@@ -1,0 +1,19 @@
+"""ceph_tpu: a TPU-native (JAX/XLA/Pallas) framework with the capabilities of Ceph's
+erasure-code and CRUSH placement subsystems.
+
+Reference: xxhdx1985126/ceph (read-only at /root/reference). This is not a port — the
+reference defines behavioral contracts (ErasureCodeInterface semantics, plugin registry,
+chunk layout, CRUSH bit-exact mapping, benchmark CLI formats); the implementation here
+is TPU-first: batched GF(2^8) bit-plane matmuls on the MXU for erasure coding, and a
+vmapped integer placement function for CRUSH.
+
+Subpackages:
+  ops      — GF(2^8) math: exact NumPy oracle + JAX/Pallas kernels
+  ec       — erasure-code framework: interface, registry, codecs (rs/shec/lrc/clay)
+  crush    — CRUSH placement: data model, NumPy oracle, vmapped JAX mapper, tools
+  osd      — mini object-store data path (striping, placement, degraded reads)
+  parallel — device-mesh sharding helpers (shard_map over stripe batches)
+  utils    — config schema, perf counters, fault injection
+"""
+
+__version__ = "0.1.0"

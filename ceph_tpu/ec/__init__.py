@@ -1,0 +1,6 @@
+"""Erasure-code framework: interface semantics, plugin registry, codecs.
+
+Behavioral contracts mirror the reference's ErasureCodeInterface
+(/root/reference/src/erasure-code/ErasureCodeInterface.h:170-462): systematic codes,
+profile string-maps, chunk padding/alignment, mapping remap, minimum_to_decode.
+"""

@@ -1,0 +1,1 @@
+"""GF(2^8) arithmetic and TPU kernels for erasure coding."""

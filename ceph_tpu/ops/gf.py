@@ -1,0 +1,182 @@
+"""Exact GF(2^8) arithmetic — the NumPy oracle for all erasure-code math.
+
+The field is GF(2^8) with the primitive polynomial x^8+x^4+x^3+x^2+1 (0x11d), the
+polynomial used by both codec families the reference supports (ISA-L's ec_base tables
+and gf-complete's w=8 default — see /root/reference/src/erasure-code/isa/README and
+the jerasure plugin, /root/reference/src/erasure-code/jerasure/ErasureCodeJerasure.cc).
+
+Everything here is exact uint8 integer math on the host. The TPU kernels in
+`gf_bitplane.py` / `gf_pallas.py` must reproduce these results bit-for-bit; tests
+compare against this module as the oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+GF_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+GF_GENERATOR = 2
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    # exp table doubled so exp[log a + log b] never needs an explicit mod 255
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= GF_POLY
+    exp[255:510] = exp[:255]
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+
+def gf_mul(a, b):
+    """Elementwise GF(2^8) multiply. Accepts scalars or arrays; returns uint8."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    out = GF_EXP[GF_LOG[a] + GF_LOG[b]]
+    return np.where((a == 0) | (b == 0), np.uint8(0), out)
+
+
+def gf_inv(a):
+    """Elementwise multiplicative inverse; inv(0) is an error."""
+    a = np.asarray(a, dtype=np.uint8)
+    if np.any(a == 0):
+        raise ZeroDivisionError("gf_inv(0)")
+    return GF_EXP[255 - GF_LOG[a]]
+
+
+def gf_div(a, b):
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    if np.any(b == 0):
+        raise ZeroDivisionError("gf_div by 0")
+    out = GF_EXP[GF_LOG[a] + 255 - GF_LOG[b]]
+    return np.where(a == 0, np.uint8(0), out)
+
+
+def gf_pow(a, n: int):
+    """a**n in GF(2^8) by square-and-multiply (exact for any int n >= 0)."""
+    result = np.uint8(1)
+    base = np.uint8(a)
+    while n > 0:
+        if n & 1:
+            result = gf_mul(result, base)
+        base = gf_mul(base, base)
+        n >>= 1
+    return result
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Matrix product over GF(2^8): (r,n) x (n,c) -> (r,c), XOR-accumulated."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    assert a.ndim == 2 and b.ndim == 2 and a.shape[1] == b.shape[0]
+    # products[i, j, l] = a[i, l] * b[l, j]; XOR-reduce over l
+    prod = gf_mul(a[:, None, :], b.T[None, :, :])
+    return np.bitwise_xor.reduce(prod, axis=2)
+
+
+def gf_matvec(a: np.ndarray, v: np.ndarray) -> np.ndarray:
+    return gf_matmul(a, v.reshape(-1, 1)).reshape(-1)
+
+
+def gf_invert_matrix(m: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inversion of a square matrix over GF(2^8).
+
+    Same role as the inversion the reference's ISA plugin performs on the survivor
+    submatrix before building decode tables (ErasureCodeIsa.cc:275). Raises
+    np.linalg.LinAlgError on a singular matrix.
+    """
+    m = np.array(m, dtype=np.uint8)
+    n = m.shape[0]
+    assert m.shape == (n, n)
+    aug = np.concatenate([m, np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        pivot = None
+        for row in range(col, n):
+            if aug[row, col]:
+                pivot = row
+                break
+        if pivot is None:
+            raise np.linalg.LinAlgError("singular GF(2^8) matrix")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        aug[col] = gf_div(aug[col], aug[col, col])
+        for row in range(n):
+            if row != col and aug[row, col]:
+                aug[row] ^= gf_mul(aug[row, col], aug[col])
+    return aug[:, n:].copy()
+
+
+# ---------------------------------------------------------------------------
+# Bit-plane (GF(2)) representation.
+#
+# Multiplication by a constant c in GF(2^8) is linear over GF(2): there is an 8x8
+# bit matrix M_c with (c * x)_bits = M_c @ x_bits (mod 2). A full (m x k) GF(2^8)
+# coding matrix therefore expands to an (8m x 8k) binary matrix, and batched
+# encode becomes one {0,1} matmul — which is exactly the formulation the TPU MXU
+# wants (see gf_bitplane.py). The same trick is what jerasure's bitmatrix
+# "schedule" codes exploit on CPUs (ErasureCodeJerasure.cc prepare_schedule).
+# ---------------------------------------------------------------------------
+
+
+def mul_bitmatrix(c) -> np.ndarray:
+    """8x8 GF(2) matrix M so that for any byte x: bits(c*x) = M @ bits(x) mod 2.
+
+    Bit order: index b is the coefficient of x^b (LSB first).
+    Column j of M is bits(c * 2^j).
+    """
+    c = int(np.uint8(c))
+    cols = []
+    for j in range(8):
+        v = int(gf_mul(c, np.uint8(1 << j)))
+        cols.append([(v >> b) & 1 for b in range(8)])
+    return np.array(cols, dtype=np.uint8).T
+
+
+def matrix_to_bitmatrix(m: np.ndarray) -> np.ndarray:
+    """Expand an (r x c) GF(2^8) matrix to an (8r x 8c) GF(2) matrix."""
+    m = np.asarray(m, dtype=np.uint8)
+    r, c = m.shape
+    out = np.zeros((8 * r, 8 * c), dtype=np.uint8)
+    for i in range(r):
+        for j in range(c):
+            out[8 * i : 8 * i + 8, 8 * j : 8 * j + 8] = mul_bitmatrix(m[i, j])
+    return out
+
+
+def bytes_to_bits(x: np.ndarray) -> np.ndarray:
+    """(..., n, L) uint8 -> (..., 8n, L) bits; row n*8+b is bit b (LSB-first)."""
+    x = np.asarray(x, dtype=np.uint8)
+    shifts = np.arange(8, dtype=np.uint8)
+    bits = (x[..., :, None, :] >> shifts[None, :, None]) & 1
+    return bits.reshape(*x.shape[:-2], x.shape[-2] * 8, x.shape[-1])
+
+
+def bits_to_bytes(bits: np.ndarray) -> np.ndarray:
+    """Inverse of bytes_to_bits."""
+    bits = np.asarray(bits, dtype=np.uint8)
+    n8, L = bits.shape[-2], bits.shape[-1]
+    assert n8 % 8 == 0
+    b = bits.reshape(*bits.shape[:-2], n8 // 8, 8, L)
+    weights = (1 << np.arange(8, dtype=np.uint16))[None, :, None]
+    return (b.astype(np.uint16) * weights).sum(axis=-2).astype(np.uint8)
+
+
+def gf_matmul_via_bits(m: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Reference bit-plane matmul: (r,k) GF matrix x (k,L) bytes -> (r,L) bytes.
+
+    Pure NumPy; used in tests to validate the bit-plane formulation against
+    gf_matmul before the same math runs on the MXU.
+    """
+    mbits = matrix_to_bitmatrix(m)
+    dbits = bytes_to_bits(data)
+    out_bits = (mbits.astype(np.int32) @ dbits.astype(np.int32)) & 1
+    return bits_to_bytes(out_bits.astype(np.uint8))

@@ -1,0 +1,17 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Must set env before jax is imported anywhere (the driver's dryrun_multichip does
+the same thing; real-TPU runs come from bench.py, which does not set these).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,90 @@
+"""GF(2^8) core: field axioms, table identities, bit-plane equivalence."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf
+
+
+def test_tables_are_a_field():
+    # exp/log round-trip for all nonzero elements
+    for a in range(1, 256):
+        assert gf.GF_EXP[gf.GF_LOG[a]] == a
+    # generator has full order
+    assert len({int(gf.gf_pow(2, i)) for i in range(255)}) == 255
+
+
+def test_mul_against_carryless_reference():
+    # independent slow oracle: schoolbook carry-less multiply + poly reduction
+    def slow_mul(a, b):
+        r = 0
+        for bit in range(8):
+            if (b >> bit) & 1:
+                r ^= a << bit
+        for bit in range(15, 7, -1):
+            if (r >> bit) & 1:
+                r ^= gf.GF_POLY << (bit - 8)
+        return r
+
+    rng = np.random.default_rng(0)
+    pairs = rng.integers(0, 256, size=(512, 2))
+    for a, b in pairs:
+        assert int(gf.gf_mul(a, b)) == slow_mul(int(a), int(b))
+    assert int(gf.gf_mul(0, 77)) == 0
+    assert int(gf.gf_mul(77, 0)) == 0
+
+
+def test_inv_div():
+    a = np.arange(1, 256, dtype=np.uint8)
+    assert np.all(gf.gf_mul(a, gf.gf_inv(a)) == 1)
+    b = np.full_like(a, 17)
+    assert np.all(gf.gf_mul(gf.gf_div(a, b), b) == a)
+    with pytest.raises(ZeroDivisionError):
+        gf.gf_inv(np.uint8(0))
+
+
+def test_matmul_and_inverse():
+    rng = np.random.default_rng(1)
+    for n in (2, 4, 8, 12):
+        while True:
+            m = rng.integers(0, 256, size=(n, n)).astype(np.uint8)
+            try:
+                inv = gf.gf_invert_matrix(m)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        assert np.array_equal(gf.gf_matmul(m, inv), np.eye(n, dtype=np.uint8))
+        assert np.array_equal(gf.gf_matmul(inv, m), np.eye(n, dtype=np.uint8))
+
+
+def test_singular_matrix_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(np.linalg.LinAlgError):
+        gf.gf_invert_matrix(m)
+
+
+def test_mul_bitmatrix_matches_mul():
+    rng = np.random.default_rng(2)
+    for c in list(range(8)) + list(rng.integers(8, 256, size=16)):
+        mb = gf.mul_bitmatrix(c)
+        for x in rng.integers(0, 256, size=8):
+            xbits = np.array([(int(x) >> b) & 1 for b in range(8)], dtype=np.uint8)
+            ybits = (mb.astype(int) @ xbits) % 2
+            y = sum(int(v) << b for b, v in enumerate(ybits))
+            assert y == int(gf.gf_mul(c, x))
+
+
+def test_bits_roundtrip():
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 256, size=(5, 4, 32)).astype(np.uint8)
+    assert np.array_equal(gf.bits_to_bytes(gf.bytes_to_bits(x)), x)
+
+
+def test_bitplane_matmul_equals_gf_matmul():
+    rng = np.random.default_rng(4)
+    for k, m, L in ((4, 2, 64), (8, 3, 96), (6, 4, 32)):
+        mat = rng.integers(0, 256, size=(m, k)).astype(np.uint8)
+        data = rng.integers(0, 256, size=(k, L)).astype(np.uint8)
+        assert np.array_equal(
+            gf.gf_matmul_via_bits(mat, data), gf.gf_matmul(mat, data)
+        )

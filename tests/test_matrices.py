@@ -1,0 +1,90 @@
+"""Coding-matrix constructions: structure + exhaustive MDS checks."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import matrices
+from ceph_tpu.ops import gf
+
+# the BASELINE.md target configs plus the reference plugins' defaults
+CONFIGS = [
+    ("reed_sol_van", 4, 2),  # benchmark config 1
+    ("reed_sol_van", 7, 3),  # jerasure defaults (ErasureCodeJerasure.h)
+    ("isa_cauchy", 8, 3),  # benchmark config 2
+    ("isa_vandermonde", 8, 3),
+    ("cauchy_orig", 4, 2),
+    ("cauchy_good", 4, 2),
+    ("cauchy_good", 8, 4),
+]
+
+
+def _is_mds(gen: np.ndarray, k: int, m: int) -> bool:
+    """Every way of keeping k of the k+m rows must be invertible."""
+    for keep in itertools.combinations(range(k + m), k):
+        try:
+            gf.gf_invert_matrix(gen[list(keep), :])
+        except np.linalg.LinAlgError:
+            return False
+    return True
+
+
+@pytest.mark.parametrize("technique,k,m", CONFIGS)
+def test_mds_property(technique, k, m):
+    gen = matrices.generator_matrix(technique, k, m)
+    assert gen.shape == (k + m, k)
+    assert np.array_equal(gen[:k], np.eye(k, dtype=np.uint8))
+    assert _is_mds(gen, k, m)
+
+
+def test_isa_vandermonde_structure():
+    p = matrices.isa_vandermonde(5, 3)
+    assert np.all(p[0] == 1)  # row of ones
+    assert np.array_equal(p[1], [1, 2, 4, 8, 16])  # powers of 2
+    assert np.array_equal(p[2], gf.gf_mul(p[1], p[1]))  # powers of 4
+
+
+def test_isa_cauchy_structure():
+    k, m = 6, 3
+    p = matrices.isa_cauchy(k, m)
+    for i in range(m):
+        for j in range(k):
+            assert p[i, j] == gf.gf_inv(np.uint8((k + i) ^ j))
+
+
+def test_jerasure_vandermonde_normalization():
+    # first parity row and first parity column are all ones (reed_sol.c contract)
+    for k, m in ((4, 2), (7, 3), (9, 5)):
+        p = matrices.jerasure_vandermonde(k, m)
+        assert np.all(p[0, :] == 1)
+        assert np.all(p[:, 0] == 1)
+
+
+def test_cauchy_orig_structure():
+    k, m = 5, 3
+    p = matrices.cauchy_orig(k, m)
+    for i in range(m):
+        for j in range(k):
+            assert p[i, j] == gf.gf_inv(np.uint8(i ^ (m + j)))
+
+
+def test_cauchy_good_not_denser_than_orig():
+    for k, m in ((4, 2), (8, 4)):
+        dense = lambda mat: sum(
+            int(gf.mul_bitmatrix(int(c)).sum()) for c in mat.flat
+        )
+        assert dense(matrices.cauchy_good(k, m)) <= dense(matrices.cauchy_orig(k, m))
+
+
+def test_decode_matrix_recovers():
+    rng = np.random.default_rng(7)
+    k, m, L = 8, 3, 64
+    gen = matrices.generator_matrix("isa_cauchy", k, m)
+    data = rng.integers(0, 256, size=(k, L)).astype(np.uint8)
+    chunks = gf.gf_matmul(gen, data)  # all k+m chunks
+    for lost in itertools.combinations(range(k + m), m):
+        present = [i for i in range(k + m) if i not in lost]
+        dm = matrices.decode_matrix(gen, k, present, list(lost))
+        rebuilt = gf.gf_matmul(dm, chunks[present[:k], :])
+        assert np.array_equal(rebuilt, chunks[list(lost), :])
