@@ -117,6 +117,15 @@ def jerasure_vandermonde(k: int, m: int) -> np.ndarray:
     return vdm[k:, :].copy()
 
 
+def jerasure_r6(k: int, m: int) -> np.ndarray:
+    """jerasure reed_sol_r6_coding_matrix (reed_sol_r6_op technique): RAID6
+    P row = all ones, Q row = [1, 2, 4, ...] — identical to the first two
+    Vandermonde parity rows. m must be 2."""
+    if m != 2:
+        raise ValueError("reed_sol_r6_op requires m=2")
+    return isa_vandermonde(k, 2)
+
+
 def cauchy_orig(k: int, m: int) -> np.ndarray:
     """jerasure cauchy_original_coding_matrix: a[i,j] = 1 / (i ^ (m+j))."""
     if k + m > 256:
@@ -160,6 +169,7 @@ TECHNIQUES = {
     "isa_cauchy": isa_cauchy,
     # reference plugin=jerasure technique= names (ErasureCodeJerasure.cc)
     "reed_sol_van": jerasure_vandermonde,
+    "reed_sol_r6_op": jerasure_r6,
     "cauchy_orig": cauchy_orig,
     "cauchy_good": cauchy_good,
 }

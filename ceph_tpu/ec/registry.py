@@ -1,0 +1,77 @@
+"""Erasure-code plugin registry.
+
+Mirrors the reference's `ErasureCodePluginRegistry`
+(/root/reference/src/erasure-code/ErasureCodePlugin.{h,cc}): plugins register a
+factory under a name; `factory(plugin, profile)` instantiates and initializes a
+codec. Where the reference dlopens `libec_<name>.so` with a version handshake
+(ErasureCodePlugin.cc:92-160), this registry imports python entry points — the
+native-shim equivalent (a C++ `libec_tpu.so` exposing the same C entry points)
+can be layered on by registering a ctypes-backed factory.
+
+Plugin names follow the reference: `jerasure`, `isa`, `shec`, `lrc`, `clay` —
+plus the new `tpu` plugin that this framework adds (the north-star deliverable:
+`plugin=tpu` selects the TPU backend). All of them run on the same TPU kernels;
+the name selects matrix family, defaults, and chunk-size behavior so profiles
+written for the reference behave identically.
+"""
+
+from __future__ import annotations
+
+import errno
+from typing import Callable
+
+from ceph_tpu.ec.interface import ErasureCode, ErasureCodeError, ErasureCodeProfile
+
+
+class ErasureCodePluginRegistry:
+    def __init__(self):
+        self._factories: dict[str, Callable[[], ErasureCode]] = {}
+
+    def add(self, name: str, factory: Callable[[], ErasureCode]) -> None:
+        if name in self._factories:
+            raise ErasureCodeError(errno.EEXIST, f"plugin {name} already registered")
+        self._factories[name] = factory
+
+    def remove(self, name: str) -> None:
+        self._factories.pop(name, None)
+
+    def get_plugins(self) -> list[str]:
+        return sorted(self._factories)
+
+    def factory(self, plugin: str, profile: ErasureCodeProfile) -> ErasureCode:
+        """Instantiate + init a codec from a profile; the profile's own
+        `plugin=` key, if present, must agree (as when profiles are stored in
+        pool metadata)."""
+        declared = profile.get("plugin")
+        if declared is not None and declared != plugin:
+            raise ErasureCodeError(
+                errno.EINVAL,
+                f"profile declares plugin={declared} but {plugin} was requested",
+            )
+        try:
+            make = self._factories[plugin]
+        except KeyError:
+            raise ErasureCodeError(
+                errno.ENOENT,
+                f"no erasure-code plugin {plugin!r}; known: {self.get_plugins()}",
+            ) from None
+        return make().init(dict(profile))
+
+
+#: process-wide singleton, like ErasureCodePluginRegistry::instance()
+registry = ErasureCodePluginRegistry()
+
+
+def _register_builtin() -> None:
+    from ceph_tpu.ec.rs import ErasureCodeRs
+
+    registry.add("tpu", lambda: ErasureCodeRs("tpu"))
+    registry.add("jerasure", lambda: ErasureCodeRs("jerasure"))
+    registry.add("isa", lambda: ErasureCodeRs("isa"))
+
+
+_register_builtin()
+
+
+def factory(plugin: str, profile: ErasureCodeProfile) -> ErasureCode:
+    return registry.factory(plugin, profile)

@@ -1,17 +1,17 @@
 """Test harness config: run JAX on a virtual 8-device CPU mesh.
 
-Must set env before jax is imported anywhere (the driver's dryrun_multichip does
-the same thing; real-TPU runs come from bench.py, which does not set these).
+The axon TPU plugin ignores JAX_PLATFORMS/XLA_FLAGS env vars, so the platform
+must be forced through jax.config before the backend initializes (the driver's
+dryrun_multichip path does the equivalent; real-TPU runs come from bench.py,
+which leaves the default platform alone).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
