@@ -1,0 +1,197 @@
+"""Map construction: buckets with derived fields, rules, reweighting.
+
+Re-expresses /root/reference/src/crush/builder.c: each bucket algorithm
+precomputes what its choose function needs — list buckets a running weight
+prefix (builder.c crush_make_list_bucket), tree buckets a binary-heap weight
+array over nodes 2i+1 (crush_make_tree_bucket), straw(1) buckets calibrated
+straw lengths via the historical float search (crush_calc_straw, version >= 1
+semantics), straw2 just the raw weights. All weights 16.16 fixed point.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.crush.types import (
+    Bucket,
+    BucketAlg,
+    CrushMap,
+    Rule,
+    RuleOp,
+    RuleStep,
+)
+
+
+def tree_depth(size: int) -> int:
+    if size == 0:
+        return 0
+    depth = 1
+    t = size - 1
+    while t:
+        t >>= 1
+        depth += 1
+    return depth
+
+
+def _tree_height(n: int) -> int:
+    h = 0
+    while (n & 1) == 0:
+        h += 1
+        n >>= 1
+    return h
+
+
+def _tree_parent(n: int) -> int:
+    h = _tree_height(n)
+    if n & (1 << (h + 1)):
+        return n - (1 << h)
+    return n + (1 << h)
+
+
+def calc_straws(weights: list[int], straw_calc_version: int = 1) -> list[int]:
+    """Straw(1) calibration — the flawed-but-frozen historical algorithm
+    (builder.c crush_calc_straw). Returns 16.16 straw lengths."""
+    size = len(weights)
+    order = sorted(range(size), key=lambda i: (weights[i], i))
+    straws = [0] * size
+    numleft = size
+    straw = 1.0
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        if straw_calc_version == 0:
+            if weights[order[i]] == 0:
+                straws[order[i]] = 0
+                i += 1
+                continue
+            straws[order[i]] = int(straw * 0x10000)
+            i += 1
+            if i == size:
+                break
+            if weights[order[i]] == weights[order[i - 1]]:
+                continue
+            wbelow += (weights[order[i - 1]] - lastw) * numleft
+            j = i
+            while j < size and weights[order[j]] == weights[order[i]]:
+                numleft -= 1
+                j += 1
+            wnext = numleft * (weights[order[i]] - weights[order[i - 1]])
+            pbelow = wbelow / (wbelow + wnext)
+            straw *= (1.0 / pbelow) ** (1.0 / numleft)
+            lastw = weights[order[i - 1]]
+        else:
+            if weights[order[i]] == 0:
+                straws[order[i]] = 0
+                i += 1
+                numleft -= 1
+                continue
+            straws[order[i]] = int(straw * 0x10000)
+            i += 1
+            if i == size:
+                break
+            wbelow += (weights[order[i - 1]] - lastw) * numleft
+            numleft -= 1
+            wnext = numleft * (weights[order[i]] - weights[order[i - 1]])
+            pbelow = wbelow / (wbelow + wnext)
+            straw *= (1.0 / pbelow) ** (1.0 / numleft)
+            lastw = weights[order[i - 1]]
+    return straws
+
+
+def make_bucket(
+    map: CrushMap,
+    bucket_id: int,
+    alg: BucketAlg,
+    type: int,
+    items: list[int],
+    weights: list[int],
+    hash: int = 0,
+) -> Bucket:
+    """Create a bucket with derived fields and register it in the map.
+
+    For UNIFORM buckets every item must carry the same weight (the reference's
+    crush_make_bucket takes a single item_weight; CrushWrapper passes the
+    first item's weight).
+    """
+    assert bucket_id < 0, "bucket ids are negative"
+    assert len(items) == len(weights)
+    size = len(items)
+    b = Bucket(
+        id=bucket_id,
+        type=type,
+        alg=alg,
+        hash=hash,
+        weight=sum(weights),
+        items=list(items),
+        item_weights=list(weights),
+    )
+    if alg == BucketAlg.UNIFORM:
+        b.item_weight = weights[0] if size else 0
+        b.weight = size * b.item_weight
+    elif alg == BucketAlg.LIST:
+        acc = 0
+        b.sum_weights = []
+        for w in weights:
+            acc += w
+            b.sum_weights.append(acc)
+    elif alg == BucketAlg.TREE:
+        depth = tree_depth(size)
+        num_nodes = 1 << depth
+        node_weights = [0] * num_nodes
+        for i, w in enumerate(weights):
+            node = (i << 1) + 1  # crush_calc_tree_node
+            node_weights[node] = w
+            for _ in range(1, depth):
+                node = _tree_parent(node)
+                node_weights[node] += w
+        b.node_weights = node_weights
+    elif alg == BucketAlg.STRAW:
+        b.straws = calc_straws(weights, map.tunables.straw_calc_version)
+    elif alg == BucketAlg.STRAW2:
+        pass
+    else:
+        raise ValueError(f"unknown bucket alg {alg}")
+    map.buckets[bucket_id] = b
+    if map.max_devices <= max((i for i in items if i >= 0), default=-1):
+        map.max_devices = max(i for i in items if i >= 0) + 1
+    return b
+
+
+def make_rule(
+    map: CrushMap,
+    rule_id: int,
+    steps: list[RuleStep],
+    rule_type: int = 1,
+    min_size: int = 1,
+    max_size: int = 10,
+) -> Rule:
+    rule = Rule(
+        rule_id=rule_id,
+        ruleset=rule_id,
+        type=rule_type,
+        min_size=min_size,
+        max_size=max_size,
+        steps=list(steps),
+    )
+    map.rules[rule_id] = rule
+    return rule
+
+
+def make_simple_rule(
+    map: CrushMap,
+    rule_id: int,
+    root: int,
+    failure_domain_type: int,
+    mode: str = "firstn",
+    num: int = 0,
+) -> Rule:
+    """The common replicated/EC rule shape (CrushWrapper::add_simple_rule):
+    take root -> chooseleaf <mode> num type <domain> -> emit."""
+    op = (
+        RuleOp.CHOOSELEAF_FIRSTN if mode == "firstn" else RuleOp.CHOOSELEAF_INDEP
+    )
+    steps = [
+        RuleStep(RuleOp.TAKE, root),
+        RuleStep(op, num, failure_domain_type),
+        RuleStep(RuleOp.EMIT),
+    ]
+    return make_rule(map, rule_id, steps, rule_type=1 if mode == "firstn" else 3)

@@ -100,12 +100,22 @@ class ErasureCode:
 
     def _parse_mapping(self, profile: ErasureCodeProfile) -> None:
         # 'D' marks a data position; others are coding (ErasureCode.cc:274).
+        # Must be called after k/m are known: a mapping whose length is not
+        # k+m (or with the wrong number of 'D's) is rejected as the reference
+        # does (ErasureCodeJerasure.cc:69-75), else chunks would silently map
+        # to out-of-range physical positions.
         mapping = profile.get("mapping")
         if mapping is None:
             self.chunk_mapping = []
             return
         data_pos = [i for i, c in enumerate(mapping) if c == "D"]
         coding_pos = [i for i, c in enumerate(mapping) if c != "D"]
+        if len(mapping) != self.get_chunk_count() or len(data_pos) != self.k:
+            raise ErasureCodeError(
+                errno.EINVAL,
+                f"mapping {mapping!r} needs length k+m={self.get_chunk_count()}"
+                f" with exactly k={self.k} 'D' positions",
+            )
         self.chunk_mapping = data_pos + coding_pos
 
     def sanity_check_k_m(self) -> None:

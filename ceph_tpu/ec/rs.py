@@ -28,6 +28,7 @@ from ceph_tpu.ec.interface import (
     SIMD_ALIGN,
     ErasureCode,
     ErasureCodeError,
+    align_up,
     chunk_size_isa_style,
     chunk_size_jerasure_style,
     profile_to_bool,
@@ -86,11 +87,12 @@ class ErasureCodeRs(ErasureCode):
         self.per_chunk_alignment = profile_to_bool(
             profile, "jerasure-per-chunk-alignment", False
         )
-        self.sanity_check_k_m()
-        if self.w != 8:
-            raise ErasureCodeError(
-                errno.EINVAL, f"w={self.w} not supported (GF(2^8) only)"
-            )
+        # packetsize only exists for jerasure's bitmatrix (cauchy) techniques
+        self.packetsize = (
+            profile_to_int(profile, "packetsize", 2048)
+            if self.family == "jerasure"
+            else 1
+        )
         techniques = self.TECHNIQUES[self.family]
         if self.technique not in techniques:
             raise ErasureCodeError(
@@ -98,14 +100,20 @@ class ErasureCodeRs(ErasureCode):
                 f"technique={self.technique} is not a valid {self.family} "
                 f"technique (know {sorted(techniques)})",
             )
-        if self.k + self.m > 256:
-            raise ErasureCodeError(errno.EINVAL, "k+m must be <= 256 for w=8")
         matrix_key = techniques[self.technique]
         if matrix_key == "reed_sol_r6_op":
             # RAID6 is m=2 by construction; the reference coerces m rather
-            # than rejecting (ErasureCodeJerasure.cc:238-252 erases profile m)
+            # than rejecting (ErasureCodeJerasure.cc:238-252 erases profile m),
+            # so coerce BEFORE the geometry checks below run
             self.m = 2
             profile["m"] = "2"
+        self.sanity_check_k_m()
+        if self.w != 8:
+            raise ErasureCodeError(
+                errno.EINVAL, f"w={self.w} not supported (GF(2^8) only)"
+            )
+        if self.k + self.m > 256:
+            raise ErasureCodeError(errno.EINVAL, "k+m must be <= 256 for w=8")
         if matrix_key == "isa_vandermonde":
             # MDS safety envelope, ErasureCodeIsa.cc:325-364
             if self.k > 32 or self.m > 4 or (self.m == 4 and self.k > 21):
@@ -134,12 +142,22 @@ class ErasureCodeRs(ErasureCode):
 
     def get_chunk_size(self, object_size: int) -> int:
         if self.family == "jerasure":
+            # bitmatrix (cauchy) techniques fold packetsize into the alignment
+            # (ErasureCodeJerasureCauchy::get_alignment, .cc:279-293); the
+            # matrix techniques use the plain word alignment (.cc:174-184)
+            cauchy = self.technique.startswith("cauchy")
             if self.per_chunk_alignment:
-                alignment = self.w * LARGEST_VECTOR_WORDSIZE
+                if cauchy:
+                    alignment = align_up(
+                        self.w * self.packetsize, LARGEST_VECTOR_WORDSIZE
+                    )
+                else:
+                    alignment = self.w * LARGEST_VECTOR_WORDSIZE
             else:
-                alignment = self.k * self.w * 4
-                if (self.w * 4) % LARGEST_VECTOR_WORDSIZE:
-                    alignment = self.k * self.w * LARGEST_VECTOR_WORDSIZE
+                packet = self.packetsize if cauchy else 1
+                alignment = self.k * self.w * packet * 4
+                if (self.w * packet * 4) % LARGEST_VECTOR_WORDSIZE:
+                    alignment = self.k * self.w * packet * LARGEST_VECTOR_WORDSIZE
             return chunk_size_jerasure_style(
                 self.k, object_size, alignment, self.per_chunk_alignment
             )

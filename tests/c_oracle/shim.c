@@ -1,0 +1,144 @@
+/*
+ * Test-only oracle driver: builds a crush_map via the reference's builder API
+ * and evaluates crush_do_rule over a range of inputs, printing the mappings.
+ *
+ * This file is part of the new framework's TEST SUITE only. It is compiled at
+ * test time against the reference checkout (read-only, path passed by the
+ * test harness via -I) so the framework's Python/JAX mappers can be validated
+ * bit-for-bit against the original C implementation. Nothing from the
+ * reference is copied into the framework itself.
+ *
+ * Input protocol (stdin, line oriented):
+ *   tunables <local_tries> <local_fallback> <total_tries> <descend_once> <vary_r> <stable> <straw_calc>
+ *   bucket <id> <alg> <type> <hash> <n> <item0> <w0> ... (weights 16.16)
+ *   rule <ruleno> <ruleset> <type> <minsz> <maxsz> <nsteps>
+ *   step <op> <arg1> <arg2>            (nsteps of these after each rule)
+ *   choosearg <bucket_id> <has_ids> <size> <npositions>
+ *             [size ids if has_ids] [npositions x size weights]
+ *   run <ruleno> <min_x> <max_x> <result_max> <nweights> <w0> ... (16.16)
+ *
+ * Output: one line per x: "x: id id id ..." (raw ids; CRUSH_ITEM_NONE as-is)
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "crush/crush.h"
+#include "crush/builder.h"
+#include "crush/mapper.h"
+#include "crush/hash.h"
+
+#define MAX_CA 256
+static struct crush_choose_arg choose_args[MAX_CA];
+static int have_choose_args = 0;
+
+int main(void) {
+  struct crush_map *map = crush_create();
+  char cmd[32];
+  struct crush_rule *rule = NULL;
+  int pending_steps = 0, step_i = 0;
+
+  while (scanf("%31s", cmd) == 1) {
+    if (!strcmp(cmd, "tunables")) {
+      int clt, clf, ctt, cdo, cvr, cs, scv;
+      if (scanf("%d %d %d %d %d %d %d", &clt, &clf, &ctt, &cdo, &cvr, &cs,
+                &scv) != 7)
+        return 2;
+      map->choose_local_tries = clt;
+      map->choose_local_fallback_tries = clf;
+      map->choose_total_tries = ctt;
+      map->chooseleaf_descend_once = cdo;
+      map->chooseleaf_vary_r = cvr;
+      map->chooseleaf_stable = cs;
+      map->straw_calc_version = scv;
+    } else if (!strcmp(cmd, "bucket")) {
+      int id, alg, type, hash, n;
+      if (scanf("%d %d %d %d %d", &id, &alg, &type, &hash, &n) != 5)
+        return 2;
+      int *items = malloc(sizeof(int) * n);
+      int *weights = malloc(sizeof(int) * n);
+      for (int i = 0; i < n; i++)
+        if (scanf("%d %d", &items[i], &weights[i]) != 2)
+          return 2;
+      struct crush_bucket *b =
+          crush_make_bucket(map, alg, hash, type, n, items, weights);
+      if (!b) return 3;
+      int idout;
+      if (crush_add_bucket(map, id, b, &idout) < 0) return 3;
+      free(items);
+      free(weights);
+    } else if (!strcmp(cmd, "rule")) {
+      int ruleno, ruleset, type, minsz, maxsz, nsteps;
+      if (scanf("%d %d %d %d %d %d", &ruleno, &ruleset, &type, &minsz, &maxsz,
+                &nsteps) != 6)
+        return 2;
+      rule = crush_make_rule(nsteps, ruleset, type, minsz, maxsz);
+      if (!rule) return 3;
+      pending_steps = nsteps;
+      step_i = 0;
+      if (crush_add_rule(map, rule, ruleno) < 0) return 3;
+    } else if (!strcmp(cmd, "step")) {
+      int op, a1, a2;
+      if (scanf("%d %d %d", &op, &a1, &a2) != 3) return 2;
+      if (!rule || step_i >= pending_steps) return 4;
+      crush_rule_set_step(rule, step_i++, op, a1, a2);
+    } else if (!strcmp(cmd, "choosearg")) {
+      int id, has_ids, size, npos;
+      if (scanf("%d %d %d %d", &id, &has_ids, &size, &npos) != 4) return 2;
+      int pos = -1 - id;
+      if (pos < 0 || pos >= MAX_CA) return 6;
+      struct crush_choose_arg *ca = &choose_args[pos];
+      if (has_ids) {
+        ca->ids = malloc(sizeof(__s32) * size);
+        ca->ids_size = size;
+        for (int i = 0; i < size; i++)
+          if (scanf("%d", &ca->ids[i]) != 1) return 2;
+      }
+      if (npos > 0) {
+        ca->weight_set = malloc(sizeof(struct crush_weight_set) * npos);
+        ca->weight_set_positions = npos;
+        for (int p = 0; p < npos; p++) {
+          ca->weight_set[p].weights = malloc(sizeof(__u32) * size);
+          ca->weight_set[p].size = size;
+          for (int i = 0; i < size; i++) {
+            int w;
+            if (scanf("%d", &w) != 1) return 2;
+            ca->weight_set[p].weights[i] = (__u32)w;
+          }
+        }
+      }
+      have_choose_args = 1;
+    } else if (!strcmp(cmd, "run")) {
+      int ruleno, min_x, max_x, result_max, nweights;
+      if (scanf("%d %d %d %d %d", &ruleno, &min_x, &max_x, &result_max,
+                &nweights) != 5)
+        return 2;
+      __u32 *weights = malloc(sizeof(__u32) * nweights);
+      for (int i = 0; i < nweights; i++) {
+        int w;
+        if (scanf("%d", &w) != 1) return 2;
+        weights[i] = (__u32)w;
+      }
+      crush_finalize(map);
+      /* crush_do_rule carves its w/o/c scratch vectors out of the space past
+         working_size (mapper.c:907), so allocate 3*result_max ints extra */
+      void *cwin = malloc(map->working_size + 3 * result_max * sizeof(int));
+      int *result = malloc(sizeof(int) * result_max);
+      for (int x = min_x; x < max_x; x++) {
+        crush_init_workspace(map, cwin);
+        int len = crush_do_rule(map, ruleno, x, result, result_max, weights,
+                                nweights, cwin,
+                                have_choose_args ? choose_args : NULL);
+        printf("%d:", x);
+        for (int i = 0; i < len; i++) printf(" %d", result[i]);
+        printf("\n");
+      }
+      free(result);
+      free(cwin);
+      free(weights);
+    } else {
+      return 5;
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,117 @@
+"""Test helper: run the reference C mapper as an external oracle.
+
+Compiles tests/c_oracle/shim.c against the reference checkout (if present at
+/root/reference) and exposes `oracle_do_rule` with the same signature shape as
+ceph_tpu.crush.mapper.do_rule. Tests that need the oracle skip cleanly when the
+reference or a C compiler is unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+REFERENCE = os.environ.get("CEPH_REFERENCE", "/root/reference")
+_SHIM = None
+
+
+def have_reference() -> bool:
+    return os.path.isdir(os.path.join(REFERENCE, "src", "crush"))
+
+
+def build_shim() -> str | None:
+    """Compile the oracle once per session; returns binary path or None."""
+    global _SHIM
+    if _SHIM is not None:
+        return _SHIM or None
+    if not have_reference():
+        _SHIM = ""
+        return None
+    tmp = tempfile.mkdtemp(prefix="crush_oracle_")
+    inc = os.path.join(tmp, "inc")
+    os.makedirs(inc)
+    with open(os.path.join(inc, "acconfig.h"), "w") as f:
+        f.write("#define HAVE_LINUX_TYPES_H 1\n")
+    out = os.path.join(tmp, "crush_shim")
+    crush = os.path.join(REFERENCE, "src", "crush")
+    here = os.path.dirname(os.path.abspath(__file__))
+    cmd = [
+        "gcc", "-O2", f"-I{inc}", f"-I{os.path.join(REFERENCE, 'src')}",
+        os.path.join(here, "c_oracle", "shim.c"),
+        os.path.join(crush, "builder.c"),
+        os.path.join(crush, "mapper.c"),
+        os.path.join(crush, "crush.c"),
+        os.path.join(crush, "hash.c"),
+        "-lm", "-o", out,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        _SHIM = ""
+        return None
+    _SHIM = out
+    return out
+
+
+def map_to_protocol(cmap) -> str:
+    """Serialize a ceph_tpu CrushMap to the shim's input protocol."""
+    t = cmap.tunables
+    lines = [
+        f"tunables {t.choose_local_tries} {t.choose_local_fallback_tries} "
+        f"{t.choose_total_tries} {t.chooseleaf_descend_once} "
+        f"{t.chooseleaf_vary_r} {t.chooseleaf_stable} {t.straw_calc_version}"
+    ]
+    for bid in sorted(cmap.buckets, reverse=True):  # shallowest ids first
+        b = cmap.buckets[bid]
+        if b.alg.name == "UNIFORM":
+            weights = [b.item_weight] * b.size
+        else:
+            weights = b.item_weights
+        items = " ".join(f"{i} {w}" for i, w in zip(b.items, weights))
+        lines.append(
+            f"bucket {b.id} {int(b.alg)} {b.type} {b.hash} {b.size} {items}"
+        )
+    for bid, ca in sorted(cmap.choose_args.items(), reverse=True):
+        b = cmap.buckets[bid]
+        has_ids = 1 if ca.ids is not None else 0
+        npos = len(ca.weight_set) if ca.weight_set is not None else 0
+        parts = [f"choosearg {bid} {has_ids} {b.size} {npos}"]
+        if ca.ids is not None:
+            parts.append(" ".join(str(i) for i in ca.ids))
+        if ca.weight_set is not None:
+            for row in ca.weight_set:
+                parts.append(" ".join(str(w) for w in row))
+        lines.append(" ".join(parts))
+    for rid in sorted(cmap.rules):
+        r = cmap.rules[rid]
+        lines.append(
+            f"rule {r.rule_id} {r.ruleset} {r.type} {r.min_size} "
+            f"{r.max_size} {len(r.steps)}"
+        )
+        for s in r.steps:
+            lines.append(f"step {int(s.op)} {s.arg1} {s.arg2}")
+    return "\n".join(lines)
+
+
+def oracle_do_rule(cmap, ruleno, xs, weight, result_max) -> list[list[int]]:
+    """Run the C oracle for every x in xs; returns result vectors."""
+    shim = build_shim()
+    assert shim, "oracle unavailable"
+    xs = list(xs)
+    assert xs == list(range(xs[0], xs[-1] + 1)), "contiguous x range required"
+    text = map_to_protocol(cmap)
+    wstr = " ".join(str(w) for w in weight)
+    text += (
+        f"\nrun {ruleno} {xs[0]} {xs[-1] + 1} {result_max} "
+        f"{len(weight)} {wstr}\n"
+    )
+    proc = subprocess.run(
+        [shim], input=text, capture_output=True, text=True, check=True
+    )
+    results = []
+    for line in proc.stdout.strip().splitlines():
+        _, _, rest = line.partition(":")
+        results.append([int(v) for v in rest.split()])
+    assert len(results) == len(xs)
+    return results

@@ -1,0 +1,232 @@
+"""Python CRUSH mapper vs the reference C implementation (external oracle).
+
+Random maps across every bucket algorithm, rule shape, and tunable profile;
+every x must map to the identical OSD vector. This is the bit-exactness gate
+SURVEY.md 2.1 requires before the vectorized/JAX mapper can trust the Python
+oracle as its own reference.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import builder as cb
+from ceph_tpu.crush import mapper as cm
+from ceph_tpu.crush.types import BucketAlg, CrushMap, RuleOp, RuleStep, Tunables
+
+from tests.crush_oracle import build_shim, oracle_do_rule
+
+pytestmark = pytest.mark.skipif(
+    build_shim() is None, reason="reference C oracle unavailable"
+)
+
+rng = np.random.default_rng(0xC12)
+
+
+def build_two_level_map(
+    alg: BucketAlg,
+    n_hosts: int = 8,
+    osds_per_host: int = 4,
+    tunables: Tunables | None = None,
+    uniform: bool = False,
+    seed: int = 0,
+) -> CrushMap:
+    """root(-1, straw2) -> hosts(type 1, `alg`) -> osds."""
+    local = np.random.default_rng(seed)
+    cmap = CrushMap(tunables=tunables or Tunables.jewel())
+    host_ids, host_weights = [], []
+    osd = 0
+    for h in range(n_hosts):
+        items = list(range(osd, osd + osds_per_host))
+        osd += osds_per_host
+        if uniform or alg == BucketAlg.UNIFORM:
+            weights = [int(local.integers(1, 4)) * 0x10000] * osds_per_host
+        else:
+            weights = [
+                int(local.integers(1, 8 * 0x10000)) for _ in range(osds_per_host)
+            ]
+        b = cb.make_bucket(cmap, -(h + 2), alg, 1, items, weights)
+        host_ids.append(b.id)
+        host_weights.append(b.weight)
+    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, host_ids, host_weights)
+    return cmap
+
+
+def compare(cmap, ruleno, xs, weight, result_max):
+    got = [
+        cm.do_rule(cmap, ruleno, x, weight, result_max, cm.Workspace())
+        for x in xs
+    ]
+    want = oracle_do_rule(cmap, ruleno, xs, weight, result_max)
+    mismatches = [(x, g, w) for x, g, w in zip(xs, got, want) if g != w]
+    assert not mismatches, mismatches[:5]
+
+
+@pytest.mark.parametrize("alg", list(BucketAlg))
+def test_chooseleaf_firstn_all_algs(alg):
+    cmap = build_two_level_map(alg, seed=int(alg))
+    cb.make_simple_rule(cmap, 0, -1, 1, "firstn", 0)
+    weight = [0x10000] * cmap.max_devices
+    compare(cmap, 0, range(0, 256), weight, 3)
+
+
+@pytest.mark.parametrize("alg", [BucketAlg.STRAW2, BucketAlg.STRAW, BucketAlg.LIST])
+def test_chooseleaf_indep(alg):
+    cmap = build_two_level_map(alg, seed=7 + int(alg))
+    cb.make_simple_rule(cmap, 0, -1, 1, "indep", 0)
+    weight = [0x10000] * cmap.max_devices
+    compare(cmap, 0, range(0, 256), weight, 6)
+
+
+@pytest.mark.parametrize("profile", ["argonaut", "bobtail", "firefly", "jewel"])
+def test_tunable_profiles(profile):
+    cmap = build_two_level_map(
+        BucketAlg.STRAW2, tunables=getattr(Tunables, profile)(), seed=11
+    )
+    cb.make_simple_rule(cmap, 0, -1, 1, "firstn", 0)
+    weight = [0x10000] * cmap.max_devices
+    compare(cmap, 0, range(0, 200), weight, 3)
+
+
+def test_reweighted_and_out_devices():
+    cmap = build_two_level_map(BucketAlg.STRAW2, seed=13)
+    cb.make_simple_rule(cmap, 0, -1, 1, "firstn", 0)
+    weight = [0x10000] * cmap.max_devices
+    weight[0] = 0          # out
+    weight[5] = 0x8000     # half-weight overload test
+    weight[9] = 0x0100
+    compare(cmap, 0, range(0, 300), weight, 3)
+
+
+def test_choose_device_directly():
+    # choose firstn 0 type 0 straight from a flat straw2 bucket of devices
+    cmap = CrushMap(tunables=Tunables.jewel())
+    weights = [int(rng.integers(1, 10 * 0x10000)) for _ in range(24)]
+    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 1, list(range(24)), weights)
+    cb.make_rule(cmap, 0, [
+        RuleStep(RuleOp.TAKE, -1),
+        RuleStep(RuleOp.CHOOSE_FIRSTN, 0, 0),
+        RuleStep(RuleOp.EMIT),
+    ])
+    weight = [0x10000] * 24
+    compare(cmap, 0, range(0, 300), weight, 4)
+
+
+def test_three_level_hierarchy_with_choose_steps():
+    # root -> 4 racks (straw2) -> 3 hosts each (straw2) -> 2 osds each;
+    # rule: choose 2 racks, chooseleaf 2 hosts per rack
+    cmap = CrushMap(tunables=Tunables.jewel())
+    local = np.random.default_rng(17)
+    osd = 0
+    rack_ids, rack_weights = [], []
+    bid = -2
+    for r in range(4):
+        host_ids, host_weights = [], []
+        for h in range(3):
+            items = [osd, osd + 1]
+            osd += 2
+            weights = [int(local.integers(1, 6 * 0x10000)) for _ in range(2)]
+            b = cb.make_bucket(cmap, bid, BucketAlg.STRAW2, 1, items, weights)
+            bid -= 1
+            host_ids.append(b.id)
+            host_weights.append(b.weight)
+        rb = cb.make_bucket(cmap, bid, BucketAlg.STRAW2, 2, host_ids, host_weights)
+        bid -= 1
+        rack_ids.append(rb.id)
+        rack_weights.append(rb.weight)
+    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, rack_ids, rack_weights)
+    cb.make_rule(cmap, 0, [
+        RuleStep(RuleOp.TAKE, -1),
+        RuleStep(RuleOp.CHOOSE_FIRSTN, 2, 2),
+        RuleStep(RuleOp.CHOOSELEAF_FIRSTN, 2, 1),
+        RuleStep(RuleOp.EMIT),
+    ])
+    weight = [0x10000] * cmap.max_devices
+    compare(cmap, 0, range(0, 300), weight, 4)
+
+
+def test_indep_with_zero_weight_failure_domain():
+    # EC-style indep with an entire host out: positions must hold NONE markers
+    # exactly where the reference puts them
+    cmap = build_two_level_map(BucketAlg.STRAW2, n_hosts=4, seed=23)
+    cb.make_simple_rule(cmap, 0, -1, 1, "indep", 0)
+    weight = [0x10000] * cmap.max_devices
+    for i in range(4):
+        weight[i] = 0  # host -2 fully out
+    compare(cmap, 0, range(0, 200), weight, 6)
+
+
+def test_choose_args_weight_set_and_ids():
+    # balancer-style per-position weight_set plus remapped ids on the root
+    # bucket (crush.h:248-294); position clamping must match the reference
+    cmap = build_two_level_map(BucketAlg.STRAW2, n_hosts=6, seed=31)
+    cb.make_simple_rule(cmap, 0, -1, 1, "firstn", 0)
+    local = np.random.default_rng(5)
+    root = cmap.buckets[-1]
+    from ceph_tpu.crush.types import ChooseArg
+
+    cmap.choose_args[-1] = ChooseArg(
+        ids=[int(i) + 100 for i in range(root.size)],
+        weight_set=[
+            [int(local.integers(1, 8 * 0x10000)) for _ in range(root.size)]
+            for _ in range(2)  # fewer positions than numrep -> clamp path
+        ],
+    )
+    for h in range(6):
+        b = cmap.buckets[-(h + 2)]
+        cmap.choose_args[b.id] = ChooseArg(
+            weight_set=[
+                [int(local.integers(1, 8 * 0x10000)) for _ in range(b.size)]
+            ]
+        )
+    weight = [0x10000] * cmap.max_devices
+    compare(cmap, 0, range(0, 300), weight, 3)
+
+
+def test_multi_take_choose_under_legacy_tunables():
+    # two chained CHOOSE steps with multiple take entries exercise the
+    # per-entry output-offset semantics (o+osize, outpos=0) under tunable
+    # profiles where chooseleaf_stable=0
+    for profile in ("argonaut", "bobtail", "firefly"):
+        cmap = CrushMap(tunables=getattr(Tunables, profile)())
+        local = np.random.default_rng(37)
+        osd = 0
+        rack_ids, rack_weights = [], []
+        bid = -2
+        for r in range(3):
+            host_ids, host_weights = [], []
+            for h in range(3):
+                items = [osd, osd + 1, osd + 2]
+                osd += 3
+                ws = [int(local.integers(1, 6 * 0x10000)) for _ in range(3)]
+                b = cb.make_bucket(cmap, bid, BucketAlg.STRAW2, 1, items, ws)
+                bid -= 1
+                host_ids.append(b.id)
+                host_weights.append(b.weight)
+            rb = cb.make_bucket(
+                cmap, bid, BucketAlg.STRAW2, 2, host_ids, host_weights
+            )
+            bid -= 1
+            rack_ids.append(rb.id)
+            rack_weights.append(rb.weight)
+        cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, rack_ids, rack_weights)
+        cb.make_rule(cmap, 0, [
+            RuleStep(RuleOp.TAKE, -1),
+            RuleStep(RuleOp.CHOOSE_FIRSTN, 2, 2),
+            RuleStep(RuleOp.CHOOSELEAF_FIRSTN, 2, 1),
+            RuleStep(RuleOp.EMIT),
+        ])
+        weight = [0x10000] * cmap.max_devices
+        compare(cmap, 0, range(0, 150), weight, 6)
+
+
+def test_set_tries_steps():
+    cmap = build_two_level_map(BucketAlg.STRAW2, seed=29)
+    cb.make_rule(cmap, 0, [
+        RuleStep(RuleOp.SET_CHOOSELEAF_TRIES, 5),
+        RuleStep(RuleOp.SET_CHOOSE_TRIES, 100),
+        RuleStep(RuleOp.TAKE, -1),
+        RuleStep(RuleOp.CHOOSELEAF_FIRSTN, 0, 1),
+        RuleStep(RuleOp.EMIT),
+    ])
+    weight = [0x10000] * cmap.max_devices
+    compare(cmap, 0, range(0, 200), weight, 3)

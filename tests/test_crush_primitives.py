@@ -1,0 +1,101 @@
+"""CRUSH primitive parity: hash + crush_ln vs the reference C, and
+numpy-vectorized vs scalar implementations."""
+
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import hash as ch
+from ceph_tpu.crush.ln_tables import LL_TBL, RH_LH_TBL, crush_ln
+
+from tests.crush_oracle import build_shim
+
+rng = np.random.default_rng(3)
+
+
+@pytest.fixture(scope="module")
+def ref_hash():
+    """ctypes binding to the reference hash.c (compiled into the shim dir)."""
+    shim = build_shim()
+    if shim is None:
+        pytest.skip("reference unavailable")
+    so = os.path.join(os.path.dirname(shim), "libcrushhash.so")
+    if not os.path.exists(so):
+        import subprocess
+
+        from tests.crush_oracle import REFERENCE
+
+        inc = os.path.join(os.path.dirname(shim), "inc")
+        subprocess.run(
+            [
+                "gcc", "-O2", "-shared", "-fPIC", f"-I{inc}",
+                f"-I{os.path.join(REFERENCE, 'src')}",
+                os.path.join(REFERENCE, "src", "crush", "hash.c"),
+                "-o", so,
+            ],
+            check=True,
+        )
+    lib = ctypes.CDLL(so)
+    for name, argc in [("crush_hash32", 1), ("crush_hash32_2", 2),
+                       ("crush_hash32_3", 3), ("crush_hash32_4", 4),
+                       ("crush_hash32_5", 5)]:
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_uint32
+        fn.argtypes = [ctypes.c_int] + [ctypes.c_uint32] * argc
+    return lib
+
+
+def test_hash_matches_reference(ref_hash):
+    args = rng.integers(0, 2**32, size=(200, 5), dtype=np.uint64)
+    fns = [ch.crush_hash32, ch.crush_hash32_2, ch.crush_hash32_3,
+           ch.crush_hash32_4, ch.crush_hash32_5]
+    for row in args:
+        vals = [int(v) for v in row]
+        for n, fn in enumerate(fns, start=1):
+            ours = fn(*vals[:n])
+            ref = getattr(ref_hash, f"crush_hash32{'_' + str(n) if n > 1 else ''}")(
+                0, *vals[:n]
+            )
+            assert ours == ref, (n, vals[:n])
+
+
+def test_hash_vectorized_matches_scalar():
+    a = rng.integers(0, 2**32, size=500, dtype=np.uint64)
+    b = rng.integers(0, 2**32, size=500, dtype=np.uint64)
+    c = rng.integers(0, 2**32, size=500, dtype=np.uint64)
+    vec = ch.crush_hash32_3_np(a, b, c)
+    for i in range(0, 500, 37):
+        assert int(vec[i]) == ch.crush_hash32_3(int(a[i]), int(b[i]), int(c[i]))
+    vec2 = ch.crush_hash32_2_np(a, b)
+    for i in range(0, 500, 37):
+        assert int(vec2[i]) == ch.crush_hash32_2(int(a[i]), int(b[i]))
+
+
+def test_ln_tables_match_reference_header():
+    """Every reconstructed LUT entry must equal the reference table."""
+    import re
+
+    from tests.crush_oracle import REFERENCE, have_reference
+
+    if not have_reference():
+        pytest.skip("reference unavailable")
+    text = open(os.path.join(REFERENCE, "src", "crush", "crush_ln_table.h")).read()
+    rh_ref = [int(v, 16) for v in re.findall(
+        r"0x([0-9a-fA-F]+)ll", text.split("__RH_LH_tbl")[1].split("};")[0])]
+    ll_ref = [int(v, 16) for v in re.findall(
+        r"0x([0-9a-fA-F]+)ull", text.split("__LL_tbl")[1].split("};")[0])]
+    assert RH_LH_TBL.tolist() == rh_ref
+    assert LL_TBL.tolist() == ll_ref
+
+
+def test_crush_ln_range_and_monotone():
+    # 2^44*log2(x+1): 0 at x=0, 2^44 at x=1, monotone nondecreasing; the top
+    # end falls 2^28 short of 16*2^44 because the reference table caps its
+    # final log2(2.0) entry (see ln_tables.py)
+    values = [crush_ln(x) for x in range(0, 0x10000, 97)] + [crush_ln(0xFFFF)]
+    assert values[0] == crush_ln(0) == 0
+    assert crush_ln(1) == 1 << 44
+    assert all(b >= a for a, b in zip(values, values[1:]))
+    assert values[-1] == (16 << 44) - (1 << 28)

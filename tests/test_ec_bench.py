@@ -1,0 +1,82 @@
+"""ec_bench CLI: reference-compatible flags and output format."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+
+
+def load_ec_bench():
+    spec = importlib.util.spec_from_file_location(
+        "ec_bench", os.path.join(TOOLS, "ec_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def ec_bench():
+    return load_ec_bench()
+
+
+def run(ec_bench, capsys, argv):
+    rc = ec_bench.main(argv)
+    assert rc == 0
+    return capsys.readouterr().out.strip().splitlines()
+
+
+def check_format(line, expect_kib):
+    seconds, kib = line.split("\t")
+    assert float(seconds) >= 0
+    assert int(kib) == expect_kib
+
+
+def test_encode_output_format(ec_bench, capsys):
+    lines = run(ec_bench, capsys, [
+        "-p", "isa", "-P", "k=4", "-P", "m=2", "-s", "65536", "-i", "3",
+    ])
+    check_format(lines[-1], 3 * 64)
+
+
+def test_decode_random(ec_bench, capsys):
+    lines = run(ec_bench, capsys, [
+        "-p", "jerasure", "-P", "k=4", "-P", "m=2", "-s", "16384", "-i", "2",
+        "-w", "decode", "-e", "2",
+    ])
+    check_format(lines[-1], 2 * 16)
+
+
+def test_decode_erased_list(ec_bench, capsys):
+    lines = run(ec_bench, capsys, [
+        "-p", "jerasure", "-P", "k=4", "-P", "m=2", "-s", "16384",
+        "-w", "decode", "--erased", "0", "--erased", "5",
+    ])
+    # erased chunks displayed with parentheses, then the timing line
+    assert lines[0].startswith("chunks (0)")
+    check_format(lines[-1], 16)
+
+
+def test_decode_exhaustive_verifies(ec_bench, capsys):
+    lines = run(ec_bench, capsys, [
+        "-p", "isa", "-P", "k=4", "-P", "m=2", "-P", "technique=cauchy",
+        "-s", "8192", "-w", "decode", "-E", "exhaustive", "-e", "2",
+    ])
+    check_format(lines[-1], 8)
+
+
+def test_batch_mode(ec_bench, capsys):
+    lines = run(ec_bench, capsys, [
+        "-p", "tpu", "-P", "k=4", "-P", "m=2", "-s", "8192", "-i", "2",
+        "--batch", "8",
+    ])
+    check_format(lines[-1], 2 * 8 * 8)
+
+
+def test_bad_parameter_warns(ec_bench, capsys):
+    rc = ec_bench.main(["-P", "k4", "-P", "k=4", "-P", "m=2", "-s", "4096"])
+    assert rc == 0
+    assert "ignored" in capsys.readouterr().err
