@@ -108,7 +108,12 @@ int main(void) {
         }
       }
       have_choose_args = 1;
-    } else if (!strcmp(cmd, "run")) {
+    } else if (!strcmp(cmd, "run") || !strcmp(cmd, "benchrun")) {
+      /* benchrun prints only an xor checksum — for timing the pure mapping
+         loop without stdout overhead. Workspace is (re)initialized per x in
+         both modes, matching the reference CLI path (CrushWrapper::do_rule
+         allocas + inits per call, CrushWrapper.h:1574). */
+      int bench = cmd[0] == 'b';
       int ruleno, min_x, max_x, result_max, nweights;
       if (scanf("%d %d %d %d %d", &ruleno, &min_x, &max_x, &result_max,
                 &nweights) != 5)
@@ -124,15 +129,22 @@ int main(void) {
          working_size (mapper.c:907), so allocate 3*result_max ints extra */
       void *cwin = malloc(map->working_size + 3 * result_max * sizeof(int));
       int *result = malloc(sizeof(int) * result_max);
+      unsigned long long acc = 0;
       for (int x = min_x; x < max_x; x++) {
         crush_init_workspace(map, cwin);
         int len = crush_do_rule(map, ruleno, x, result, result_max, weights,
                                 nweights, cwin,
                                 have_choose_args ? choose_args : NULL);
-        printf("%d:", x);
-        for (int i = 0; i < len; i++) printf(" %d", result[i]);
-        printf("\n");
+        if (bench) {
+          for (int i = 0; i < len; i++)
+            acc ^= (unsigned long long)result[i] + x;
+        } else {
+          printf("%d:", x);
+          for (int i = 0; i < len; i++) printf(" %d", result[i]);
+          printf("\n");
+        }
       }
+      if (bench) printf("checksum %llu\n", acc);
       free(result);
       free(cwin);
       free(weights);

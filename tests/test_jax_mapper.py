@@ -1,0 +1,222 @@
+"""Vectorized JAX mapper vs the scalar oracle (itself proven against the C
+reference): identical OSD vectors for every x, across rule shapes, tunables,
+choose_args, reweighted devices, and both firstn and indep modes."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import builder as cb
+from ceph_tpu.crush import jax_mapper as jm
+from ceph_tpu.crush import mapper as cm
+from ceph_tpu.crush.types import (
+    CRUSH_ITEM_NONE,
+    BucketAlg,
+    ChooseArg,
+    CrushMap,
+    RuleOp,
+    RuleStep,
+    Tunables,
+)
+
+from tests.test_crush_mapper import build_two_level_map
+
+N_X = 512
+
+
+def compare(cmap, ruleno, weight, result_max, positions=0):
+    compiled = jm.compile_map(cmap, positions=positions)
+    got = np.asarray(
+        jm.map_rule(compiled, ruleno, np.arange(N_X), weight, result_max)
+    )
+    for x in range(N_X):
+        want = cm.do_rule(cmap, ruleno, x, weight, result_max, cm.Workspace())
+        row = [int(v) for v in got[x]]
+        firstn_like = CRUSH_ITEM_NONE not in want
+        if firstn_like:
+            row = [v for v in row if v != CRUSH_ITEM_NONE]
+        else:
+            row = row[: len(want)]
+        assert row == want, (x, row, want)
+
+
+def test_crush_ln_matches_scalar():
+    from ceph_tpu.crush.ln_tables import crush_ln as ln_scalar
+
+    xs = np.arange(0, 0x10000, dtype=np.int64)
+    got = np.asarray(jm.crush_ln(jm.jnp.asarray(xs)))
+    want = np.array([ln_scalar(int(v)) for v in range(0, 0x10000)])
+    assert np.array_equal(got, want)
+
+
+def test_hash_matches_scalar():
+    from ceph_tpu.crush.hash import crush_hash32_2, crush_hash32_3
+
+    rng = np.random.default_rng(1)
+    a, b, c = (rng.integers(0, 2**32, 300, dtype=np.uint64) for _ in range(3))
+    h3 = np.asarray(jm.hash32_3(jm.jnp.asarray(a), jm.jnp.asarray(b), jm.jnp.asarray(c)))
+    h2 = np.asarray(jm.hash32_2(jm.jnp.asarray(a), jm.jnp.asarray(b)))
+    for i in range(0, 300, 23):
+        assert int(h3[i]) == crush_hash32_3(int(a[i]), int(b[i]), int(c[i]))
+        assert int(h2[i]) == crush_hash32_2(int(a[i]), int(b[i]))
+
+
+def test_supports_gate():
+    cmap = build_two_level_map(BucketAlg.LIST, seed=1)
+    assert not jm.supports(cmap)
+    cmap = build_two_level_map(BucketAlg.STRAW2, tunables=Tunables.argonaut(), seed=1)
+    assert not jm.supports(cmap)
+    cmap = build_two_level_map(BucketAlg.STRAW2, seed=1)
+    assert jm.supports(cmap)
+    with pytest.raises(ValueError):
+        jm.compile_map(build_two_level_map(BucketAlg.TREE, seed=1))
+
+
+def test_chooseleaf_firstn():
+    cmap = build_two_level_map(BucketAlg.STRAW2, seed=41)
+    cb.make_simple_rule(cmap, 0, -1, 1, "firstn", 0)
+    compare(cmap, 0, [0x10000] * cmap.max_devices, 3)
+
+
+def test_chooseleaf_indep():
+    cmap = build_two_level_map(BucketAlg.STRAW2, seed=43)
+    cb.make_simple_rule(cmap, 0, -1, 1, "indep", 0)
+    compare(cmap, 0, [0x10000] * cmap.max_devices, 6)
+
+
+def test_reweighted_and_out():
+    cmap = build_two_level_map(BucketAlg.STRAW2, seed=47)
+    cb.make_simple_rule(cmap, 0, -1, 1, "firstn", 0)
+    weight = [0x10000] * cmap.max_devices
+    weight[2] = 0
+    weight[7] = 0x4000
+    weight[11] = 0xC000
+    compare(cmap, 0, weight, 3)
+
+
+def test_indep_with_out_domain():
+    cmap = build_two_level_map(BucketAlg.STRAW2, n_hosts=4, seed=53)
+    cb.make_simple_rule(cmap, 0, -1, 1, "indep", 0)
+    weight = [0x10000] * cmap.max_devices
+    for i in range(4):
+        weight[i] = 0
+    compare(cmap, 0, weight, 6)
+
+
+def test_choose_device_directly():
+    cmap = CrushMap(tunables=Tunables.jewel())
+    rng = np.random.default_rng(59)
+    weights = [int(rng.integers(1, 10 * 0x10000)) for _ in range(24)]
+    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 1, list(range(24)), weights)
+    cb.make_rule(cmap, 0, [
+        RuleStep(RuleOp.TAKE, -1),
+        RuleStep(RuleOp.CHOOSE_FIRSTN, 0, 0),
+        RuleStep(RuleOp.EMIT),
+    ])
+    compare(cmap, 0, [0x10000] * 24, 4)
+
+
+def test_three_level_chained_choose():
+    cmap = CrushMap(tunables=Tunables.jewel())
+    local = np.random.default_rng(61)
+    osd = 0
+    rack_ids, rack_weights = [], []
+    bid = -2
+    for r in range(4):
+        host_ids, host_weights = [], []
+        for h in range(3):
+            items = [osd, osd + 1]
+            osd += 2
+            ws = [int(local.integers(1, 6 * 0x10000)) for _ in range(2)]
+            b = cb.make_bucket(cmap, bid, BucketAlg.STRAW2, 1, items, ws)
+            bid -= 1
+            host_ids.append(b.id)
+            host_weights.append(b.weight)
+        rb = cb.make_bucket(cmap, bid, BucketAlg.STRAW2, 2, host_ids, host_weights)
+        bid -= 1
+        rack_ids.append(rb.id)
+        rack_weights.append(rb.weight)
+    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, rack_ids, rack_weights)
+    cb.make_rule(cmap, 0, [
+        RuleStep(RuleOp.TAKE, -1),
+        RuleStep(RuleOp.CHOOSE_FIRSTN, 2, 2),
+        RuleStep(RuleOp.CHOOSELEAF_FIRSTN, 2, 1),
+        RuleStep(RuleOp.EMIT),
+    ])
+    compare(cmap, 0, [0x10000] * cmap.max_devices, 4)
+
+
+def test_firefly_tunables():
+    cmap = build_two_level_map(
+        BucketAlg.STRAW2, tunables=Tunables.firefly(), seed=67
+    )
+    cb.make_simple_rule(cmap, 0, -1, 1, "firstn", 0)
+    compare(cmap, 0, [0x10000] * cmap.max_devices, 3)
+
+
+def test_choose_args():
+    cmap = build_two_level_map(BucketAlg.STRAW2, n_hosts=6, seed=71)
+    cb.make_simple_rule(cmap, 0, -1, 1, "firstn", 0)
+    local = np.random.default_rng(73)
+    root = cmap.buckets[-1]
+    cmap.choose_args[-1] = ChooseArg(
+        ids=[i + 100 for i in range(root.size)],
+        weight_set=[
+            [int(local.integers(1, 8 * 0x10000)) for _ in range(root.size)]
+            for _ in range(2)
+        ],
+    )
+    for h in range(6):
+        b = cmap.buckets[-(h + 2)]
+        cmap.choose_args[b.id] = ChooseArg(
+            weight_set=[[int(local.integers(1, 8 * 0x10000)) for _ in range(b.size)]]
+        )
+    # positions auto-derived from the longest weight_set (compile_map default)
+    compare(cmap, 0, [0x10000] * cmap.max_devices, 3)
+    assert jm.compile_map(cmap).n_positions == 2
+
+
+def test_chained_choose_under_result_max_pressure():
+    # rack0 can under-place (one host fully out), so the reference gives
+    # later take entries a larger budget; compact-then-truncate must
+    # reproduce the same emitted prefix
+    cmap = CrushMap(tunables=Tunables.jewel())
+    local = np.random.default_rng(83)
+    osd = 0
+    rack_ids, rack_weights = [], []
+    bid = -2
+    for r in range(3):
+        host_ids, host_weights = [], []
+        for h in range(2):
+            items = [osd, osd + 1]
+            osd += 2
+            ws = [int(local.integers(1, 6 * 0x10000)) for _ in range(2)]
+            b = cb.make_bucket(cmap, bid, BucketAlg.STRAW2, 1, items, ws)
+            bid -= 1
+            host_ids.append(b.id)
+            host_weights.append(b.weight)
+        rb = cb.make_bucket(cmap, bid, BucketAlg.STRAW2, 2, host_ids, host_weights)
+        bid -= 1
+        rack_ids.append(rb.id)
+        rack_weights.append(rb.weight)
+    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, rack_ids, rack_weights)
+    cb.make_rule(cmap, 0, [
+        RuleStep(RuleOp.TAKE, -1),
+        RuleStep(RuleOp.CHOOSE_FIRSTN, 2, 2),
+        RuleStep(RuleOp.CHOOSELEAF_FIRSTN, 2, 1),
+        RuleStep(RuleOp.EMIT),
+    ])
+    weight = [0x10000] * cmap.max_devices
+    weight[0] = weight[1] = 0  # host -2 entirely out
+    compare(cmap, 0, weight, 3)
+
+
+def test_set_tries_steps():
+    cmap = build_two_level_map(BucketAlg.STRAW2, seed=79)
+    cb.make_rule(cmap, 0, [
+        RuleStep(RuleOp.SET_CHOOSELEAF_TRIES, 5),
+        RuleStep(RuleOp.SET_CHOOSE_TRIES, 100),
+        RuleStep(RuleOp.TAKE, -1),
+        RuleStep(RuleOp.CHOOSELEAF_FIRSTN, 0, 1),
+        RuleStep(RuleOp.EMIT),
+    ])
+    compare(cmap, 0, [0x10000] * cmap.max_devices, 3)

@@ -1,0 +1,126 @@
+"""crush_bench — the BASELINE CRUSH benchmark, reproducibly.
+
+Measures BASELINE.md config 5 ("crushtool --test: straw2 mapping of 1M PGs
+over a 10k-OSD map") on both implementations:
+
+  * the reference C mapper, single thread, via the test oracle shim's
+    `benchrun` command (only when /root/reference and gcc are available);
+  * this framework's vectorized JAX mapper on the default device.
+
+Prints one JSON line per measurement, plus the ratio. The JAX output is
+validated bit-exact against the C oracle on a prefix before timing.
+
+    python tools/crush_bench.py [--pgs 1000000] [--osds 10000] [--replicas 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_map(n_osds: int, osds_per_host: int = 50):
+    from ceph_tpu.crush import builder as cb
+    from ceph_tpu.crush.types import BucketAlg, CrushMap, Tunables
+
+    cmap = CrushMap(tunables=Tunables.jewel())
+    host_ids, host_w = [], []
+    osd = 0
+    n_hosts = n_osds // osds_per_host
+    for h in range(n_hosts):
+        items = list(range(osd, osd + osds_per_host))
+        osd += osds_per_host
+        b = cb.make_bucket(
+            cmap, -(h + 2), BucketAlg.STRAW2, 1, items, [0x10000] * osds_per_host
+        )
+        host_ids.append(b.id)
+        host_w.append(b.weight)
+    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, host_ids, host_w)
+    cb.make_simple_rule(cmap, 0, -1, 1, "firstn", 0)
+    return cmap
+
+
+def bench_c(cmap, n_pgs: int, replicas: int, weight) -> float | None:
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests"))
+    try:
+        from crush_oracle import build_shim, map_to_protocol
+    except ImportError:
+        return None
+    shim = build_shim()
+    if shim is None:
+        return None
+    wtxt = " ".join(str(w) for w in weight)
+    text = (
+        map_to_protocol(cmap)
+        + f"\nbenchrun 0 0 {n_pgs} {replicas} {len(weight)} {wtxt}\n"
+    )
+    t0 = time.perf_counter()
+    subprocess.run([shim], input=text, capture_output=True, text=True, check=True)
+    return time.perf_counter() - t0
+
+
+def validate(cmap, compiled, jax_out, replicas, weight, n_check: int):
+    from crush_oracle import build_shim, oracle_do_rule
+
+    if build_shim() is None:
+        return None
+    want = oracle_do_rule(cmap, 0, range(n_check), weight, replicas)
+    for i in range(n_check):
+        if [int(v) for v in jax_out[i]] != want[i]:
+            raise SystemExit(f"MISMATCH vs reference C at x={i}")
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pgs", type=int, default=1_000_000)
+    ap.add_argument("--osds", type=int, default=10_000)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--skip-c", action="store_true")
+    args = ap.parse_args(argv)
+
+    from ceph_tpu.crush import jax_mapper as jm
+
+    cmap = build_map(args.osds)
+    weight = [0x10000] * args.osds
+    compiled = jm.compile_map(cmap)
+    xs = np.arange(args.pgs)
+
+    jm.map_rule(compiled, 0, xs[: jm.DEFAULT_CHUNK], weight, args.replicas)  # compile
+    t0 = time.perf_counter()
+    out = jm.map_rule(compiled, 0, xs, weight, args.replicas)
+    jax_s = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "crush_straw2_mappings_per_s_tpu",
+        "value": round(args.pgs / jax_s, 1),
+        "unit": "mappings/s",
+        "pgs": args.pgs, "osds": args.osds,
+    }))
+
+    c_s = None if args.skip_c else bench_c(cmap, args.pgs, args.replicas, weight)
+    if c_s is not None:
+        print(json.dumps({
+            "metric": "crush_straw2_mappings_per_s_reference_c",
+            "value": round(args.pgs / c_s, 1),
+            "unit": "mappings/s",
+        }))
+        print(json.dumps({"metric": "crush_vs_reference_c",
+                          "value": round(c_s / jax_s, 3), "unit": "x"}))
+        checked = validate(cmap, compiled, out, args.replicas, weight, 10000)
+        if checked:
+            print(json.dumps({"metric": "bit_exact_vs_c_prefix",
+                              "value": 10000, "unit": "mappings"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
