@@ -31,13 +31,18 @@ against the reference C):
   * indep: breadth-first positional retries, r' = r + numrep*ftotal,
     UNDEF -> NONE finalization (mapper.c:655).
 
-Scope (checked at compile time; use the scalar oracle in mapper.py elsewhere):
-straw2 buckets only, rjenkins1 hash, and choose_local_tries ==
-choose_local_fallback_tries == 0 — i.e. every tunable profile from bobtail on.
-Known divergences (oracle-tested maps never hit them): malformed maps whose
-buckets reference out-of-range items, and multi-step rules where an earlier
-stage emits NONE into the working vector (the reference compacts those
-entries mid-rule; this path keeps them as NONE columns).
+Scope (checked at compile/map time; use the scalar oracle in mapper.py
+elsewhere): straw2 buckets only, rjenkins1 hash, and choose_local_tries ==
+choose_local_fallback_tries == 0 — i.e. every tunable profile from bobtail
+on. Rules carrying SET_CHOOSE_LOCAL_*_TRIES steps with nonzero args raise
+ValueError rather than silently diverging. Per-EMIT blocks are assembled
+exactly as the reference's EMIT loop (firstn appends placed entries only;
+indep appends positional NONE holes), so mixed-mode multi-EMIT rules are
+exact. Known divergences (oracle-tested maps never hit them): malformed maps
+whose buckets reference out-of-range items, and chained choose steps where an
+earlier firstn stage leaves per-lane NONE in the working vector (the
+reference's working vector only ever holds placed entries mid-rule; this path
+keeps NONE lanes in place between stages).
 
 Everything is int32/int64/uint64 exact — no float anywhere.
 """
@@ -154,9 +159,12 @@ _LN16_NP = _crush_ln_np(np.arange(0x10000)) - (1 << 48)
 @functools.lru_cache(maxsize=1)
 def _ln16() -> jnp.ndarray:
     """Device copy of LN16, created lazily so the int64 dtype survives (the
-    table must not be built before _require_x64 has run)."""
+    table must not be built before _require_x64 has run). The first call can
+    happen inside a jit trace; ensure_compile_time_eval keeps the cached value
+    a concrete array rather than a leaked tracer."""
     _require_x64()
-    return jnp.asarray(_LN16_NP, dtype=jnp.int64)
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(_LN16_NP, dtype=jnp.int64)
 
 
 def crush_ln(xin):
@@ -206,10 +214,16 @@ class CompiledMap:
 
 
 def supports(cmap: CrushMap) -> bool:
-    """True if the fast path can evaluate this map exactly."""
+    """True if the fast path can evaluate this map exactly (every rule)."""
     t = cmap.tunables
     if t.choose_local_tries or t.choose_local_fallback_tries:
         return False
+    for rule in cmap.rules.values():
+        for step in rule.steps:
+            if step.op in (RuleOp.SET_CHOOSE_LOCAL_TRIES,
+                           RuleOp.SET_CHOOSE_LOCAL_FALLBACK_TRIES) \
+                    and step.arg1 > 0:
+                return False
     return all(b.alg == BucketAlg.STRAW2 for b in cmap.buckets.values())
 
 
@@ -810,11 +824,24 @@ def _choose_indep_b(
 # -- rule driver -------------------------------------------------------------
 
 
-def _compact_firstn(cols: np.ndarray) -> np.ndarray:
-    """Stable-move non-NONE entries left per row (firstn emit semantics)."""
-    is_none = cols == CRUSH_ITEM_NONE
-    order = np.argsort(is_none, axis=1, kind="stable")
-    return np.take_along_axis(cols, order, axis=1)
+def _assemble_blocks(blocks, n: int, result_max: int) -> np.ndarray:
+    """Append emitted blocks per row exactly as the reference's EMIT does:
+    firstn blocks contribute only placed entries (each advances result_len),
+    indep blocks contribute every positional slot including NONE holes, and
+    everything past result_max is dropped (mapper.c CRUSH_RULE_EMIT loop)."""
+    out = np.full((n, result_max), CRUSH_ITEM_NONE, dtype=np.int32)
+    pos = np.zeros(n, dtype=np.int64)
+    rows = np.arange(n)
+    for firstn, cols in blocks:
+        for j in range(cols.shape[1]):
+            col = cols[:, j]
+            if firstn:
+                write = (col != CRUSH_ITEM_NONE) & (pos < result_max)
+            else:
+                write = pos < result_max
+            out[rows[write], pos[write]] = col[write]
+            pos[write] += 1
+    return out
 
 
 def _map_rule_chunk(compiled, rule, tunables, xs, weight_vec, result_max):
@@ -826,12 +853,23 @@ def _map_rule_chunk(compiled, rule, tunables, xs, weight_vec, result_max):
 
     n = xs.shape[0]
     w_cols: list = []  # (static_bid | None, column array | None)
-    results: list[jnp.ndarray] = []
+    blocks: list[tuple[bool, list[jnp.ndarray]]] = []  # per-EMIT (firstn, cols)
     last_mode_firstn = True
 
     for step in rule.steps:
         op = step.op
-        if op == RuleOp.TAKE:
+        if op in (RuleOp.SET_CHOOSE_LOCAL_TRIES,
+                  RuleOp.SET_CHOOSE_LOCAL_FALLBACK_TRIES):
+            # local retries are legacy-tunable semantics the lockstep kernels
+            # do not model; a nonzero arg would silently diverge from the
+            # reference (ADVICE r1) — force callers to the scalar oracle
+            if step.arg1 > 0:
+                raise ValueError(
+                    f"rule step op {int(op)} (set_choose_local_*_tries) with "
+                    "nonzero arg is not supported by the vectorized path; "
+                    "use the scalar mapper"
+                )
+        elif op == RuleOp.TAKE:
             item = step.arg1
             valid = (
                 0 <= item < compiled.max_devices
@@ -906,20 +944,22 @@ def _map_rule_chunk(compiled, rule, tunables, xs, weight_vec, result_max):
                     budget -= slots
             w_cols = new_cols
         elif op == RuleOp.EMIT:
+            cols = []
             for bid, col in w_cols:
                 if bid is not None:
                     col = jnp.full((n,), bid, dtype=jnp.int32)
-                results.append(col)
+                cols.append(col)
+            if cols:
+                blocks.append((last_mode_firstn, cols))
             w_cols = []
 
-    if not results:
-        return np.zeros((n, 0), dtype=np.int32), last_mode_firstn
-    # keep ALL firstn columns here: truncation to result_max must happen
-    # after per-row compaction (map_rule), or placements from later take
-    # entries would be lost when earlier entries under-place
-    keep = results if last_mode_firstn else results[:result_max]
-    stacked = np.asarray(jnp.stack(keep, axis=1))
-    return stacked, last_mode_firstn
+    # one (mode, (N, w) array) per EMIT: the reference appends each emitted
+    # working vector to the output independently (mapper.c EMIT), so firstn
+    # compaction must not cross an indep block's positional NONE holes
+    return [
+        (firstn, np.asarray(jnp.stack(cols, axis=1)))
+        for firstn, cols in blocks
+    ]
 
 
 def map_rule(
@@ -945,19 +985,20 @@ def map_rule(
     weight_vec = jnp.asarray(np.asarray(weight, dtype=np.int64))
 
     pieces = []
-    firstn_mode = True
     for lo in range(0, len(xs), chunk):
         part = xs[lo : lo + chunk]
         pad = 0
         if len(xs) > chunk and len(part) < chunk:
             pad = chunk - len(part)
             part = np.concatenate([part, np.zeros(pad, dtype=np.int32)])
-        res, firstn_mode = _map_rule_chunk(
+        blocks = _map_rule_chunk(
             compiled, rule, cmap.tunables, jnp.asarray(part), weight_vec,
             result_max,
         )
+        res = _assemble_blocks(blocks, len(part), result_max)
         pieces.append(res[: len(part) - pad] if pad else res)
-    out = np.concatenate(pieces, axis=0) if pieces else np.zeros((0, 0), np.int32)
-    if firstn_mode and out.size:
-        out = _compact_firstn(out)[:, :result_max]
-    return out
+    return (
+        np.concatenate(pieces, axis=0)
+        if pieces
+        else np.zeros((0, result_max), np.int32)
+    )

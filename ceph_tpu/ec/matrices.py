@@ -141,26 +141,57 @@ def _bitmatrix_ones(c: int) -> int:
     return int(mul_bitmatrix(c).sum())
 
 
-def cauchy_good(k: int, m: int) -> np.ndarray:
-    """jerasure cauchy_good_general_coding_matrix: cauchy_orig improved to reduce
-    the total bit-matrix density (fewer XORs in a schedule): divide row i by its
-    first element (making column 0 all ones), then for each later column pick the
-    divisor among its elements that minimizes the column's bit-matrix ones.
+def cauchy_improve(mat: np.ndarray) -> np.ndarray:
+    """jerasure cauchy_improve_coding_matrix (cauchy.c), faithfully:
+
+    1. scale each COLUMN j by inv(mat[0][j]) so the first parity row becomes
+       all ones;
+    2. for each later ROW i >= 1, among its non-one elements pick the divisor
+       whose row-wide division minimizes the row's total bit-matrix ones, and
+       divide the whole row by it (only if it strictly improves).
+
+    This is the transpose-orientation of what round 1 shipped (rows then
+    columns), which produced matrices that were MDS but not bit-compatible
+    with jerasure's technique=cauchy_good shards (ADVICE r1, medium).
     """
-    mat = cauchy_orig(k, m)
-    for i in range(m):
-        if mat[i, 0] not in (0, 1):
-            mat[i, :] = gf_div(mat[i, :], mat[i, 0])
-    for j in range(1, k):
-        col = mat[:, j]
-        best_div, best_cost = np.uint8(1), sum(_bitmatrix_ones(int(c)) for c in col)
-        for cand in {int(c) for c in col if c not in (0, 1)}:
-            cost = sum(_bitmatrix_ones(int(c)) for c in gf_div(col, np.uint8(cand)))
+    mat = mat.copy()
+    m, k = mat.shape
+    for j in range(k):
+        if mat[0, j] != 1:
+            mat[:, j] = gf_div(mat[:, j], mat[0, j])
+    for i in range(1, m):
+        row = mat[i, :]
+        best_cost = sum(_bitmatrix_ones(int(c)) for c in row)
+        best_div = None
+        for j in range(k):
+            cand = int(row[j])
+            if cand == 1:
+                continue
+            cost = sum(
+                _bitmatrix_ones(int(c)) for c in gf_div(row, np.uint8(cand))
+            )
             if cost < best_cost:
                 best_cost, best_div = cost, np.uint8(cand)
-        if best_div != 1:
-            mat[:, j] = gf_div(col, best_div)
+        if best_div is not None:
+            mat[i, :] = gf_div(row, best_div)
     return mat
+
+
+def cauchy_good(k: int, m: int) -> np.ndarray:
+    """jerasure cauchy_good_general_coding_matrix: cauchy_orig improved via
+    cauchy_improve_coding_matrix, with the k=2,m=2,w=8 case special-cased to
+    the exhaustive optimum (cauchy.c special-cases this config because the
+    greedy improvement cannot reach it).
+
+    The special case is computed here rather than hardcoded: every normalized
+    2x2 Cauchy matrix is column/row-scalable to [[1,1],[1,c]] with c the
+    Cauchy cross-ratio (any c not in {0,1} is reachable), so the exhaustive
+    optimum is [[1,1],[1,argmin_c n_ones(c)]].
+    """
+    if k == 2 and m == 2:
+        best = min(range(2, 256), key=_bitmatrix_ones)
+        return np.array([[1, 1], [1, best]], dtype=np.uint8)
+    return cauchy_improve(cauchy_orig(k, m))
 
 
 TECHNIQUES = {

@@ -220,3 +220,73 @@ def test_set_tries_steps():
         RuleStep(RuleOp.EMIT),
     ])
     compare(cmap, 0, [0x10000] * cmap.max_devices, 3)
+
+
+def test_local_tries_steps_rejected():
+    """Rules carrying SET_CHOOSE_LOCAL_*_TRIES with nonzero args must raise
+    rather than silently diverge from the reference (ADVICE r1, medium)."""
+    for op in (RuleOp.SET_CHOOSE_LOCAL_TRIES,
+               RuleOp.SET_CHOOSE_LOCAL_FALLBACK_TRIES):
+        cmap = build_two_level_map(BucketAlg.STRAW2, seed=83)
+        cb.make_rule(cmap, 0, [
+            RuleStep(op, 2),
+            RuleStep(RuleOp.TAKE, -1),
+            RuleStep(RuleOp.CHOOSELEAF_FIRSTN, 0, 1),
+            RuleStep(RuleOp.EMIT),
+        ])
+        # the supports() gate sees the rule, so gated callers fall back to
+        # the scalar oracle instead of crashing at map time
+        assert not jm.supports(cmap)
+        with pytest.raises(ValueError):
+            jm.compile_map(cmap)
+        # zero-arg steps are inert in the reference too: must still map
+        cmap.rules[0].steps[0] = RuleStep(op, 0)
+        assert jm.supports(cmap)
+        compiled = jm.compile_map(cmap)
+        jm.map_rule(compiled, 0, np.arange(8), [0x10000] * cmap.max_devices, 3)
+
+
+def test_mixed_mode_multi_emit():
+    """indep block with NONE holes followed by a firstn block: holes must stay
+    positional, firstn entries append after them (ADVICE r1, low)."""
+    cmap = build_two_level_map(BucketAlg.STRAW2, n_hosts=4, seed=89)
+    cb.make_rule(cmap, 0, [
+        RuleStep(RuleOp.TAKE, -1),
+        RuleStep(RuleOp.CHOOSELEAF_INDEP, 4, 1),
+        RuleStep(RuleOp.EMIT),
+        RuleStep(RuleOp.TAKE, -1),
+        RuleStep(RuleOp.CHOOSELEAF_FIRSTN, 2, 1),
+        RuleStep(RuleOp.EMIT),
+    ])
+    weight = [0x10000] * cmap.max_devices
+    # knock a whole host out: indep wants 4 distinct hosts but only 3 are
+    # live, so every x gets a NONE hole in the indep block
+    for i in range(4):
+        weight[i] = 0
+    result_max = 6
+    compiled = jm.compile_map(cmap)
+    got = np.asarray(
+        jm.map_rule(compiled, 0, np.arange(N_X), weight, result_max)
+    )
+    saw_hole = False
+    for x in range(N_X):
+        want = cm.do_rule(cmap, 0, x, weight, result_max, cm.Workspace())
+        if CRUSH_ITEM_NONE in want[:4]:
+            saw_hole = True
+        row = [int(v) for v in got[x]][: len(want)]
+        assert row == want, (x, row, want)
+    assert saw_hole, "test map never produced an indep hole; weaken weights"
+
+
+def test_firstn_multi_emit():
+    """Two firstn blocks across EMITs each compact independently."""
+    cmap = build_two_level_map(BucketAlg.STRAW2, seed=97)
+    cb.make_rule(cmap, 0, [
+        RuleStep(RuleOp.TAKE, -1),
+        RuleStep(RuleOp.CHOOSELEAF_FIRSTN, 2, 1),
+        RuleStep(RuleOp.EMIT),
+        RuleStep(RuleOp.TAKE, -1),
+        RuleStep(RuleOp.CHOOSELEAF_FIRSTN, 2, 1),
+        RuleStep(RuleOp.EMIT),
+    ])
+    compare(cmap, 0, [0x10000] * cmap.max_devices, 4)

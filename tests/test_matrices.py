@@ -88,3 +88,17 @@ def test_decode_matrix_recovers():
         dm = matrices.decode_matrix(gen, k, present, list(lost))
         rebuilt = gf.gf_matmul(dm, chunks[present[:k], :])
         assert np.array_equal(rebuilt, chunks[list(lost), :])
+
+
+def test_cauchy_good_matches_jerasure():
+    """Pin the jerasure cauchy_improve_coding_matrix orientation: columns are
+    scaled so parity row 0 is all ones, then each later row is divided by the
+    element minimizing its total bit-matrix ones (cauchy.c). The k=4,m=2
+    expectation was computed from jerasure's own algorithm (ADVICE r1)."""
+    got = matrices.cauchy_good(4, 2)
+    assert got.tolist() == [[1, 1, 1, 1], [143, 101, 1, 217]]
+    # row 0 is always all ones after the column scaling
+    for k, m in [(3, 2), (6, 3), (8, 4), (10, 4)]:
+        assert np.all(matrices.cauchy_good(k, m)[0] == 1)
+    # the 2,2 special case: [[1,1],[1,c]] with c the min-ones multiplier
+    assert matrices.cauchy_good(2, 2).tolist() == [[1, 1], [1, 2]]
