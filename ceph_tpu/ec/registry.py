@@ -64,10 +64,12 @@ registry = ErasureCodePluginRegistry()
 
 def _register_builtin() -> None:
     from ceph_tpu.ec.rs import ErasureCodeRs
+    from ceph_tpu.ec.shec import ErasureCodeShec
 
     registry.add("tpu", lambda: ErasureCodeRs("tpu"))
     registry.add("jerasure", lambda: ErasureCodeRs("jerasure"))
     registry.add("isa", lambda: ErasureCodeRs("isa"))
+    registry.add("shec", ErasureCodeShec)
 
 
 _register_builtin()

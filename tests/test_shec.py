@@ -1,0 +1,196 @@
+"""SHEC plugin: structure, exhaustive <=c recoverability, cheapest repair
+sets, and byte-API round trips (reference: ErasureCodeShec.cc + the
+TestErasureCodeShec{_all,_arguments} suites)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.registry import factory
+from ceph_tpu.ec.shec import (
+    MULTIPLE,
+    SINGLE,
+    calc_recovery_efficiency1,
+    shec_coding_matrix,
+)
+
+
+def make(k, m, c, technique="multiple"):
+    return factory(
+        "shec",
+        {"k": str(k), "m": str(m), "c": str(c), "technique": technique},
+    )
+
+
+def stripe(ec, seed=0, chunk=None):
+    rng = np.random.default_rng(seed)
+    chunk = chunk or ec.get_chunk_size(1000)
+    data = rng.integers(0, 256, size=(1, ec.k, chunk), dtype=np.uint8)
+    parity = np.asarray(ec.encode_array(data))
+    return np.concatenate([data, parity], axis=1)
+
+
+# -- matrix structure --------------------------------------------------------
+
+
+def test_matrix_shingle_structure():
+    """Each parity row keeps a contiguous (mod k) window of Vandermonde
+    entries; window sizes follow the (rr+c)*k/m - rr*k/m formula."""
+    for k, m, c, tech in [
+        (4, 3, 2, MULTIPLE), (4, 3, 2, SINGLE),
+        (6, 4, 3, MULTIPLE), (8, 4, 2, SINGLE), (10, 6, 3, MULTIPLE),
+    ]:
+        mat = shec_coding_matrix(k, m, c, tech)
+        assert mat.shape == (m, k)
+        for row in mat:
+            nz = np.nonzero(row)[0]
+            assert len(nz) > 0
+            # contiguity mod k: the zero run is contiguous too
+            if 0 < len(nz) < k:
+                gaps = np.diff(sorted(nz))
+                assert np.sum(gaps > 1) <= 1  # at most one wrap split
+
+
+def test_single_vs_multiple_differ():
+    assert not np.array_equal(
+        shec_coding_matrix(8, 4, 2, SINGLE),
+        shec_coding_matrix(8, 4, 2, MULTIPLE),
+    )
+
+
+def test_recovery_efficiency_invalid_splits():
+    assert calc_recovery_efficiency1(4, 1, 2, 2, 1) == -1.0  # m1 < c1
+    assert calc_recovery_efficiency1(4, 0, 3, 1, 1) == -1.0  # m1==0, c1!=0
+
+
+# -- recoverability ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("k,m,c,tech", [
+    (4, 3, 2, "multiple"),
+    (4, 3, 2, "single"),
+    (6, 4, 3, "multiple"),   # BASELINE config 3
+])
+def test_all_c_erasures_recoverable(k, m, c, tech):
+    """SHEC(k,m,c) guarantees recovery of ANY <= c erasures — exhaustively."""
+    ec = make(k, m, c, tech)
+    full = stripe(ec, seed=k * 100 + m)
+    n = k + m
+    for r in range(1, c + 1):
+        for lost in itertools.combinations(range(n), r):
+            present = [i for i in range(n) if i not in lost]
+            out = ec.decode_array(
+                present, list(lost), full[:, present, :]
+            )
+            assert np.array_equal(np.asarray(out), full[:, list(lost), :]), (
+                k, m, c, tech, lost,
+            )
+
+
+def test_minimum_to_decode_sufficient_and_small():
+    """minimum_to_decode returns a set that (a) suffices to rebuild and
+    (b) for single-chunk repair reads fewer than k chunks."""
+    ec = make(6, 4, 3)
+    full = stripe(ec, seed=7)
+    n = ec.k + ec.m
+    sizes = []
+    for lost in range(n):
+        available = set(range(n)) - {lost}
+        minimum = ec.minimum_to_decode({lost}, available)
+        chosen = sorted(minimum)
+        sizes.append(len(chosen))
+        assert lost not in chosen
+        out = ec.decode_array(chosen, [lost], full[:, chosen, :])
+        assert np.array_equal(np.asarray(out)[:, 0], full[:, lost])
+    # recovery efficiency: average single-shard repair reads < k chunks
+    assert sum(sizes) / len(sizes) < ec.k, sizes
+
+
+def test_minimum_to_decode_subchunk_shape():
+    ec = make(4, 3, 2)
+    got = ec.minimum_to_decode({0}, {1, 2, 3, 4, 5, 6})
+    assert all(v == [(0, 1)] for v in got.values())
+
+
+# -- byte API ----------------------------------------------------------------
+
+
+def test_byte_roundtrip_degraded():
+    ec = make(6, 4, 3)
+    rng = np.random.default_rng(11)
+    obj = rng.integers(0, 256, size=9000, dtype=np.uint8).tobytes()
+    chunks = ec.encode(range(10), obj)
+    assert len(chunks) == 10
+    # lose three chunks, ask for everything lost
+    surv = {i: v for i, v in chunks.items() if i not in (0, 5, 7)}
+    out = ec.decode({0, 5, 7}, surv)
+    for i in (0, 5, 7):
+        assert out[i] == chunks[i]
+    # decode_concat rebuilds the object prefix
+    got = ec.decode_concat(surv)
+    assert got[: len(obj)] == obj
+
+
+def test_decode_from_fewer_than_k_chunks():
+    """The locally-repairable case: a single lost chunk is rebuilt from the
+    minimum set, which is smaller than k."""
+    ec = make(6, 4, 3)
+    obj = bytes(range(256)) * 30
+    chunks = ec.encode(range(10), obj)
+    minimum = ec.minimum_to_decode({2}, set(range(10)) - {2})
+    assert len(minimum) < ec.k + 1  # strictly fewer than k+1 reads
+    surv = {i: chunks[i] for i in minimum}
+    out = ec.decode({2}, surv)
+    assert out[2] == chunks[2]
+
+
+def test_unrecoverable_raises():
+    ec = make(4, 3, 2, "single")
+    obj = bytes(1024)
+    chunks = ec.encode(range(7), obj)
+    # single technique: all parities shingle one bank; losing a data chunk
+    # plus every parity covering it is unrecoverable
+    mat = ec._matrix
+    covering = {4 + i for i in range(3) if mat[i, 0]}
+    lost = {0} | covering
+    surv = {i: chunks[i] for i in range(7) if i not in lost}
+    with pytest.raises(ErasureCodeError):
+        ec.decode({0}, surv)
+
+
+# -- parameter validation ----------------------------------------------------
+
+
+def test_parse_validation():
+    with pytest.raises(ErasureCodeError):
+        make(3, 4, 2)        # m > k
+    with pytest.raises(ErasureCodeError):
+        make(4, 2, 3)        # c > m
+    with pytest.raises(ErasureCodeError):
+        make(13, 4, 2)       # k > 12
+    with pytest.raises(ErasureCodeError):
+        make(12, 9, 2)       # k+m > 20
+    with pytest.raises(ErasureCodeError):
+        factory("shec", {"k": "4", "m": "3"})  # partial kmc
+    with pytest.raises(ErasureCodeError):
+        factory("shec", {"k": "4", "m": "3", "c": "2", "technique": "bogus"})
+    # all-defaulted profile works: (4, 3, 2)
+    ec = factory("shec", {})
+    assert (ec.k, ec.m, ec.c) == (4, 3, 2)
+
+
+def test_chunk_size_alignment():
+    ec = make(4, 3, 2)
+    # k*w*4 = 128-byte aligned object, split k ways
+    assert ec.get_chunk_size(1) == 32
+    assert ec.get_chunk_size(129) == 64
+
+
+def test_mapping_rejected_and_empty_decode_eio():
+    with pytest.raises(ErasureCodeError):
+        factory("shec", {"k": "4", "m": "3", "c": "2", "mapping": "DD_DD__"})
+    ec = make(4, 3, 2)
+    with pytest.raises(ErasureCodeError):
+        ec.decode({0}, {})
