@@ -256,6 +256,31 @@ class ErasureCode:
         assert all(len(v) == blocksize for v in out.values())
         return out
 
+    def _decode_bytes_ungated(
+        self, want_to_read, chunks: Mapping[int, bytes], decode_physical
+    ) -> dict[int, bytes]:
+        """Byte-level decode WITHOUT the >= k survivor gate, for codecs that
+        can rebuild from fewer than k chunks (shec, lrc). `decode_physical`
+        is (present, targets, survivors) -> (batch, len(targets), chunk);
+        chunk ids are physical positions and recoverability errors are its
+        job to raise."""
+        want = set(want_to_read)
+        have = set(chunks)
+        if want <= have:
+            return {i: bytes(chunks[i]) for i in want}
+        if not have:
+            raise ErasureCodeError(errno.EIO, "no chunks to decode from")
+        present = sorted(have)
+        missing = sorted(want - have)
+        survivors = np.stack(
+            [np.frombuffer(chunks[i], dtype=np.uint8) for i in present]
+        )[None, :, :]
+        rebuilt = np.asarray(decode_physical(present, missing, survivors))
+        out = {i: bytes(chunks[i]) for i in want & have}
+        for pos, i in enumerate(missing):
+            out[i] = rebuilt[0, pos].tobytes()
+        return out
+
     def decode_concat(self, chunks: Mapping[int, bytes]) -> bytes:
         """Concatenate the data chunks in logical order (ErasureCode.cc:344+)."""
         want = {self.chunk_index(i) for i in range(self.k)}

@@ -1,0 +1,462 @@
+"""LRC — Locally Repairable Code as layer composition, TPU backend.
+
+Re-expresses the reference lrc plugin
+(/root/reference/src/erasure-code/lrc/ErasureCodeLrc.cc): the codec is a
+stack of inner erasure codes, each acting on a subset of the global chunk
+positions described by a `chunks_map` string ('D' = the layer's data, 'c' =
+the layer's coding, '_' = not in the layer):
+
+  * profile `layers` is a JSON array of [chunks_map, config] entries; each
+    layer instantiates an inner plugin (default jerasure reed_sol_van) with
+    k=#D, m=#c (layers_parse/layers_init, ErasureCodeLrc.cc:143-251);
+  * `parse_kml` synthesizes mapping/layers/crush-steps from the k/m/l
+    shorthand: one global RS layer plus one local XOR-parity layer per
+    group (ErasureCodeLrc.cc:293-398);
+  * encode runs every layer in order over the physical chunk tensor
+    (encode_chunks, .cc:737-775);
+  * decode walks layers in reverse, each recovering its own erasures from
+    chunks earlier layers already repaired — so a single lost chunk is
+    repaired by its local layer reading only l chunks (decode_chunks,
+    .cc:777-860);
+  * `_minimum_to_decode` is locality-aware: cases 1/2/3 of the reference
+    (.cc:566-737) — wanted-and-available, cheapest-layer recovery, then
+    all-available cascade.
+
+All chunk math runs on the inner codecs' TPU kernels; the layer walk is
+host-side control flow. Chunk ids in the byte API and minimum_to_decode are
+PHYSICAL positions (as the reference's ECBackend uses them).
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from ceph_tpu.ec.interface import (
+    ErasureCode,
+    ErasureCodeError,
+    profile_to_string,
+)
+
+DEFAULT_KML = -1
+
+
+class Layer:
+    def __init__(self, chunks_map: str, config: dict):
+        self.chunks_map = chunks_map
+        self.profile = dict(config)
+        self.data = [i for i, ch in enumerate(chunks_map) if ch == "D"]
+        self.coding = [i for i, ch in enumerate(chunks_map) if ch == "c"]
+        self.chunks = self.data + self.coding
+        self.chunks_as_set = set(self.chunks)
+        self.erasure_code: ErasureCode | None = None
+
+
+class Step:
+    """One generated CRUSH rule step ([op, type, n], ErasureCodeLrc.h:67-76)."""
+
+    def __init__(self, op: str, type_: str, n: int):
+        self.op = op
+        self.type = type_
+        self.n = n
+
+    def __repr__(self):
+        return f"Step({self.op!r}, {self.type!r}, {self.n})"
+
+
+class ErasureCodeLrc(ErasureCode):
+    """plugin=lrc — layered composition over inner TPU codecs."""
+
+    def __init__(self):
+        super().__init__()
+        self.layers: list[Layer] = []
+        self.chunk_count = 0
+        self.data_chunk_count = 0
+        self.rule_root = "default"
+        self.rule_device_class = ""
+        self.rule_steps: list[Step] = [Step("chooseleaf", "host", 0)]
+
+    # -- profile ------------------------------------------------------------
+
+    def init(self, profile) -> "ErasureCodeLrc":
+        self.profile = profile
+        self._parse_kml(profile)
+        self._parse_rule(profile)
+        self._layers_parse(profile)
+        self._layers_init()
+        mapping = profile.get("mapping")
+        if not mapping:
+            raise ErasureCodeError(
+                errno.EINVAL, "the 'mapping' profile is missing"
+            )
+        self.data_chunk_count = mapping.count("D")
+        self.chunk_count = len(mapping)
+        self.k = self.data_chunk_count
+        self.m = self.chunk_count - self.k
+        for layer in self.layers:
+            if len(layer.chunks_map) != self.chunk_count:
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    f"chunks_map {layer.chunks_map!r} must be "
+                    f"{self.chunk_count} characters long",
+                )
+        self._parse_mapping(profile)
+        # kml-generated parameters are not exposed back to the caller
+        # (ErasureCodeLrc.cc:540-545)
+        if str(profile.get("l", DEFAULT_KML)) != str(DEFAULT_KML):
+            profile.pop("mapping", None)
+            profile.pop("layers", None)
+        return self
+
+    def _parse_kml(self, profile) -> None:
+        """k/m/l shorthand -> generated mapping + layers + rule steps
+        (parse_kml, ErasureCodeLrc.cc:293-398)."""
+        try:
+            k = int(profile.get("k", DEFAULT_KML))
+            m = int(profile.get("m", DEFAULT_KML))
+            l = int(profile.get("l", DEFAULT_KML))
+        except ValueError:
+            raise ErasureCodeError(
+                errno.EINVAL, "could not convert k/m/l to int"
+            ) from None
+        if k == DEFAULT_KML and m == DEFAULT_KML and l == DEFAULT_KML:
+            return
+        if k == DEFAULT_KML or m == DEFAULT_KML or l == DEFAULT_KML:
+            raise ErasureCodeError(
+                errno.EINVAL, "all of k, m, l must be set or none of them"
+            )
+        for generated in ("mapping", "layers", "crush-steps"):
+            if generated in profile:
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    f"the {generated} parameter cannot be set when "
+                    "k, m, l are set",
+                )
+        if l == 0 or (k + m) % l:
+            raise ErasureCodeError(
+                errno.EINVAL, "k + m must be a multiple of l"
+            )
+        groups = (k + m) // l
+        if k % groups:
+            raise ErasureCodeError(
+                errno.EINVAL, "k must be a multiple of (k + m) / l"
+            )
+        if m % groups:
+            raise ErasureCodeError(
+                errno.EINVAL, "m must be a multiple of (k + m) / l"
+            )
+        kg, mg = k // groups, m // groups
+        profile["mapping"] = ("D" * kg + "_" * mg + "_") * groups
+
+        layers = []
+        # global layer
+        layers.append([("D" * kg + "c" * mg + "_") * groups, ""])
+        # local layers: one XOR parity per group over the group's data and
+        # global parity chunks
+        for i in range(groups):
+            row = ""
+            for j in range(groups):
+                row += ("D" * l + "c") if i == j else "_" * (l + 1)
+            layers.append([row, ""])
+        profile["layers"] = json.dumps(layers)
+
+        locality = profile.get("crush-locality", "")
+        failure_domain = profile.get("crush-failure-domain", "host")
+        if locality:
+            self.rule_steps = [
+                Step("choose", locality, groups),
+                Step("chooseleaf", failure_domain, l + 1),
+            ]
+        elif failure_domain:
+            self.rule_steps = [Step("chooseleaf", failure_domain, 0)]
+
+    def _parse_rule(self, profile) -> None:
+        self.rule_root = profile_to_string(profile, "crush-root", "default")
+        self.rule_device_class = profile.get("crush-device-class", "")
+        steps = profile.get("crush-steps")
+        if steps is not None:
+            try:
+                desc = json.loads(steps) if isinstance(steps, str) else steps
+            except json.JSONDecodeError as e:
+                raise ErasureCodeError(
+                    errno.EINVAL, f"failed to parse crush-steps: {e}"
+                ) from None
+            if not isinstance(desc, list):
+                raise ErasureCodeError(
+                    errno.EINVAL, "crush-steps must be a JSON array"
+                )
+            self.rule_steps = []
+            for entry in desc:
+                if (
+                    not isinstance(entry, list)
+                    or len(entry) != 3
+                    or not isinstance(entry[0], str)
+                    or not isinstance(entry[1], str)
+                    or not isinstance(entry[2], int)
+                ):
+                    raise ErasureCodeError(
+                        errno.EINVAL,
+                        f"crush-steps entry {entry!r} must be "
+                        "[op:str, type:str, n:int]",
+                    )
+                self.rule_steps.append(Step(entry[0], entry[1], entry[2]))
+
+    def _layers_parse(self, profile) -> None:
+        if "layers" not in profile:
+            raise ErasureCodeError(
+                errno.EINVAL, "could not find 'layers' in profile"
+            )
+        raw = profile["layers"]
+        try:
+            desc = json.loads(raw) if isinstance(raw, str) else raw
+        except json.JSONDecodeError as e:
+            raise ErasureCodeError(
+                errno.EINVAL, f"failed to parse layers={raw!r}: {e}"
+            ) from None
+        if not isinstance(desc, list):
+            raise ErasureCodeError(
+                errno.EINVAL, f"layers={raw!r} must be a JSON array"
+            )
+        if len(desc) < 1:
+            raise ErasureCodeError(
+                errno.EINVAL, "layers needs at least one layer"
+            )
+        self.layers = []
+        for pos, entry in enumerate(desc):
+            if not isinstance(entry, list) or not entry:
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    f"layers[{pos}] must be a non-empty JSON array",
+                )
+            chunks_map = entry[0]
+            if not isinstance(chunks_map, str):
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    f"layers[{pos}][0] must be the chunks_map string",
+                )
+            config: dict = {}
+            if len(entry) > 1:
+                if isinstance(entry[1], dict):
+                    config = {k: str(v) for k, v in entry[1].items()}
+                elif isinstance(entry[1], str):
+                    if entry[1]:
+                        # "k=v k=v" / JSON-object string forms of
+                        # get_json_str_map (str_map.cc:26)
+                        try:
+                            config = {
+                                k: str(v)
+                                for k, v in json.loads(entry[1]).items()
+                            }
+                        except (json.JSONDecodeError, AttributeError):
+                            config = dict(
+                                kv.split("=", 1)
+                                for kv in entry[1].split()
+                                if "=" in kv
+                            )
+                else:
+                    raise ErasureCodeError(
+                        errno.EINVAL,
+                        f"layers[{pos}][1] must be a string or object",
+                    )
+            self.layers.append(Layer(chunks_map, config))
+
+    def _layers_init(self) -> None:
+        from ceph_tpu.ec.registry import registry
+
+        for layer in self.layers:
+            layer.profile.setdefault("k", str(len(layer.data)))
+            layer.profile.setdefault("m", str(len(layer.coding)))
+            layer.profile.setdefault("plugin", "jerasure")
+            layer.profile.setdefault("technique", "reed_sol_van")
+            layer.erasure_code = registry.factory(
+                layer.profile["plugin"], layer.profile
+            )
+
+    # -- geometry -----------------------------------------------------------
+
+    def get_chunk_count(self) -> int:
+        return self.chunk_count
+
+    def get_data_chunk_count(self) -> int:
+        return self.data_chunk_count
+
+    def get_chunk_size(self, object_size: int) -> int:
+        # the first (usually global) layer dictates the chunk size
+        # (ErasureCodeLrc.cc:559-562)
+        return self.layers[0].erasure_code.get_chunk_size(object_size)
+
+    # -- minimum_to_decode (locality-aware) ----------------------------------
+
+    def _minimum_to_decode(
+        self, want_to_read: set[int], available: set[int]
+    ) -> set[int]:
+        """Cases 1/2/3 of ErasureCodeLrc::_minimum_to_decode (.cc:566-737).
+        Ids are physical chunk positions."""
+        n = self.get_chunk_count()
+        erasures_total = {i for i in range(n) if i not in available}
+        erasures_not_recovered = set(erasures_total)
+        erasures_want = erasures_total & set(want_to_read)
+
+        # case 1: nothing wanted is missing
+        if not erasures_want:
+            return set(want_to_read)
+
+        # case 2: recover wanted erasures with as few chunks as possible,
+        # walking layers from the last (most local) to the first
+        minimum: set[int] = set()
+        for layer in reversed(self.layers):
+            layer_want = set(want_to_read) & layer.chunks_as_set
+            if not layer_want:
+                continue
+            layer_erasures = layer_want & erasures_want
+            if not layer_erasures:
+                layer_minimum = layer_want
+            else:
+                erasures = layer.chunks_as_set & erasures_not_recovered
+                if len(erasures) > layer.erasure_code.get_coding_chunk_count():
+                    continue  # too many for this layer; hope upper layers help
+                layer_minimum = layer.chunks_as_set - erasures_not_recovered
+                erasures_not_recovered -= erasures
+                erasures_want -= erasures
+            minimum |= layer_minimum
+        if not erasures_want:
+            minimum |= set(want_to_read)
+            minimum -= erasures_total
+            return minimum
+
+        # case 3: cascade — let layers repair chunks nobody asked for, in
+        # the hope upper layers then succeed; if everything is recoverable,
+        # read all available chunks
+        erasures_total = {i for i in range(n) if i not in available}
+        for layer in reversed(self.layers):
+            layer_erasures = layer.chunks_as_set & erasures_total
+            if not layer_erasures:
+                continue
+            if len(layer_erasures) <= layer.erasure_code.get_coding_chunk_count():
+                erasures_total -= layer_erasures
+        if not erasures_total:
+            return set(available)
+
+        raise ErasureCodeError(
+            errno.EIO,
+            f"not enough chunks in {sorted(available)} to read "
+            f"{sorted(want_to_read)}",
+        )
+
+    # -- compute (physical-position core) ------------------------------------
+
+    def _encode_physical(self, phys: np.ndarray) -> np.ndarray:
+        """Run every layer in order over the (B, k+m, L) physical tensor
+        (encode_chunks, .cc:737-775; top==0 for the want-everything case)."""
+        for layer in self.layers:
+            inner = layer.erasure_code
+            data = phys[:, layer.data, :]
+            parity = np.asarray(inner.encode_array(data))
+            phys[:, layer.coding, :] = parity
+        return phys
+
+    def _decode_physical(
+        self,
+        present: Sequence[int],
+        targets: Sequence[int],
+        survivors: np.ndarray,
+    ) -> np.ndarray:
+        """Layered recovery in reverse order (decode_chunks, .cc:777-860)."""
+        n = self.get_chunk_count()
+        batch, _, chunk = survivors.shape
+        decoded = np.zeros((batch, n, chunk), dtype=np.uint8)
+        present_set = set(present)
+        for idx, pch in enumerate(present):
+            decoded[:, pch, :] = survivors[:, idx, :]
+        erasures = {i for i in range(n) if i not in present_set}
+        want_erasures = set(targets) & erasures
+        for layer in reversed(self.layers):
+            layer_erasures = layer.chunks_as_set & erasures
+            inner = layer.erasure_code
+            if not layer_erasures:
+                continue
+            if len(layer_erasures) > inner.get_coding_chunk_count():
+                continue  # too many erasures for this layer
+            local_present = [
+                j for j, c in enumerate(layer.chunks) if c not in erasures
+            ]
+            local_targets = [
+                j for j, c in enumerate(layer.chunks) if c in erasures
+            ]
+            local_surv = decoded[:, [layer.chunks[j] for j in local_present], :]
+            # inner errors propagate, as the reference's decode_chunks does
+            # (a misconfigured layer must not be masked by another layer)
+            out = np.asarray(
+                inner.decode_array(local_present, local_targets, local_surv)
+            )
+            for pos, j in enumerate(local_targets):
+                decoded[:, layer.chunks[j], :] = out[:, pos, :]
+            erasures -= layer.chunks_as_set
+            want_erasures = set(targets) & erasures
+            if not want_erasures:
+                break
+        if want_erasures:
+            raise ErasureCodeError(
+                errno.EIO,
+                f"unable to read {sorted(want_erasures)} from "
+                f"{sorted(present_set)}",
+            )
+        return decoded[:, list(targets), :]
+
+    # -- array API (logical ids, like the other codecs) ----------------------
+
+    def encode_array(self, data: np.ndarray) -> np.ndarray:
+        data = np.asarray(data, dtype=np.uint8)
+        batch, _, chunk = data.shape
+        phys = np.zeros((batch, self.get_chunk_count(), chunk), dtype=np.uint8)
+        data_pos = [self.chunk_index(i) for i in range(self.k)]
+        phys[:, data_pos, :] = data
+        self._encode_physical(phys)
+        coding_pos = [self.chunk_index(self.k + i) for i in range(self.m)]
+        return phys[:, coding_pos, :]
+
+    def decode_array(self, present, targets, survivors) -> np.ndarray:
+        phys_present = [self.chunk_index(i) for i in present]
+        phys_targets = [self.chunk_index(i) for i in targets]
+        return self._decode_physical(
+            phys_present, phys_targets, np.asarray(survivors, dtype=np.uint8)
+        )
+
+    # -- byte-level decode (physical ids, no k-survivor precondition) --------
+
+    def decode(self, want_to_read, chunks: Mapping[int, bytes]):
+        return self._decode_bytes_ungated(
+            want_to_read, chunks, self._decode_physical
+        )
+
+    # -- CRUSH rule generation ----------------------------------------------
+
+    def create_rule(self, cmap, ruleno: int, root: int):
+        """Generated multi-step indep rule (create_rule, .cc:44-113): set
+        tries, take root, then one choose/chooseleaf indep step per
+        rule_steps entry, finally emit."""
+        from ceph_tpu.crush import builder
+        from ceph_tpu.crush.types import RuleOp, RuleStep
+
+        type_ids = {name: tid for tid, name in cmap.type_names.items()}
+        steps = [
+            RuleStep(RuleOp.SET_CHOOSELEAF_TRIES, 5),
+            RuleStep(RuleOp.SET_CHOOSE_TRIES, 100),
+            RuleStep(RuleOp.TAKE, root),
+        ]
+        for s in self.rule_steps:
+            op = (
+                RuleOp.CHOOSELEAF_INDEP
+                if s.op == "chooseleaf"
+                else RuleOp.CHOOSE_INDEP
+            )
+            if s.type not in type_ids:
+                raise ErasureCodeError(
+                    errno.EINVAL, f"unknown crush type {s.type!r}"
+                )
+            steps.append(RuleStep(op, s.n, type_ids[s.type]))
+        steps.append(RuleStep(RuleOp.EMIT))
+        return builder.make_rule(cmap, ruleno, steps)

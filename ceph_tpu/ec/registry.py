@@ -71,6 +71,10 @@ def _register_builtin() -> None:
     registry.add("isa", lambda: ErasureCodeRs("isa"))
     registry.add("shec", ErasureCodeShec)
 
+    from ceph_tpu.ec.lrc import ErasureCodeLrc
+
+    registry.add("lrc", ErasureCodeLrc)
+
 
 _register_builtin()
 

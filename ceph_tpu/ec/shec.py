@@ -208,10 +208,12 @@ class ErasureCodeShec(ErasureCode):
         """Port of shec_make_decoding_matrix (ErasureCodeShec.cc:531-755).
 
         want_in/avails: 0/1 vectors of length k+m. Returns
-        (dm_row, dm_column, minimum, inv) where dm_row are original chunk ids
-        whose values feed the inverse, dm_column the data chunks it rebuilds,
-        minimum the chunk-id set to read, inv the (dup x dup) GF inverse
-        (None when nothing needs solving). Raises EIO when unrecoverable.
+        (dm_row, dm_column, minimum, missing_idx, data_bits, parity_targets,
+        parity_bits): dm_row are original chunk ids whose values feed the
+        inverse, dm_column the data chunks it rebuilds, minimum the chunk-id
+        set to read, and the *_bits device bit-plane matrices rebuild the
+        unavailable data columns / wanted-missing parities directly. Raises
+        EIO when unrecoverable.
         """
         k, m = self.k, self.m
         mat = self._matrix
@@ -297,7 +299,33 @@ class ErasureCodeShec(ErasureCode):
                 if any(mat[i, j] > 0 and not want[j] for j in range(k)):
                     minimum[k + i] = 1
 
-        result = (dm_row, dm_column, minimum, inv)
+        # hot-path device tables, precomputed once per erasure signature
+        # (the TPU analogue of ErasureCodeShecTableCache): bit-plane forms
+        # of (a) the inverse rows rebuilding unavailable data columns and
+        # (b) the parity rows re-encoding wanted-missing parities
+        missing_idx = [
+            i for i, dcol in enumerate(dm_column) if not avails[dcol]
+        ]
+        data_bits = (
+            bp.bitplane_matrix(np.stack([inv[i] for i in missing_idx]))
+            if inv is not None and missing_idx
+            else None
+        )
+        parity_targets = [
+            k + i for i in range(m) if want[k + i] and not avails[k + i]
+        ]
+        parity_bits = (
+            bp.bitplane_matrix(
+                np.stack([mat[t - k] for t in parity_targets])
+            )
+            if parity_targets
+            else None
+        )
+
+        result = (
+            dm_row, dm_column, minimum,
+            missing_idx, data_bits, parity_targets, parity_bits,
+        )
         self._decode_cache[key] = result
         if len(self._decode_cache) > DECODE_TABLE_CACHE_SIZE:
             self._decode_cache.popitem(last=False)
@@ -313,7 +341,7 @@ class ErasureCodeShec(ErasureCode):
             raise ErasureCodeError(errno.EINVAL, "chunk id out of range")
         want = [1 if i in want_to_read else 0 for i in range(n)]
         avails = [1 if i in available else 0 for i in range(n)]
-        _, _, minimum, _ = self._make_decoding_matrix(want, avails)
+        minimum = self._make_decoding_matrix(want, avails)[2]
         return {i for i in range(n) if minimum[i]}
 
     # -- compute -------------------------------------------------------------
@@ -337,24 +365,22 @@ class ErasureCodeShec(ErasureCode):
         avails = [0] * n
         for pch in present:
             avails[pch] = 1
-        dm_row, dm_column, _, inv = self._make_decoding_matrix(want, avails)
+        (
+            dm_row, dm_column, _,
+            missing_idx, data_bits, parity_targets, parity_bits,
+        ) = self._make_decoding_matrix(want, avails)
 
         survivors = jnp.asarray(survivors, dtype=jnp.uint8)
         batch, _, chunk = survivors.shape
         col_of = {pch: idx for idx, pch in enumerate(present)}
 
-        # data targets rebuilt by the inverse over the dm_row chunk values
+        # data targets rebuilt by the cached inverse rows over dm_row values
         rebuilt: dict[int, jnp.ndarray] = {}
-        if inv is not None:
-            missing = [
-                i for i, dcol in enumerate(dm_column) if not avails[dcol]
-            ]
-            if missing:
-                rows = np.stack([inv[i] for i in missing])
-                src = survivors[:, [col_of[r] for r in dm_row], :]
-                out = bp.gf_matmul_bitplane(bp.bitplane_matrix(rows), src)
-                for pos, i in enumerate(missing):
-                    rebuilt[dm_column[i]] = out[:, pos, :]
+        if data_bits is not None:
+            src = survivors[:, [col_of[r] for r in dm_row], :]
+            out = bp.gf_matmul_bitplane(data_bits, src)
+            for pos, i in enumerate(missing_idx):
+                rebuilt[dm_column[i]] = out[:, pos, :]
 
         # full data vector (zeros where untouched-missing: their matrix
         # coefficients are zero in every parity row that needs re-encoding)
@@ -365,16 +391,12 @@ class ErasureCodeShec(ErasureCode):
                 return rebuilt[j]
             return jnp.zeros((batch, chunk), dtype=jnp.uint8)
 
-        parity_targets = [t for t in targets if t >= self.k and not avails[t]]
         parity_out: dict[int, jnp.ndarray] = {}
-        if parity_targets:
+        if parity_bits is not None:
             data_full = jnp.stack(
                 [data_chunk(j) for j in range(self.k)], axis=1
             )
-            prows = np.stack(
-                [self._matrix[t - self.k] for t in parity_targets]
-            )
-            out = bp.gf_matmul_bitplane(bp.bitplane_matrix(prows), data_full)
+            out = bp.gf_matmul_bitplane(parity_bits, data_full)
             for pos, t in enumerate(parity_targets):
                 parity_out[t] = out[:, pos, :]
 
@@ -394,19 +416,6 @@ class ErasureCodeShec(ErasureCode):
         """SHEC can decode from fewer than k chunks (that is the point), so
         the base class's len(have) >= k gate does not apply
         (ErasureCodeShec::_decode has no such check, .cc:172-213)."""
-        want = set(want_to_read)
-        have = set(chunks)
-        if want <= have:
-            return {i: bytes(chunks[i]) for i in want}
-        if not have:
-            raise ErasureCodeError(errno.EIO, "no chunks to decode from")
-        present = sorted(have)
-        missing = sorted(want - have)
-        survivors = np.stack(
-            [np.frombuffer(chunks[i], dtype=np.uint8) for i in present]
-        )[None, :, :]
-        rebuilt = np.asarray(self.decode_array(present, missing, survivors))
-        out = {i: bytes(chunks[i]) for i in want & have}
-        for pos, i in enumerate(missing):
-            out[i] = rebuilt[0, pos].tobytes()
-        return out
+        return self._decode_bytes_ungated(
+            want_to_read, chunks, self.decode_array
+        )
