@@ -20,6 +20,7 @@ import errno
 from collections import OrderedDict
 from typing import Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,6 +37,8 @@ from ceph_tpu.ec.interface import (
     profile_to_string,
 )
 from ceph_tpu.ops import gf_bitplane as bp
+from ceph_tpu.ops import gf_pallas as gp
+from ceph_tpu.ops.gf import matrix_to_bitmatrix
 
 LARGEST_VECTOR_WORDSIZE = 16  # reference: ErasureCodeJerasure.cc:30
 DECODE_TABLE_CACHE_SIZE = 256  # reference LRU is sized for <=(12,4) patterns
@@ -74,7 +77,8 @@ class ErasureCodeRs(ErasureCode):
         self.per_chunk_alignment = False
         self._gen: np.ndarray | None = None
         self._encode_bits: jnp.ndarray | None = None
-        self._decode_cache: OrderedDict[tuple, jnp.ndarray] = OrderedDict()
+        self._encode_packed: jnp.ndarray | None = None
+        self._decode_cache: OrderedDict[tuple, tuple] = OrderedDict()
 
     # -- profile ------------------------------------------------------------
 
@@ -135,7 +139,9 @@ class ErasureCodeRs(ErasureCode):
         # the XOR fast path is only valid when the parity row really is XOR
         self._xor_ok = self.m == 1 and bool(np.all(parity == 1))
         self._gen = np.concatenate([np.eye(self.k, dtype=np.uint8), parity])
-        self._encode_bits = bp.bitplane_matrix(parity)
+        bits = matrix_to_bitmatrix(parity)
+        self._encode_bits = jnp.asarray(bits, dtype=jnp.int8)
+        self._encode_packed = jnp.asarray(gp.pack_matrix(bits))
         self._decode_cache.clear()
 
     # -- geometry -----------------------------------------------------------
@@ -175,10 +181,10 @@ class ErasureCodeRs(ErasureCode):
             return bp.xor_reduce(data)
         return bp.gf_matmul_bitplane(self._encode_bits, data)
 
-    def decode_bitmatrix(
-        self, present: Sequence[int], targets: Sequence[int]
-    ) -> jnp.ndarray:
-        """Memoized (8*targets x 8*k) decode bit-matrix for an erasure signature."""
+    def decode_bitmatrix(self, present: Sequence[int], targets: Sequence[int]):
+        """Memoized decode matrices for an erasure signature: a (bitplane,
+        packed) pair — the TPU analogue of the reference's LRU decode-table
+        cache (ErasureCodeIsaTableCache.cc:234-296)."""
         key = (tuple(present[: self.k]), tuple(targets))
         cached = self._decode_cache.get(key)
         if cached is not None:
@@ -187,15 +193,57 @@ class ErasureCodeRs(ErasureCode):
         dm = matrices.decode_matrix(
             self._gen, self.k, list(present), list(targets)
         )
-        bits = bp.bitplane_matrix(dm)
-        self._decode_cache[key] = bits
+        bits_np = matrix_to_bitmatrix(dm)
+        # cache HOST arrays: entries may be created while tracing under jit,
+        # where a device array would be a leaked tracer; as numpy constants
+        # they fold into the compiled program at each use site
+        entry = (bits_np.astype(np.int8), gp.pack_matrix(bits_np))
+        self._decode_cache[key] = entry
         if len(self._decode_cache) > DECODE_TABLE_CACHE_SIZE:
             self._decode_cache.popitem(last=False)
-        return bits
+        return entry
 
     def decode_array(self, present, targets, survivors) -> np.ndarray:
         if len(present) < self.k:
             raise ErasureCodeError(errno.EIO, "not enough survivors")
         survivors = jnp.asarray(survivors, dtype=jnp.uint8)[:, : self.k, :]
-        bits = self.decode_bitmatrix(present, targets)
+        bits, _ = self.decode_bitmatrix(present, targets)
         return bp.gf_matmul_bitplane(bits, survivors)
+
+    # -- planar word API: the fused Pallas fast path --------------------------
+
+    def encode_words(self, words) -> jnp.ndarray:
+        """Chunk-planar encode: (k, N/4) int32 words -> (m, N/4) parity words.
+
+        The TPU-native entry point — rows are whole chunk columns (many
+        objects' chunk j packed end to end), bytes ride 4-per-lane through the
+        fused kernel (ceph_tpu.ops.gf_pallas). Falls back to the XLA bit-plane
+        path off-TPU so the data path runs identically on CPU meshes.
+        """
+        words = jnp.asarray(words, dtype=jnp.int32)
+        if self._xor_ok:
+            return gp.xor_reduce_words(words)
+        if gp.available():
+            return gp.gf_matmul_packed(self._encode_packed, words)
+        return self._words_fallback(self._encode_bits, words)
+
+    def decode_words(self, present, targets, words) -> jnp.ndarray:
+        """Planar decode: words holds the first k survivor chunks (logical ids
+        `present`, ascending); returns len(targets) rebuilt chunk rows."""
+        if len(present) < self.k:
+            raise ErasureCodeError(errno.EIO, "not enough survivors")
+        words = jnp.asarray(words, dtype=jnp.int32)[: self.k]
+        bits, packed = self.decode_bitmatrix(present, targets)
+        if gp.available():
+            return gp.gf_matmul_packed(packed, words)
+        return self._words_fallback(bits, words)
+
+    @staticmethod
+    def _words_fallback(bits, words) -> jnp.ndarray:
+        """XLA path for planar words on non-TPU backends (bit-exact, slower)."""
+        bytes_ = jax.lax.bitcast_convert_type(words, jnp.uint8)  # (k, N4, 4)
+        flat = bytes_.reshape(words.shape[0], -1)
+        out = bp.gf_matmul_bitplane(bits, flat[None])[0]
+        return jax.lax.bitcast_convert_type(
+            out.reshape(out.shape[0], -1, 4), jnp.int32
+        )

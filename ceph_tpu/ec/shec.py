@@ -43,7 +43,7 @@ from ceph_tpu.ec.interface import (
     profile_to_string,
 )
 from ceph_tpu.ops import gf_bitplane as bp
-from ceph_tpu.ops.gf import gf_invert_matrix
+from ceph_tpu.ops.gf import gf_invert_matrix, matrix_to_bitmatrix
 
 MULTIPLE = 0  # ErasureCodeShec.h:31
 SINGLE = 1
@@ -299,15 +299,19 @@ class ErasureCodeShec(ErasureCode):
                 if any(mat[i, j] > 0 and not want[j] for j in range(k)):
                     minimum[k + i] = 1
 
-        # hot-path device tables, precomputed once per erasure signature
-        # (the TPU analogue of ErasureCodeShecTableCache): bit-plane forms
-        # of (a) the inverse rows rebuilding unavailable data columns and
-        # (b) the parity rows re-encoding wanted-missing parities
+        # hot-path bit-plane tables, precomputed once per erasure signature
+        # (the TPU analogue of ErasureCodeShecTableCache): (a) the inverse
+        # rows rebuilding unavailable data columns and (b) the parity rows
+        # re-encoding wanted-missing parities. Cached as HOST int8 arrays —
+        # minimum_to_decode hits this path as a pure planning query, and a
+        # device array built while tracing under jit would leak a tracer
         missing_idx = [
             i for i, dcol in enumerate(dm_column) if not avails[dcol]
         ]
         data_bits = (
-            bp.bitplane_matrix(np.stack([inv[i] for i in missing_idx]))
+            matrix_to_bitmatrix(
+                np.stack([inv[i] for i in missing_idx])
+            ).astype(np.int8)
             if inv is not None and missing_idx
             else None
         )
@@ -315,9 +319,9 @@ class ErasureCodeShec(ErasureCode):
             k + i for i in range(m) if want[k + i] and not avails[k + i]
         ]
         parity_bits = (
-            bp.bitplane_matrix(
+            matrix_to_bitmatrix(
                 np.stack([mat[t - k] for t in parity_targets])
-            )
+            ).astype(np.int8)
             if parity_targets
             else None
         )
