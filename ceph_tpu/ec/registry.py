@@ -75,6 +75,10 @@ def _register_builtin() -> None:
 
     registry.add("lrc", ErasureCodeLrc)
 
+    from ceph_tpu.ec.clay import ErasureCodeClay
+
+    registry.add("clay", ErasureCodeClay)
+
 
 _register_builtin()
 
