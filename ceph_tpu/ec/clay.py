@@ -91,9 +91,11 @@ class ErasureCodeClay(ErasureCode):
                 "one of 'jerasure', 'isa'",
             )
         technique = profile_to_string(profile, "technique", "reed_sol_van")
+        # liber8tion (allowed by the reference, .cc:232) is omitted until the
+        # bitmatrix techniques land in the jerasure family
         allowed = {
             "jerasure": ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig",
-                         "cauchy_good", "liber8tion"),
+                         "cauchy_good"),
             "isa": ("reed_sol_van", "cauchy"),
         }[scalar_mds]
         if technique not in allowed:
@@ -101,6 +103,13 @@ class ErasureCodeClay(ErasureCode):
                 errno.EINVAL,
                 f"technique {technique!r} is not currently supported for "
                 f"scalar_mds={scalar_mds}, use one of {allowed}",
+            )
+        if technique == "reed_sol_r6_op" and self.m != 2:
+            # the inner jerasure codec coerces its m to 2 for RAID6; with
+            # CLAY's m baked into the plane geometry that coercion would
+            # desynchronize the two, so require agreement up front
+            raise ErasureCodeError(
+                errno.EINVAL, "technique=reed_sol_r6_op requires m=2"
             )
         if not self.k <= self.d <= self.k + self.m - 1:
             raise ErasureCodeError(
@@ -184,14 +193,17 @@ class ErasureCodeClay(ErasureCode):
         (positions 0..3), return the requested positions. Vectorized over
         arbitrary array shapes."""
         rows = sorted(knowns)[:2]
-        Minv = self._pair_inv[tuple(rows)]
         v0, v1 = knowns[rows[0]], knowns[rows[1]]
 
         def lin2(a, x, b, y):
             return gf.gf_mul(a, x) ^ gf.gf_mul(b, y)
 
-        c_hi = lin2(Minv[0, 0], v0, Minv[0, 1], v1)
-        c_lo = lin2(Minv[1, 0], v0, Minv[1, 1], v1)
+        if rows == [0, 1]:  # knowns ARE the variables; skip the identity solve
+            c_hi, c_lo = v0, v1
+        else:
+            Minv = self._pair_inv[tuple(rows)]
+            c_hi = lin2(Minv[0, 0], v0, Minv[0, 1], v1)
+            c_lo = lin2(Minv[1, 0], v0, Minv[1, 1], v1)
         out = []
         for tpos in targets:
             if tpos == 0:
@@ -277,32 +289,43 @@ class ErasureCodeClay(ErasureCode):
             )
             for pos, node in enumerate(targets):
                 U[node, zs] = rebuilt[:, pos]
-            # phase 3: recover coupled values of erased nodes (.cc:686-708)
+            # phase 3: recover coupled values of erased nodes (.cc:686-708),
+            # vectorized over the group's planes
+            erased_mask = np.zeros(qt, dtype=bool)
+            erased_mask[sorted(erased)] = True
             for node in sorted(erased):
                 x, y = node % q, node // q
-                for gi, z in enumerate(zs):
-                    node_sw, z_sw, is_hi, dig = self._pair_at(x, y, int(z), digits)
-                    if dig == x:  # hole-dot: C = U
-                        C[node, z] = U[node, z]
-                    elif node_sw not in erased:
-                        # type-1: C_xy from intact C_sw + own U (.cc:776-812)
-                        if is_hi:
-                            sol = self._pair_solve(
-                                {1: C[node_sw, z_sw], 2: U[node, z]}, [0]
-                            )[0]
-                        else:
-                            sol = self._pair_solve(
-                                {0: C[node_sw, z_sw], 3: U[node, z]}, [1]
-                            )[0]
-                        C[node, z] = sol
-                    elif dig < x:
-                        # both erased: full pair from both U (.cc:814-839);
-                        # done once from the hi perspective, writes both
-                        c_hi, c_lo = self._pair_solve(
-                            {2: U[node, z], 3: U[node_sw, z_sw]}, [0, 1]
-                        )
-                        C[node, z] = c_hi
-                        C[node_sw, z_sw] = c_lo
+                dig = digits[zs, y]
+                z_sw = zs + (x - dig) * _pow_int(q, t - 1 - y)
+                node_sw = y * q + dig
+                pair_erased = erased_mask[node_sw]
+                dot = dig == x
+                hi = dig < x
+                u_own = U[node, zs]
+                u_sw = U[node_sw, z_sw]
+                c_sw = C[node_sw, z_sw]
+                # type-1: C_xy from intact C_sw + own U (.cc:776-812)
+                t1 = np.where(
+                    hi[:, None],
+                    self._pair_solve({1: c_sw, 2: u_own}, [0])[0],
+                    self._pair_solve({0: c_sw, 3: u_own}, [1])[0],
+                )
+                # both erased: full pair from both U (.cc:814-839); done once
+                # from the hi perspective, which also writes the lo partner
+                both_hi, both_lo = self._pair_solve({2: u_own, 3: u_sw}, [0, 1])
+                val = np.where(
+                    dot[:, None],
+                    u_own,
+                    np.where(
+                        ~pair_erased[:, None],
+                        t1,
+                        np.where(hi[:, None], both_hi, C[node, zs]),
+                    ),
+                )
+                C[node, zs] = val
+                scatter = hi & pair_erased
+                if scatter.any():
+                    C[node_sw[scatter], z_sw[scatter]] = both_lo[scatter]
 
     # -- chunk-array assembly --------------------------------------------------
 
@@ -413,7 +436,7 @@ class ErasureCodeClay(ErasureCode):
     def get_repair_sub_chunk_count(self, want_to_read: set[int]) -> int:
         weight = [0] * self.t
         for c in want_to_read:
-            weight[self._node_of(c) // self.q] += 1
+            weight[self._node_of(self.logical_index(c)) // self.q] += 1
         remaining = 1
         for y in range(self.t):
             remaining *= self.q - weight[y]

@@ -227,3 +227,20 @@ def test_repair_with_chunk_mapping():
 def test_scalar_mds_shec_rejected():
     with pytest.raises(ErasureCodeError):
         factory("clay", {"k": "4", "m": "2", "d": "5", "scalar_mds": "shec"})
+
+
+def test_r6_op_requires_m2_and_liber8tion_rejected():
+    with pytest.raises(ErasureCodeError):
+        factory("clay", {"k": "4", "m": "3", "d": "6",
+                         "technique": "reed_sol_r6_op"})
+    with pytest.raises(ErasureCodeError):
+        factory("clay", {"k": "4", "m": "2", "d": "5",
+                         "technique": "liber8tion"})
+    # m=2 RAID6 works end to end
+    ec = factory("clay", {"k": "4", "m": "2", "d": "5",
+                          "technique": "reed_sol_r6_op"})
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 256, ec.get_chunk_size(1) * 4, np.uint8).tobytes()
+    encoded = ec.encode(range(6), data)
+    out = ec.decode({0, 5}, {i: encoded[i] for i in (1, 2, 3, 4)})
+    assert out[0] == encoded[0] and out[5] == encoded[5]

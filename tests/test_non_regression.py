@@ -1,0 +1,30 @@
+"""Golden-chunk non-regression gate.
+
+Checks every committed corpus profile (tests/corpus/) the way the reference's
+encode-decode-non-regression.sh drives ceph_erasure_code_non_regression
+(/root/reference/src/test/erasure-code/ceph_erasure_code_non_regression.cc):
+re-encode the stored content and require bit-identical chunks, then re-decode
+erasures and require bit-identical recovery. Any drift in matrices, padding,
+chunk layout, or kernels fails here first.
+"""
+
+import os
+
+import pytest
+
+from tools.ec_non_regression import DEFAULT_PROFILES, check
+
+CORPUS = os.path.join(os.path.dirname(__file__), "corpus")
+
+
+@pytest.mark.parametrize(
+    "plugin,profile,sw",
+    DEFAULT_PROFILES,
+    ids=[
+        f"{p}-{'-'.join(f'{k}{v}' for k, v in prof.items())}"
+        for p, prof, _ in DEFAULT_PROFILES
+    ],
+)
+def test_corpus_profile(plugin, profile, sw):
+    errors = check(CORPUS, plugin, profile, sw)
+    assert not errors, "\n".join(errors)
