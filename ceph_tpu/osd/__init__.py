@@ -1,0 +1,12 @@
+"""OSD-side cluster-map layer: pools, placement pipeline, balancer.
+
+Re-expresses the reference's `src/osd/osd_types.{h,cc}` pool/PG types and
+`src/osd/OSDMap.{h,cc}` placement pipeline in TPU-first form: the per-PG
+scalar pipeline for parity with the C code, and a batched whole-pool mapping
+(ParallelPGMapper's job, OSDMapMapping.h:18) on the vectorized CRUSH mapper.
+"""
+
+from ceph_tpu.osd.types import PgPool, ceph_stable_mod, pg_num_mask
+from ceph_tpu.osd.osdmap import OSDMap
+
+__all__ = ["PgPool", "OSDMap", "ceph_stable_mod", "pg_num_mask"]
