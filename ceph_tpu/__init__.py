@@ -8,12 +8,16 @@ is TPU-first: batched GF(2^8) bit-plane matmuls on the MXU for erasure coding, a
 vmapped integer placement function for CRUSH.
 
 Subpackages:
-  ops      — GF(2^8) math: exact NumPy oracle + JAX/Pallas kernels
-  ec       — erasure-code framework: interface, registry, codecs (rs/shec/lrc/clay)
-  crush    — CRUSH placement: data model, NumPy oracle, vmapped JAX mapper, tools
-  osd      — mini object-store data path (striping, placement, degraded reads)
+  ops      — GF(2^8) math: exact NumPy oracle, XLA bit-plane kernels, and the
+             fused packed-lane Pallas kernel (gf_pallas)
+  ec       — erasure-code framework: interface, registry, and all five
+             reference codec families (jerasure/isa RS, shec, lrc, clay)
+  crush    — CRUSH placement: data model, NumPy oracle, batched JAX mapper
+  osd      — cluster map (OSDMap placement pipeline, balancer) + MemStore
+  rados    — MiniCluster: the end-to-end striped data path (put/get,
+             degraded reads, recovery, fault injection)
+  common   — shared runtime pieces (object-name hashes; config/perf to come)
   parallel — device-mesh sharding helpers (shard_map over stripe batches)
-  utils    — config schema, perf counters, fault injection
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,2 @@
+"""Common runtime utilities (the L0 layer analogue: src/common in the
+reference). Grows config/perf-counter subsystems as the framework widens."""

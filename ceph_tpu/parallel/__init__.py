@@ -1,0 +1,18 @@
+"""Multi-chip sharding layer: meshes + shard_map'd EC kernels.
+
+The scale-out story (SURVEY §2.3/§2.4): stripe batches shard over a device
+mesh ('stripe' axis = data parallel over objects/PGs, 'byte' axis =
+sequence-parallel-style split of the chunk byte columns, both embarrassingly
+clean for GF matmul), with XLA collectives over ICI for cross-shard
+reductions — the TPU-native counterpart of the reference fanning ECSubWrites
+across OSDs over its async messenger.
+"""
+
+from ceph_tpu.parallel.sharding import (
+    ec_mesh,
+    sharded_encode,
+    sharded_decode,
+    shard_batch,
+)
+
+__all__ = ["ec_mesh", "sharded_encode", "sharded_decode", "shard_batch"]

@@ -1,0 +1,5 @@
+"""Client-facing data path (the librados/Objecter layer analogue)."""
+
+from ceph_tpu.rados.cluster import MiniCluster
+
+__all__ = ["MiniCluster"]

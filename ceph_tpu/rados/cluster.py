@@ -1,0 +1,312 @@
+"""MiniCluster: the single-process striped object store (SURVEY §7.8).
+
+This is the minimum end-to-end slice of the reference's data path, one
+process, no wire protocol:
+
+  put(name, data)                       rados_write -> Objecter::op_submit
+    -> object name -> ps -> pg          ceph_str_hash_rjenkins + stable_mod
+       (src/common/ceph_hash.cc:21, osd_types.cc:1628)
+    -> pg -> up/acting osds             OSDMap::_pg_to_up_acting_osds
+       via the TPU CRUSH mapper         (OSDMap.cc:2591)
+    -> stripe + encode on TPU           ECBackend/ECTransaction -> ECUtil::encode
+       (kernels: ceph_tpu.ops)          (ECTransaction.cc:44)
+    -> shard i -> store of acting[i]    ECSubWrite to shard OSDs (ECBackend.cc:910)
+
+  get(name)                             objects_read_async (ECBackend.cc:2154)
+    -> probe shards, pick minimum       get_min_avail_to_read_shards ->
+       via minimum_to_decode            ec_impl->minimum_to_decode (1605)
+    -> decode on TPU when degraded      ECUtil::decode (2306)
+
+  kill/revive osd + recover()           the qa Thrasher loop (ceph_manager.py:196)
+    -> deterministic re-placement on the new map epoch, shard rebuild onto the
+       new homes, CLAY pools reading only their repair sub-chunk fraction
+       (RecoveryOp, ECBackend.cc:733; minimum_to_repair, ErasureCodeClay.cc:325)
+
+Fault injection mirrors the reference's config hooks: per-store transient op
+failures (`ms_inject_socket_failures`, options.cc:1044) retried once by the
+client (the Objecter's resend contract), EIO poisoning of individual shards
+(test-erasure-eio.sh), and whole-OSD death.
+
+The cluster-level object registry stands in for the PG log (PGLog.cc): real
+OSDs discover objects per PG from their logs during peering; here recovery
+iterates the registry and asks the SAME placement/decode questions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ceph_tpu.common.hash import ceph_str_hash_rjenkins
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.registry import factory
+from ceph_tpu.osd.memstore import MemStore, ObjectStoreError
+from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE, OSDMap
+
+
+@dataclass
+class MiniCluster:
+    osdmap: OSDMap
+    #: pool id -> erasure profile (with "plugin"), or None for replicated
+    profiles: dict[int, dict | None] = field(default_factory=dict)
+    stores: dict[int, MemStore] = field(default_factory=dict)
+    _codecs: dict[int, object] = field(default_factory=dict)
+    #: (pool, name) -> object size; the PG-log stand-in (see module doc)
+    registry: dict[tuple[int, str], int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        for osd in range(self.osdmap.max_osd):
+            self.stores[osd] = MemStore(osd_id=osd)
+
+    # -- plumbing --------------------------------------------------------------
+
+    def codec(self, pool_id: int):
+        if pool_id not in self._codecs:
+            profile = self.profiles.get(pool_id)
+            if profile is None:
+                self._codecs[pool_id] = None
+            else:
+                profile = dict(profile)
+                plugin = profile.pop("plugin", "tpu")
+                self._codecs[pool_id] = factory(plugin, profile)
+        return self._codecs[pool_id]
+
+    def object_pg(self, pool_id: int, name: str) -> int:
+        pool = self.osdmap.pools[pool_id]
+        return pool.raw_pg_to_pg(ceph_str_hash_rjenkins(name))
+
+    def acting(self, pool_id: int, name: str) -> tuple[int, list[int]]:
+        pg = self.object_pg(pool_id, name)
+        _, _, acting, _ = self.osdmap.pg_to_up_acting_osds(pool_id, pg)
+        return pg, acting
+
+    def _op(self, fn, *args, **kw):
+        """One retry on injected transient failures — the client resend
+        contract (Objecter re-targets and resends on failure/map change)."""
+        try:
+            return fn(*args, **kw)
+        except ObjectStoreError as e:
+            if e.code != "ECONN":
+                raise
+            return fn(*args, **kw)
+
+    # -- client API ------------------------------------------------------------
+
+    def put(self, pool_id: int, name: str, data: bytes) -> None:
+        pg, acting = self.acting(pool_id, name)
+        ec = self.codec(pool_id)
+        if ec is None:  # replicated: full copy on every acting osd
+            for osd in acting:
+                if osd != CRUSH_ITEM_NONE:
+                    self._op(self.stores[osd].write, (pool_id, pg, name), data)
+        else:
+            encoded = ec.encode(range(ec.get_chunk_count()), data)
+            for shard, osd in enumerate(acting):
+                if osd == CRUSH_ITEM_NONE:
+                    continue  # degraded write: shard stays missing
+                self._op(
+                    self.stores[osd].write,
+                    (pool_id, pg, name, shard),
+                    encoded[shard],
+                )
+        self.registry[(pool_id, name)] = len(data)
+
+    def get(self, pool_id: int, name: str) -> bytes:
+        size = self.registry.get((pool_id, name))
+        if size is None:
+            raise KeyError(f"no such object {name!r} in pool {pool_id}")
+        pg, acting = self.acting(pool_id, name)
+        ec = self.codec(pool_id)
+        if ec is None:
+            key = (pool_id, pg, name)
+            candidates = [o for o in acting if o != CRUSH_ITEM_NONE]
+            # stray fallback: previous-interval OSDs may still hold copies
+            candidates += [o for o in self.stores if o not in candidates]
+            for osd in candidates:
+                if key not in self.stores[osd].objects:
+                    continue
+                try:
+                    return self._op(self.stores[osd].read, key)
+                except ObjectStoreError:
+                    continue
+            raise ErasureCodeError(5, f"no live replica of {name!r}")
+
+        # EC read: probe shard availability, then read only the minimum set
+        available = self._probe_shards(pool_id, pg, name, ec, acting)
+        return self._read_min_and_decode(pool_id, pg, name, ec, available, size)
+
+    def _probe_shards(
+        self, pool_id, pg, name, ec, acting
+    ) -> dict[int, int]:
+        """shard -> osd for every readable shard at its acting home."""
+        available: dict[int, int] = {}
+        for shard, osd in enumerate(acting):
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            store = self.stores[osd]
+            key = (pool_id, pg, name, shard)
+            if store.alive and key not in store.eio_keys and key in store.objects:
+                available[shard] = osd
+        return available
+
+    def _read_min_and_decode(
+        self, pool_id, pg, name, ec, available, size
+    ) -> bytes:
+        """Plan the minimum read set, fetch it, decode, truncate — replanning
+        without any shard that fails mid-read (handle_sub_read error path,
+        ECBackend.cc:985)."""
+        want = {ec.chunk_index(i) for i in range(ec.get_data_chunk_count())}
+        while True:
+            minimum = ec.minimum_to_decode(want, set(available))
+            chunks: dict[int, bytes] = {}
+            retry = False
+            for shard in minimum:
+                key = (pool_id, pg, name, shard)
+                try:
+                    chunks[shard] = self._op(
+                        self.stores[available[shard]].read, key
+                    )
+                except ObjectStoreError:
+                    del available[shard]
+                    retry = True
+                    break
+            if retry:
+                continue
+            decoded = ec.decode(want, chunks)
+            return self._concat(ec, decoded)[:size]
+
+    @staticmethod
+    def _concat(ec, decoded: dict[int, bytes]) -> bytes:
+        return b"".join(
+            decoded[ec.chunk_index(i)] for i in range(ec.get_data_chunk_count())
+        )
+
+    # -- failure / recovery (the thrasher loop) --------------------------------
+
+    def kill_osd(self, osd: int) -> None:
+        self.stores[osd].alive = False
+        self.osdmap.mark_down(osd)
+
+    def revive_osd(self, osd: int) -> None:
+        """Revive with amnesia: the store comes back empty (recovery must
+        rebuild), like an OSD replaced after data loss."""
+        self.stores[osd] = MemStore(osd_id=osd)
+        self.osdmap.mark_up(osd)
+
+    def recover(self, pool_id: int) -> int:
+        """Rebuild missing shards onto their current acting homes.
+
+        For every registered object: any acting position whose store lacks
+        its shard gets the shard rebuilt from the minimum surviving set —
+        single-shard losses on CLAY pools read only the repair sub-chunk
+        fraction (minimum_to_decode -> (offset, count) runs). Returns the
+        number of shards rebuilt. Mirrors RecoveryOp (ECBackend.cc:733).
+        """
+        ec = self.codec(pool_id)
+        rebuilt = 0
+        for (pid, name), size in list(self.registry.items()):
+            if pid != pool_id:
+                continue
+            pg, acting = self.acting(pool_id, name)
+            if ec is None:
+                key = (pool_id, pg, name)
+                data = None
+                # acting homes first, then stray stores (MissingLoc contract)
+                candidates = [o for o in acting if o != CRUSH_ITEM_NONE]
+                candidates += [o for o in self.stores if o not in candidates]
+                for osd in candidates:
+                    store = self.stores[osd]
+                    if (
+                        store.alive
+                        and key in store.objects
+                        and key not in store.eio_keys
+                    ):
+                        data = store.objects[key]
+                        break
+                if data is None:
+                    continue
+                for osd in acting:
+                    if osd != CRUSH_ITEM_NONE and (
+                        key not in self.stores[osd].objects
+                    ):
+                        self._op(self.stores[osd].write, key, data)
+                        rebuilt += 1
+                continue
+
+            # locate every shard: acting home first, then stray stores (the
+            # MissingLoc contract, src/osd/MissingLoc.cc — after a remap the
+            # surviving shards still live on the previous interval's OSDs)
+            available: dict[int, int] = {}
+            missing: list[tuple[int, int]] = []
+
+            def readable(osd: int, key: tuple) -> bool:
+                st = self.stores[osd]
+                return st.alive and key in st.objects and key not in st.eio_keys
+
+            for shard, osd in enumerate(acting):
+                key = (pool_id, pg, name, shard)
+                if osd != CRUSH_ITEM_NONE and readable(osd, key):
+                    available[shard] = osd
+                    continue
+                stray = next(
+                    (o for o in self.stores if readable(o, key)), None
+                )
+                if stray is not None:
+                    available[shard] = stray
+                if osd != CRUSH_ITEM_NONE:
+                    missing.append((shard, osd))
+            for shard, osd in missing:
+                key = (pool_id, pg, name, shard)
+                if shard in available:
+                    # log-based recovery: the shard survives on a stray OSD,
+                    # push the copy instead of decoding (ReplicatedBackend-
+                    # style pull/push vs full rebuild)
+                    self._op(
+                        self.stores[osd].write,
+                        key,
+                        self.stores[available[shard]].objects[key],
+                    )
+                    available[shard] = osd
+                    rebuilt += 1
+                    continue
+                sub_total = ec.get_sub_chunk_count()
+                while True:  # re-plan without any source that fails mid-read
+                    minimum = ec.minimum_to_decode({shard}, set(available))
+                    chunk_size = None
+                    chunks: dict[int, bytes] = {}
+                    partial = False
+                    failed_src = None
+                    for src, runs in minimum.items():
+                        key = (pool_id, pg, name, src)
+                        store = self.stores[available[src]]
+                        try:
+                            n_sub = sum(c for _, c in runs)
+                            if n_sub < sub_total:
+                                partial = True
+                                whole_len = len(store.objects[key])
+                                chunk_size = whole_len
+                                unit = whole_len // sub_total
+                                chunks[src] = self._op(
+                                    store.read_runs, key, runs, unit
+                                )
+                            else:
+                                chunks[src] = self._op(store.read, key)
+                                chunk_size = len(chunks[src])
+                        except ObjectStoreError:
+                            failed_src = src
+                            break
+                    if failed_src is not None:
+                        del available[failed_src]
+                        continue
+                    break
+                if partial:
+                    decoded = ec.decode({shard}, chunks, chunk_size=chunk_size)
+                else:
+                    decoded = ec.decode({shard}, chunks)
+                self._op(
+                    self.stores[osd].write,
+                    (pool_id, pg, name, shard),
+                    decoded[shard],
+                )
+                available[shard] = osd
+                rebuilt += 1
+        return rebuilt

@@ -1,0 +1,285 @@
+"""Mini data path end-to-end tests (SURVEY §7.8).
+
+The loop the reference exists for: put -> stripe -> TPU encode -> shards
+placed by the TPU CRUSH mapper -> kill shards -> degraded read via
+minimum_to_decode + TPU decode -> bit-exact data back. Plus the thrasher
+moves: kill/revive OSDs, recover onto new placements, fault injection.
+Reference anchors: ECBackend.cc:2154 (read path), OSDMap.cc:2591
+(placement), qa/tasks/ceph_manager.py:196 (thrasher), test-erasure-eio.sh.
+"""
+
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.hash import ceph_str_hash_rjenkins
+from ceph_tpu.crush import builder as cb
+from ceph_tpu.crush.types import BucketAlg, CrushMap, Tunables
+from ceph_tpu.osd import OSDMap, PgPool
+from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE
+from ceph_tpu.osd.types import TYPE_ERASURE, TYPE_REPLICATED
+from ceph_tpu.rados import MiniCluster
+
+EC_POOL, REP_POOL, CLAY_POOL = 1, 2, 3
+
+
+def build_cluster(n_hosts=8, per_host=3):
+    cmap = CrushMap(tunables=Tunables.jewel())
+    host_ids, host_weights, osd, bid = [], [], 0, -2
+    for _ in range(n_hosts):
+        items = list(range(osd, osd + per_host))
+        osd += per_host
+        b = cb.make_bucket(
+            cmap, bid, BucketAlg.STRAW2, 1, items, [0x10000] * per_host
+        )
+        host_ids.append(b.id)
+        host_weights.append(b.weight)
+        bid -= 1
+    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, host_ids, host_weights)
+    cb.make_simple_rule(cmap, 0, -1, 1, "indep", 0)
+    cb.make_simple_rule(cmap, 1, -1, 1, "firstn", 0)
+    m = OSDMap(crush=cmap, max_osd=cmap.max_devices)
+    m.pools[EC_POOL] = PgPool(
+        pg_num=16, size=6, type=TYPE_ERASURE, crush_rule=0
+    )
+    m.pools[REP_POOL] = PgPool(
+        pg_num=16, size=3, type=TYPE_REPLICATED, crush_rule=1
+    )
+    m.pools[CLAY_POOL] = PgPool(
+        pg_num=16, size=6, type=TYPE_ERASURE, crush_rule=0
+    )
+    return MiniCluster(
+        osdmap=m,
+        profiles={
+            EC_POOL: {"plugin": "isa", "k": "4", "m": "2", "technique": "cauchy"},
+            REP_POOL: None,
+            CLAY_POOL: {"plugin": "clay", "k": "4", "m": "2", "d": "5"},
+        },
+    )
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, np.uint8).tobytes()
+
+
+def test_str_hash_matches_reference_c():
+    """ceph_str_hash_rjenkins vs the compiled reference ceph_hash.cc."""
+    ref = "/root/reference/src/common/ceph_hash.cc"
+    if not os.path.exists(ref):
+        pytest.skip("reference checkout unavailable")
+    tmp = tempfile.mkdtemp(prefix="strhash_")
+    inc = os.path.join(tmp, "include")
+    os.makedirs(inc)
+    with open(os.path.join(inc, "types.h"), "w") as f:
+        f.write(
+            "#include <stdint.h>\n#include <stdbool.h>\n"
+            "typedef uint32_t __u32;\n"
+            "#define CEPH_STR_HASH_LINUX 0x1\n"
+            "#define CEPH_STR_HASH_RJENKINS 0x2\n"
+        )
+    main = os.path.join(tmp, "main.c")
+    with open(main, "w") as f:
+        f.write(
+            '#include <stdio.h>\n#include <string.h>\n'
+            'unsigned ceph_str_hash_rjenkins(const char *str, unsigned length);\n'
+            'int main(int argc, char **argv) {\n'
+            '  for (int i = 1; i < argc; i++)\n'
+            '    printf("%u\\n", ceph_str_hash_rjenkins(argv[i], strlen(argv[i])));\n'
+            '  return 0;\n}\n'
+        )
+    out = os.path.join(tmp, "strhash")
+    try:
+        subprocess.run(
+            ["gcc", "-O2", f"-I{tmp}", "-x", "c", ref, main, "-o", out],
+            check=True, capture_output=True,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        pytest.skip("cannot compile reference hash oracle")
+    names = ["", "a", "rbd_data.1", "object-123", "x" * 11, "y" * 12,
+             "a-much-longer-object-name-with-suffix.0000000000000004"]
+    names = [n for n in names if n]  # argv can't carry empty strings
+    got = subprocess.run(
+        [out] + names, capture_output=True, text=True, check=True
+    ).stdout.split()
+    for name, want in zip(names, got):
+        assert ceph_str_hash_rjenkins(name) == int(want), name
+
+
+def test_put_get_roundtrip_ec_and_replicated():
+    c = build_cluster()
+    for pool in (EC_POOL, REP_POOL):
+        for i in range(8):
+            data = payload(3000 + 517 * i, seed=i)
+            c.put(pool, f"obj-{i}", data)
+            assert c.get(pool, f"obj-{i}") == data
+
+
+def test_shards_land_where_crush_says():
+    c = build_cluster()
+    data = payload(4096, seed=1)
+    c.put(EC_POOL, "placed", data)
+    pg, acting = c.acting(EC_POOL, "placed")
+    assert len(acting) == 6
+    for shard, osd in enumerate(acting):
+        assert osd != CRUSH_ITEM_NONE
+        assert (EC_POOL, pg, "placed", shard) in c.stores[osd].objects
+    # no other store holds a shard of this object
+    for osd, store in c.stores.items():
+        if osd not in acting:
+            assert not any("placed" in k for k in store.objects)
+
+
+def test_degraded_read_after_killing_m_osds():
+    c = build_cluster()
+    data = payload(5000, seed=2)
+    c.put(EC_POOL, "victim", data)
+    _, acting = c.acting(EC_POOL, "victim")
+    for osd in acting[:2]:  # m = 2 losses, incl. shard 0
+        c.kill_osd(osd)
+    assert c.get(EC_POOL, "victim") == data
+    # a third loss makes the object unreadable -> error, not garbage
+    c.kill_osd(acting[2])
+    with pytest.raises(Exception):
+        c.get(EC_POOL, "victim")
+
+
+def test_degraded_read_uses_minimum_shards():
+    c = build_cluster()
+    data = payload(8192, seed=3)
+    c.put(EC_POOL, "minread", data)
+    _, acting = c.acting(EC_POOL, "minread")
+    for s in c.stores.values():
+        s.reads = 0
+    assert c.get(EC_POOL, "minread") == data
+    assert sum(s.reads for s in c.stores.values()) == 4  # k, not k+m
+    c.kill_osd(acting[1])
+    for s in c.stores.values():
+        s.reads = 0
+    assert c.get(EC_POOL, "minread") == data
+    assert sum(s.reads for s in c.stores.values()) == 4
+
+
+def test_eio_injection_recovers():
+    c = build_cluster()
+    data = payload(6000, seed=4)
+    c.put(EC_POOL, "eio-obj", data)
+    pg, acting = c.acting(EC_POOL, "eio-obj")
+    c.stores[acting[0]].eio_keys.add((EC_POOL, pg, "eio-obj", 0))
+    assert c.get(EC_POOL, "eio-obj") == data
+
+
+def test_transient_failures_are_retried():
+    c = build_cluster()
+    data = payload(4000, seed=5)
+    c.put(EC_POOL, "flaky", data)
+    for s in c.stores.values():
+        s.inject_transient_every = 4  # 1-in-4 ops fail once
+    for _ in range(10):
+        assert c.get(EC_POOL, "flaky") == data
+
+
+def test_thrash_kill_revive_recover():
+    """The thrasher loop: kill an OSD, re-place, rebuild, read everywhere."""
+    c = build_cluster()
+    objs = {f"t-{i}": payload(2048 + 777 * i, seed=10 + i) for i in range(6)}
+    for name, data in objs.items():
+        c.put(EC_POOL, name, data)
+    victim = c.acting(EC_POOL, "t-0")[1][0]
+    c.kill_osd(victim)
+    # degraded reads all still work
+    for name, data in objs.items():
+        assert c.get(EC_POOL, name) == data
+    # revive with amnesia; recovery rebuilds everything that moved/vanished
+    c.revive_osd(victim)
+    rebuilt = c.recover(EC_POOL)
+    assert rebuilt > 0
+    # now every acting shard is present on disk
+    for name in objs:
+        pg, acting = c.acting(EC_POOL, name)
+        for shard, osd in enumerate(acting):
+            if osd != CRUSH_ITEM_NONE:
+                assert (EC_POOL, pg, name, shard) in c.stores[osd].objects
+    for s in c.stores.values():
+        s.inject_transient_every = 0
+    for name, data in objs.items():
+        assert c.get(EC_POOL, name) == data
+
+
+def test_clay_recovery_reads_subchunk_fraction():
+    """Single-shard rebuild on a CLAY pool reads only sub_chunk_no/q of each
+    helper (the MSR contract, ErasureCodeClay.cc:363-393)."""
+    c = build_cluster()
+    ec = c.codec(CLAY_POOL)
+    chunk = ec.get_chunk_size(1)
+    data = payload(chunk * 4, seed=20)
+    c.put(CLAY_POOL, "msr", data)
+    pg, acting = c.acting(CLAY_POOL, "msr")
+    # drop exactly one shard from its store (the OSD stays up)
+    lost_shard, lost_osd = 3, acting[3]
+    del c.stores[lost_osd].objects[(CLAY_POOL, pg, "msr", lost_shard)]
+    for s in c.stores.values():
+        s.reads = s.bytes_read = 0
+    rebuilt = c.recover(CLAY_POOL)
+    assert rebuilt == 1
+    total_read = sum(s.bytes_read for s in c.stores.values())
+    frac = ec.get_sub_chunk_count() // ec.q
+    expected = ec.d * frac * (chunk // ec.get_sub_chunk_count())
+    assert total_read == expected
+    assert total_read < 4 * chunk  # strictly less than a naive k-chunk read
+    assert c.get(CLAY_POOL, "msr") == data
+
+
+def test_remap_after_permanent_loss():
+    """Kill an OSD for good: CRUSH re-places deterministically, recover()
+    rebuilds onto the new homes, then reads succeed with the old OSD gone."""
+    c = build_cluster()
+    data = payload(9000, seed=30)
+    c.put(EC_POOL, "migrate", data)
+    old_acting = c.acting(EC_POOL, "migrate")[1]
+    victim = old_acting[2]
+    c.kill_osd(victim)
+    # merely down -> positional hole, no re-placement yet (EC semantics)
+    assert c.acting(EC_POOL, "migrate")[1][2] == CRUSH_ITEM_NONE
+    # marking OUT (weight 0) re-runs CRUSH onto a replacement home
+    c.osdmap.mark_out(victim)
+    new_acting = c.acting(EC_POOL, "migrate")[1]
+    assert new_acting != old_acting
+    assert victim not in new_acting and CRUSH_ITEM_NONE not in new_acting
+    assert c.recover(EC_POOL) > 0
+    assert c.get(EC_POOL, "migrate") == data
+
+
+def test_recover_plans_around_eio_shards():
+    """EIO-poisoned shards must be excluded from the recovery read plan, and
+    a mid-read failure replans rather than aborting the pass."""
+    c = build_cluster()
+    data = payload(4096, seed=40)
+    c.put(EC_POOL, "eio-rec", data)
+    pg, acting = c.acting(EC_POOL, "eio-rec")
+    del c.stores[acting[0]].objects[(EC_POOL, pg, "eio-rec", 0)]
+    c.stores[acting[1]].eio_keys.add((EC_POOL, pg, "eio-rec", 1))
+    # both the deleted shard AND the poisoned one get rebuilt (scrub-repair
+    # semantics: an unreadable home counts as missing)
+    assert c.recover(EC_POOL) == 2
+    assert c.get(EC_POOL, "eio-rec") == data
+
+
+def test_replicated_recovery_uses_stray_copies():
+    """After a full remap (all acting OSDs marked out but alive), recovery
+    must find the surviving copies on previous-interval OSDs."""
+    c = build_cluster()
+    data = payload(2222, seed=41)
+    c.put(REP_POOL, "stray", data)
+    old_acting = c.acting(REP_POOL, "stray")[1]
+    for osd in old_acting:
+        c.osdmap.mark_out(osd)  # alive + up, just weightless
+    new_acting = c.acting(REP_POOL, "stray")[1]
+    assert not set(new_acting) & set(old_acting)
+    assert c.get(REP_POOL, "stray") == data  # stray fallback read
+    assert c.recover(REP_POOL) == len(new_acting)
+    pg = c.object_pg(REP_POOL, "stray")
+    for osd in new_acting:
+        assert (REP_POOL, pg, "stray") in c.stores[osd].objects
