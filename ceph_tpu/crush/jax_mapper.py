@@ -8,10 +8,13 @@ jitted batched kernel whose state is vectors over the x batch.
 
 Performance structure (all measured on v5e):
 
-  * one fused LN16 table: crush_ln has only 2^16 possible inputs, so the whole
-    `crush_ln(u) - 2^48` computation collapses into a single 64K-entry int64
-    gather — one gather per draw instead of three plus the fixed-point
-    arithmetic;
+  * gather-free crush_ln: XLA's TPU gather is ~1e8 lookups/s regardless of
+    table size, so the straw2 log rides the MXU instead — the RH/LH and LL
+    tables become u8-limb one-hot contractions (crush_ln_fast), bit-exact and
+    an order of magnitude faster than the LN16 gather it replaces;
+  * division-free weights: the truncating int64 divide by the 16.16 weight
+    becomes four small multiplies against compile-time magic constants
+    (_magic_arrays), exact for the full numerator range;
   * static-start specialization: the first descent level of a choose stage
     after TAKE uses the root bucket's exact-width arrays as compile-time
     constants (no row gather, no padding waste); deeper levels gather from a
@@ -173,13 +176,187 @@ def crush_ln(xin):
     return _ln16()[u] + (1 << 48)
 
 
-def straw2_draws(x, ids, rs, weights, valid):
+# -- gather-free crush_ln: table lookups as one-hot matmuls -------------------
+#
+# XLA's TPU gather runs at ~10^8 elements/s regardless of table size, which
+# made LN16[u] >90% of the whole mapper's runtime. The MXU, however, does a
+# one-hot contraction per lookup at >10^10/s. crush_ln's original structure
+# (mapper.c:248-264) uses three tiny tables (RH/LH interleaved in
+# __RH_LH_tbl, LL in __LL_tbl, crush_ln_table.h) indexed by the top 9 bits of
+# the normalized input and by one byte of the 64-bit product — so each lookup
+# becomes an exact one-hot matmul: indicator rows are {0,1}, table entries are
+# split into u8 limbs, and the int32 dot accumulates a single selected row
+# exactly. One-hot width is HBM traffic, so the 256-entry LL table folds to a
+# 64-wide lookup of 4 column blocks. Everything else is integer.
+
+def _limb_split_u8(arr: np.ndarray, n_limbs: int) -> np.ndarray:
+    a = np.asarray(arr, dtype=np.uint64)
+    return np.stack(
+        [((a >> np.uint64(8 * i)) & np.uint64(0xFF)) for i in range(n_limbs)],
+        axis=1,
+    ).astype(np.uint8)
+
+
+@functools.lru_cache(maxsize=1)
+def _ln_limb_tables():
+    rh_lh = np.asarray(RH_LH_TBL)
+    # RH and LH share the index, so one fused lookup fetches both:
+    # limbs 0..5 = RH - 1 (RH[0] = 2^48 exactly would need a 7th limb;
+    # RH >= 2^47 so RH-1 always fits 48 bits), limbs 6..11 = LH (< 2^48)
+    rhlh = np.concatenate(
+        [_limb_split_u8(rh_lh[0::2] - 1, 6), _limb_split_u8(rh_lh[1::2], 6)],
+        axis=1,
+    )  # (129, 12) u8
+    # LL (256 entries, < 2^43) reshaped for the 64-wide two-level lookup:
+    # row = index2 & 63, column block = index2 >> 6
+    ll = (
+        _limb_split_u8(np.asarray(LL_TBL), 6)      # (256, 6)
+        .reshape(4, 64, 6)
+        .transpose(1, 0, 2)
+        .reshape(64, 24)
+    )
+    return rhlh, ll
+
+
+def _onehot_limb_matmul(idx, limbs, width: int):
+    """idx (...,) int32 in [0, width) -> (..., L) exact int32 limb values.
+
+    XLA's TPU gather runs at ~1e8 lookups/s regardless of table size; a u8
+    one-hot contraction against a u8 limb table rides the MXU >10x faster and
+    is exact (one-hot rows select a single u8 row; int32 accumulation)."""
+    flat = idx.reshape(-1)  # 2-D dot avoids batched-matmul layout copies
+    oh = (flat[:, None] == jnp.arange(width, dtype=jnp.int32)).astype(
+        jnp.uint8
+    )
+    out = jax.lax.dot_general(
+        oh,
+        limbs,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return out.reshape(*idx.shape, limbs.shape[1])
+
+
+def _limbs_to_i64(out, lo: int, hi: int):
+    acc = out[..., lo].astype(jnp.int64)
+    for i in range(lo + 1, hi):
+        acc = acc + (out[..., i].astype(jnp.int64) << (8 * (i - lo)))
+    return acc
+
+
+def crush_ln_fast(u):
+    """Gather-free crush_ln over 16-bit inputs; bit-exact vs the LN16 table
+    (asserted exhaustively in tests). Mirrors mapper.c:248-264 step by step;
+    the two table reads ride the MXU as one-hot contractions: RH and LH fuse
+    into one 129-wide lookup, and the 256-entry LL table folds into a 64-wide
+    lookup of 4 column blocks + a block select (one-hot width is the HBM
+    traffic driver, so narrower beats wider)."""
+    rhlh_l, ll_l = _ln_limb_tables()
+    rhlh_l = jnp.asarray(rhlh_l)
+    ll_l = jnp.asarray(ll_l)
+    x = (u.astype(jnp.int32) & 0xFFFF) + 1  # [1, 0x10000]
+    # bit length via thresholds (x <= 2^16)
+    bl = jnp.zeros_like(x)
+    for k in range(1, 17):
+        bl = bl + (x >= (1 << k)).astype(jnp.int32)
+    bl = bl + 1
+    bits = jnp.where((x & 0x18000) == 0, 16 - bl, 0)
+    xn = x << bits  # normalized to [0x8000, 0x10000]
+    iexpon = (15 - bits).astype(jnp.int64)
+    xa = (xn >> 8) - 128  # [0, 128]
+    both = _onehot_limb_matmul(xa, rhlh_l, 129)
+    rh = _limbs_to_i64(both, 0, 6) + 1  # table stores RH - 1
+    lh = _limbs_to_i64(both, 6, 12)
+    xl64 = (xn.astype(jnp.uint64) * rh.astype(jnp.uint64)) >> jnp.uint64(48)
+    index2 = (xl64 & jnp.uint64(0xFF)).astype(jnp.int32)
+    ll24 = _onehot_limb_matmul(index2 & 63, ll_l, 64)  # (..., 4*6)
+    # block select as a where-chain: a one-hot multiply+reduce here would
+    # materialize an (..., 4, 6) int32 intermediate in HBM (gigabytes at
+    # mapping batch sizes); nested selects stay elementwise and fuse
+    blk = (index2 >> 6)[..., None]
+    ll6 = jnp.where(
+        blk == 0,
+        ll24[..., 0:6],
+        jnp.where(
+            blk == 1,
+            ll24[..., 6:12],
+            jnp.where(blk == 2, ll24[..., 12:18], ll24[..., 18:24]),
+        ),
+    )
+    lh = lh + _limbs_to_i64(ll6, 0, 6)
+    return (iexpon << 44) + (lh >> 4)
+
+
+def _magic_arrays(weights: np.ndarray):
+    """Per-slot exact-division magics for static 16.16 divisors.
+
+    For d >= 1 pick F = 48 + bitlen(d), m = ceil(2^F / d); then for any
+    0 <= n <= 2^48, floor(n/d) == floor(n*m / 2^F) (e = m*d - 2^F < d, so
+    n*e <= (d-1)*2^48 < 2^F). The straw2 numerator -ln is <= 2^48, so the
+    emulated 64-bit divide becomes four small multiplies at runtime."""
+    d = np.maximum(np.asarray(weights, dtype=np.int64), 1)
+    bl = np.zeros_like(d)
+    v = d.copy()
+    while np.any(v):
+        bl += (v > 0)
+        v >>= 1
+    m = np.zeros_like(d)
+    flat_d, flat_m = d.reshape(-1), m.reshape(-1)
+    # python bignum (2^F overflows int64), memoized: real maps repeat a
+    # handful of distinct weights across slots/positions/padding
+    magic_of: dict[int, int] = {}
+    for i in range(flat_d.size):
+        di = int(flat_d[i])
+        mi = magic_of.get(di)
+        if mi is None:
+            F = 48 + di.bit_length()
+            mi = magic_of[di] = (2**F + di - 1) // di
+        flat_m[i] = mi
+    return flat_m.reshape(d.shape), (bl - 1).astype(np.int32)
+
+
+def _magic_div(n, m, s):
+    """floor(n/d) for 0 <= n <= 2^48 via the compile-time magic (m, s).
+
+    128-bit product emulated in int64 limbs: with n = n_hi*2^24 + n_lo and
+    m = m_hi*2^25 + m_lo (m <= 2^49), every intermediate stays < 2^63 and
+    q = (n_hi*m_hi + T>>25) >> s, T = n_hi*m_lo + 2*n_lo*m_hi + (n_lo*m_lo
+    >> 24), equals floor(n*m / 2^(48+bitlen(d))) exactly."""
+    n_hi, n_lo = n >> 24, n & ((1 << 24) - 1)
+    m_hi, m_lo = m >> 25, m & ((1 << 25) - 1)
+    t = n_hi * m_lo + ((n_lo * m_hi) << 1) + ((n_lo * m_lo) >> 24)
+    return (n_hi * m_hi + (t >> 25)) >> s.astype(jnp.int64)
+
+
+def argmax_draws(draws):
+    """First-index argmax over int64 draws via 32-bit reductions.
+
+    XLA's s64 argmax lowers to a slow (value, index) pair reduce with
+    bitcast tricks; splitting into a hi-word max, a masked unsigned lo-word
+    max, and a u8 first-true argmax keeps every reduction 32-bit. For equal
+    hi words, unsigned lo comparison matches s64 order (two's complement)."""
+    hi = (draws >> 32).astype(jnp.int32)
+    lo = (draws & 0xFFFFFFFF).astype(jnp.uint32)
+    max_hi = jnp.max(hi, axis=-1, keepdims=True)
+    cand = hi == max_hi
+    lo_m = jnp.where(cand, lo, jnp.uint32(0))
+    max_lo = jnp.max(lo_m, axis=-1, keepdims=True)
+    winner = cand & (lo_m == max_lo)
+    return jnp.argmax(winner, axis=-1)
+
+
+def straw2_draws(x, ids, rs, weights, valid, magic=None):
     """Broadcast draws; weights 16.16 int64; zero weight or invalid slot ->
-    S64_MIN (mapper.c:361)."""
+    S64_MIN (mapper.c:361). `magic` carries the compile-time (m, s) arrays
+    turning the truncating int64 division — by far the costliest VPU op —
+    into four small multiplies (see _magic_arrays)."""
     u = (hash32_3(x, ids, rs) & jnp.uint32(0xFFFF)).astype(jnp.int32)
-    ln = _ln16()[u]
-    w = jnp.maximum(weights, 1)
-    draw = -((-ln) // w)  # truncating division (ln <= 0, w > 0)
+    ln = crush_ln_fast(u) - (1 << 48)  # always <= 0
+    if magic is not None:
+        draw = -_magic_div(-ln, magic[0], magic[1])
+    else:
+        w = jnp.maximum(weights, 1)
+        draw = -((-ln) // w)  # truncating division (ln <= 0, w > 0)
     return jnp.where(valid & (weights > 0), draw, jnp.int64(_S64_MIN))
 
 
@@ -199,6 +376,8 @@ class CompiledMap:
     items: jnp.ndarray        # (B, S_inner) int32: member ids
     ids: jnp.ndarray          # (B, P, S_inner) int32: straw2 hash ids
     weights: jnp.ndarray      # (B, P, S_inner) int64: 16.16 weights
+    magic_m: jnp.ndarray      # (B, P, S_inner) int64: division magic multiplier
+    magic_s: jnp.ndarray      # (B, P, S_inner) int32: division magic shift
     sizes: jnp.ndarray        # (B,) int32
     row_of: jnp.ndarray       # (max_buckets,) int32: -1-id -> row (or -1)
     type_of_bucket: jnp.ndarray  # (B,) int32
@@ -206,7 +385,8 @@ class CompiledMap:
     n_positions: int          # P (1 unless choose_args weight_set present)
     depth: int                # longest root->device chain
     source: CrushMap
-    exact: dict = field(default_factory=dict)  # bid -> (items, ids, weights)
+    # bid -> (items, ids, weights, size, magic_m, magic_s) at exact width
+    exact: dict = field(default_factory=dict)
 
     @property
     def max_size(self) -> int:
@@ -245,7 +425,8 @@ def _hierarchy_depth(cmap: CrushMap) -> int:
 
 
 def _bucket_arrays(cmap: CrushMap, bid: int, p: int, width: int):
-    """(items, ids, weights) padded to `width`, honoring choose_args."""
+    """(items, ids, weights, magic_m, magic_s) padded to `width`, honoring
+    choose_args; the magics drive the exact weight division (_magic_div)."""
     b = cmap.buckets[bid]
     s = b.size
     items = np.zeros(width, dtype=np.int32)
@@ -262,7 +443,8 @@ def _bucket_arrays(cmap: CrushMap, bid: int, p: int, width: int):
         if arg is not None and arg.weight_set is not None:
             w = arg.weight_set[min(pos, len(arg.weight_set) - 1)]
         weights[pos, :s] = w
-    return items, ids, weights
+    magic_m, magic_s = _magic_arrays(weights)
+    return items, ids, weights, magic_m, magic_s
 
 
 def compile_map(cmap: CrushMap, positions: int = 0) -> CompiledMap:
@@ -299,6 +481,8 @@ def compile_map(cmap: CrushMap, positions: int = 0) -> CompiledMap:
     items = np.zeros((nb, smax_inner), dtype=np.int32)
     ids = np.zeros((nb, p, smax_inner), dtype=np.int32)
     weights = np.zeros((nb, p, smax_inner), dtype=np.int64)
+    magic_m = np.zeros((nb, p, smax_inner), dtype=np.int64)
+    magic_s = np.zeros((nb, p, smax_inner), dtype=np.int32)
     sizes = np.zeros(nb, dtype=np.int32)
     types = np.zeros(nb, dtype=np.int32)
     row_of = np.full(max((-b for b in rows), default=1), -1, dtype=np.int32)
@@ -309,16 +493,19 @@ def compile_map(cmap: CrushMap, positions: int = 0) -> CompiledMap:
         sizes[row] = min(b.size, smax_inner)
         types[row] = b.type
         if b.size <= smax_inner:
-            it, id_, w = _bucket_arrays(cmap, bid, p, smax_inner)
+            it, id_, w, mm, ms = _bucket_arrays(cmap, bid, p, smax_inner)
             items[row], ids[row], weights[row] = it, id_, w
+            magic_m[row], magic_s[row] = mm, ms
         # every bucket also gets an exact-width copy for static starts
         width = max(b.size, 1)
-        it, id_, w = _bucket_arrays(cmap, bid, p, width)
+        it, id_, w, mm, ms = _bucket_arrays(cmap, bid, p, width)
         exact[bid] = (
             jnp.asarray(it),
             jnp.asarray(id_),
             jnp.asarray(w),
             b.size,
+            jnp.asarray(mm),
+            jnp.asarray(ms),
         )
         row_of[-1 - bid] = row
 
@@ -326,6 +513,8 @@ def compile_map(cmap: CrushMap, positions: int = 0) -> CompiledMap:
         items=jnp.asarray(items),
         ids=jnp.asarray(ids),
         weights=jnp.asarray(weights),
+        magic_m=jnp.asarray(magic_m),
+        magic_s=jnp.asarray(magic_s),
         sizes=jnp.asarray(sizes),
         row_of=jnp.asarray(row_of),
         type_of_bucket=jnp.asarray(types),
@@ -345,34 +534,38 @@ def _straw2_choose_inner(cm: CompiledMap, rows, xs, rs, positions):
     if cm.n_positions == 1:
         ids = cm.ids[rows, 0]        # (N, S_inner)
         ws = cm.weights[rows, 0]
+        mg = (cm.magic_m[rows, 0], cm.magic_s[rows, 0])
     else:
         pos = jnp.minimum(positions, cm.n_positions - 1)
         ids = cm.ids[rows, pos]
         ws = cm.weights[rows, pos]
+        mg = (cm.magic_m[rows, pos], cm.magic_s[rows, pos])
     lane = jnp.arange(cm.max_size)[None, :]
     valid = lane < cm.sizes[rows][:, None]
     draws = straw2_draws(
-        xs[:, None], ids, rs[:, None].astype(jnp.int32), ws, valid
+        xs[:, None], ids, rs[:, None].astype(jnp.int32), ws, valid, mg
     )
-    idx = jnp.argmax(draws, axis=1)
+    idx = argmax_draws(draws)
     return cm.items[rows, idx]
 
 
 def _straw2_choose_static(cm: CompiledMap, bid: int, xs, rs, positions):
     """Static bucket id -> (N,) chosen items; exact width, no row gather."""
-    items, ids, weights, size = cm.exact[bid]
+    items, ids, weights, size, magic_m, magic_s = cm.exact[bid]
     if cm.n_positions == 1:
         ids_b = ids[0][None, :]
         ws_b = weights[0][None, :]
+        mg_b = (magic_m[0][None, :], magic_s[0][None, :])
     else:
         pos = jnp.minimum(positions, cm.n_positions - 1)
         ids_b = ids[pos]              # (N, S) via position gather
         ws_b = weights[pos]
+        mg_b = (magic_m[pos], magic_s[pos])
     valid = jnp.arange(items.shape[0])[None, :] < size
     draws = straw2_draws(
-        xs[:, None], ids_b, rs[:, None].astype(jnp.int32), ws_b, valid
+        xs[:, None], ids_b, rs[:, None].astype(jnp.int32), ws_b, valid, mg_b
     )
-    return items[jnp.argmax(draws, axis=1)]
+    return items[argmax_draws(draws)]
 
 
 def _item_lookup_b(cm: CompiledMap, item):

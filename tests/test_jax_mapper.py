@@ -48,6 +48,16 @@ def test_crush_ln_matches_scalar():
     assert np.array_equal(got, want)
 
 
+def test_crush_ln_fast_exhaustive():
+    # the gather-free one-hot-matmul formulation must equal the LN16 table
+    # (and hence the scalar crush_ln) for every 16-bit input
+    jm._require_x64()
+    us = np.arange(0, 0x10000, dtype=np.int32)
+    got = np.asarray(jm.jax.jit(jm.crush_ln_fast)(jm.jnp.asarray(us)))
+    want = np.asarray(jm._ln16()) + (1 << 48)
+    assert np.array_equal(got, want)
+
+
 def test_hash_matches_scalar():
     from ceph_tpu.crush.hash import crush_hash32_2, crush_hash32_3
 
