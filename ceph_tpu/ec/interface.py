@@ -27,7 +27,8 @@ tensors — that is where stripes from many objects get packed into one launch.
 from __future__ import annotations
 
 import errno
-from typing import Iterable, Mapping, Sequence
+from collections import OrderedDict
+from typing import Callable, Iterable, Mapping, Sequence
 
 import numpy as np
 
@@ -42,6 +43,33 @@ class ErasureCodeError(Exception):
     def __init__(self, code: int, message: str):
         super().__init__(message)
         self.code = code
+
+
+class DecodeTableCache:
+    """LRU memo for per-erasure-signature decode tables — the analogue of
+    the reference's ErasureCodeIsaTableCache (LRU keyed on the erasure
+    signature, ErasureCodeIsaTableCache.cc:234-296). Shared by every codec
+    that inverts a matrix per erasure pattern."""
+
+    #: reference LRU is sized for <=(12,4) patterns
+    DEFAULT_CAPACITY = 256
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._entries: OrderedDict = OrderedDict()
+        self._capacity = capacity
+
+    def get_or(self, key, build: Callable):
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+            return entry
+        entry = self._entries[key] = build()
+        if len(self._entries) > self._capacity:
+            self._entries.popitem(last=False)
+        return entry
+
+    def clear(self) -> None:
+        self._entries.clear()
 
 
 def profile_to_int(profile: ErasureCodeProfile, name: str, default: int) -> int:

@@ -62,12 +62,28 @@ class ErasureCodePluginRegistry:
 registry = ErasureCodePluginRegistry()
 
 
+class _JerasureSelector:
+    """Technique-dispatching factory for plugin=jerasure: the matrix
+    techniques live in ErasureCodeRs, the liberation-family pure-bitmatrix
+    techniques in ErasureCodeBitmatrix (the reference's plugin factory
+    similarly switches on technique, ErasureCodePluginJerasure.cc)."""
+
+    def init(self, profile):
+        from ceph_tpu.ec.bitmatrix import BUILDERS, ErasureCodeBitmatrix
+        from ceph_tpu.ec.rs import ErasureCodeRs
+
+        technique = profile.get("technique", "reed_sol_van")
+        if technique in BUILDERS:
+            return ErasureCodeBitmatrix(technique).init(profile)
+        return ErasureCodeRs("jerasure").init(profile)
+
+
 def _register_builtin() -> None:
     from ceph_tpu.ec.rs import ErasureCodeRs
     from ceph_tpu.ec.shec import ErasureCodeShec
 
     registry.add("tpu", lambda: ErasureCodeRs("tpu"))
-    registry.add("jerasure", lambda: ErasureCodeRs("jerasure"))
+    registry.add("jerasure", _JerasureSelector)
     registry.add("isa", lambda: ErasureCodeRs("isa"))
     registry.add("shec", ErasureCodeShec)
 

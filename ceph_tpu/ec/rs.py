@@ -17,7 +17,6 @@ Parameter envelopes mirror the reference:
 from __future__ import annotations
 
 import errno
-from collections import OrderedDict
 from typing import Sequence
 
 import jax
@@ -27,6 +26,7 @@ import numpy as np
 from ceph_tpu.ec import matrices
 from ceph_tpu.ec.interface import (
     SIMD_ALIGN,
+    DecodeTableCache,
     ErasureCode,
     ErasureCodeError,
     align_up,
@@ -41,7 +41,6 @@ from ceph_tpu.ops import gf_pallas as gp
 from ceph_tpu.ops.gf import matrix_to_bitmatrix
 
 LARGEST_VECTOR_WORDSIZE = 16  # reference: ErasureCodeJerasure.cc:30
-DECODE_TABLE_CACHE_SIZE = 256  # reference LRU is sized for <=(12,4) patterns
 
 
 class ErasureCodeRs(ErasureCode):
@@ -78,7 +77,7 @@ class ErasureCodeRs(ErasureCode):
         self._gen: np.ndarray | None = None
         self._encode_bits: jnp.ndarray | None = None
         self._encode_packed: jnp.ndarray | None = None
-        self._decode_cache: OrderedDict[tuple, tuple] = OrderedDict()
+        self._decode_cache = DecodeTableCache()
 
     # -- profile ------------------------------------------------------------
 
@@ -185,23 +184,18 @@ class ErasureCodeRs(ErasureCode):
         """Memoized decode matrices for an erasure signature: a (bitplane,
         packed) pair — the TPU analogue of the reference's LRU decode-table
         cache (ErasureCodeIsaTableCache.cc:234-296)."""
+        def build():
+            dm = matrices.decode_matrix(
+                self._gen, self.k, list(present), list(targets)
+            )
+            bits_np = matrix_to_bitmatrix(dm)
+            # cache HOST arrays: entries may be created while tracing under
+            # jit, where a device array would be a leaked tracer; as numpy
+            # constants they fold into the compiled program at each use site
+            return (bits_np.astype(np.int8), gp.pack_matrix(bits_np))
+
         key = (tuple(present[: self.k]), tuple(targets))
-        cached = self._decode_cache.get(key)
-        if cached is not None:
-            self._decode_cache.move_to_end(key)
-            return cached
-        dm = matrices.decode_matrix(
-            self._gen, self.k, list(present), list(targets)
-        )
-        bits_np = matrix_to_bitmatrix(dm)
-        # cache HOST arrays: entries may be created while tracing under jit,
-        # where a device array would be a leaked tracer; as numpy constants
-        # they fold into the compiled program at each use site
-        entry = (bits_np.astype(np.int8), gp.pack_matrix(bits_np))
-        self._decode_cache[key] = entry
-        if len(self._decode_cache) > DECODE_TABLE_CACHE_SIZE:
-            self._decode_cache.popitem(last=False)
-        return entry
+        return self._decode_cache.get_or(key, build)
 
     def decode_array(self, present, targets, survivors) -> np.ndarray:
         if len(present) < self.k:

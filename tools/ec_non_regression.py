@@ -41,6 +41,12 @@ DEFAULT_PROFILES: list[tuple[str, dict, int]] = [
     ("jerasure", {"k": "4", "m": "2", "technique": "reed_sol_r6_op"}, 4096),
     ("jerasure", {"k": "4", "m": "2", "technique": "cauchy_orig"}, 4096),
     ("jerasure", {"k": "4", "m": "2", "technique": "cauchy_good"}, 4096),
+    ("jerasure", {"k": "4", "m": "2", "w": "5", "technique": "liberation",
+                  "packetsize": "32"}, 4096),
+    ("jerasure", {"k": "4", "m": "2", "w": "6", "technique": "blaum_roth",
+                  "packetsize": "32"}, 4096),
+    ("jerasure", {"k": "6", "m": "2", "technique": "liber8tion",
+                  "packetsize": "32"}, 4096),
     ("isa", {"k": "8", "m": "3", "technique": "cauchy"}, 4096),
     ("isa", {"k": "8", "m": "3", "technique": "reed_sol_van"}, 4096),
     ("shec", {"k": "4", "m": "3", "c": "2"}, 4096),
