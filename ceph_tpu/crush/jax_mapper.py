@@ -1034,7 +1034,7 @@ def _assemble_blocks(blocks, n: int, result_max: int) -> np.ndarray:
                 write = pos < result_max
             out[rows[write], pos[write]] = col[write]
             pos[write] += 1
-    return out
+    return out, pos.astype(np.int32)
 
 
 def _map_rule_chunk(compiled, rule, tunables, xs, weight_vec, result_max):
@@ -1162,6 +1162,7 @@ def map_rule(
     weight,
     result_max: int,
     chunk: int = DEFAULT_CHUNK,
+    return_lengths: bool = False,
 ):
     """Evaluate one rule for a whole batch of x on device.
 
@@ -1170,6 +1171,10 @@ def map_rule(
     indep results are positional (NONE holes kept). Launches are chunked (and
     the tail padded to the chunk size) so arbitrary N reuses one compiled
     executable per stage.
+
+    return_lengths=True additionally returns the (N,) per-row emitted result
+    length — the reference result vector's size, which distinguishes an indep
+    row's trailing NONE holes (inside the result) from padding (outside it).
     """
     _require_x64()
     cmap = compiled.source
@@ -1178,6 +1183,7 @@ def map_rule(
     weight_vec = jnp.asarray(np.asarray(weight, dtype=np.int64))
 
     pieces = []
+    len_pieces = []
     for lo in range(0, len(xs), chunk):
         part = xs[lo : lo + chunk]
         pad = 0
@@ -1188,10 +1194,19 @@ def map_rule(
             compiled, rule, cmap.tunables, jnp.asarray(part), weight_vec,
             result_max,
         )
-        res = _assemble_blocks(blocks, len(part), result_max)
+        res, lens = _assemble_blocks(blocks, len(part), result_max)
         pieces.append(res[: len(part) - pad] if pad else res)
-    return (
+        len_pieces.append(lens[: len(part) - pad] if pad else lens)
+    out = (
         np.concatenate(pieces, axis=0)
         if pieces
         else np.zeros((0, result_max), np.int32)
     )
+    if return_lengths:
+        lengths = (
+            np.concatenate(len_pieces)
+            if len_pieces
+            else np.zeros(0, np.int32)
+        )
+        return out, lengths
+    return out

@@ -141,6 +141,15 @@ class CrushMap:
     # name/type maps (CrushWrapper): id -> name, type id -> type name
     type_names: dict[int, str] = field(default_factory=dict)
     item_names: dict[int, str] = field(default_factory=dict)
+    rule_names: dict[int, str] = field(default_factory=dict)
+    # device id -> class name (CrushWrapper class_map; informational until
+    # shadow hierarchies are implemented)
+    device_classes: dict[int, str] = field(default_factory=dict)
+    # every named choose_args map from the text grammar (choose_args <id>);
+    # `choose_args` above is the active one the mapper consumes
+    choose_args_maps: dict[int, dict[int, ChooseArg]] = field(
+        default_factory=dict
+    )
 
     @property
     def max_buckets(self) -> int:
