@@ -16,7 +16,8 @@ Subpackages:
   osd      — cluster map (OSDMap placement pipeline, balancer) + MemStore
   rados    — MiniCluster: the end-to-end striped data path (put/get,
              degraded reads, recovery, fault injection)
-  common   — shared runtime pieces (object-name hashes; config/perf to come)
+  common   — L0 runtime: hashes, typed config schema, perf counters,
+             admin commands + op tracker
   parallel — device-mesh sharding helpers (shard_map over stripe batches)
 """
 

@@ -1,2 +1,9 @@
-"""Common runtime utilities (the L0 layer analogue: src/common in the
-reference). Grows config/perf-counter subsystems as the framework widens."""
+"""Common runtime (the L0 layer analogue: src/common in the reference):
+
+  hash          — ceph_str_hash_rjenkins (object name -> ps)
+  config        — typed option schema + layered resolution + observers
+                  (options.cc / config_proxy.h / config_obs.h)
+  perf_counters — PerfCounters blocks with perf-dump JSON (perf_counters.h)
+  admin         — admin command hub + TrackedOp/OpTracker op timeline
+                  (admin_socket.cc, TrackedOp.h)
+"""

@@ -1,0 +1,150 @@
+"""Admin command hub + in-flight op tracker.
+
+Two small pieces of the reference's observability plumbing:
+
+  * `AdminCommands` — the admin-socket command table
+    (/root/reference/src/common/admin_socket.cc: per-daemon unix socket
+    answering `ceph daemon <name> <cmd>`). In-process here (no socket): the
+    built-ins `perf dump`, `perf schema`, `config show`, `config get/set`,
+    and `dump_ops_in_flight`/`dump_historic_ops` return the same JSON trees;
+    subsystems register extra handlers by prefix.
+  * `OpTracker` / `TrackedOp` — the always-on per-op event timeline
+    (/root/reference/src/common/TrackedOp.h:102,201): ops mark named events
+    with timestamps, land in a bounded history ring on completion, and
+    anything alive longer than `slow_op_seconds` is reported by
+    dump_ops_in_flight — the "slow request" mechanism.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ceph_tpu.common.config import config as global_config
+from ceph_tpu.common.perf_counters import collection as global_perf
+
+
+@dataclass
+class TrackedOp:
+    description: str
+    start: float = field(default_factory=time.time)
+    events: list[tuple[float, str]] = field(default_factory=list)
+    done: float | None = None
+
+    def mark_event(self, event: str) -> None:
+        self.events.append((time.time(), event))
+
+    @property
+    def duration(self) -> float:
+        return (self.done or time.time()) - self.start
+
+    def dump(self) -> dict[str, Any]:
+        return {
+            "description": self.description,
+            "initiated_at": self.start,
+            "age": self.duration,
+            "events": [
+                {"time": t, "event": e} for t, e in self.events
+            ],
+        }
+
+
+class OpTracker:
+    def __init__(self, history_size: int = 20, slow_op_seconds: float = 30.0):
+        self.history_size = history_size
+        self.slow_op_seconds = slow_op_seconds
+        self._in_flight: dict[int, TrackedOp] = {}
+        self._history: deque[TrackedOp] = deque(maxlen=history_size)
+        self._next_id = 0
+
+    def create(self, description: str) -> tuple[int, TrackedOp]:
+        op = TrackedOp(description)
+        op_id = self._next_id
+        self._next_id += 1
+        self._in_flight[op_id] = op
+        return op_id, op
+
+    def finish(self, op_id: int) -> None:
+        op = self._in_flight.pop(op_id, None)
+        if op is not None:
+            op.done = time.time()
+            self._history.append(op)
+
+    def track(self, description: str) -> "_TrackCtx":
+        """Context manager tracking one op."""
+        return _TrackCtx(self, description)
+
+    def dump_ops_in_flight(self) -> dict[str, Any]:
+        ops = [op.dump() for op in self._in_flight.values()]
+        slow = [o for o in ops if o["age"] >= self.slow_op_seconds]
+        return {"num_ops": len(ops), "ops": ops, "num_slow_ops": len(slow)}
+
+    def dump_historic_ops(self) -> dict[str, Any]:
+        return {
+            "num_ops": len(self._history),
+            "ops": [op.dump() for op in self._history],
+        }
+
+
+class _TrackCtx:
+    __slots__ = ("_tracker", "_description", "_op_id")
+
+    def __init__(self, tracker: OpTracker, description: str):
+        self._tracker = tracker
+        self._description = description
+
+    def __enter__(self) -> TrackedOp:
+        self._op_id, op = self._tracker.create(self._description)
+        return op
+
+    def __exit__(self, *exc):
+        self._tracker.finish(self._op_id)
+        return False
+
+
+class AdminCommands:
+    """Command-string -> handler table with the reference's built-ins."""
+
+    def __init__(self, perf=None, config=None, op_tracker: OpTracker | None = None):
+        self._perf = perf if perf is not None else global_perf
+        self._config = config if config is not None else global_config
+        self._tracker = op_tracker or OpTracker()
+        self._handlers: dict[str, Callable[..., Any]] = {}
+        self.register("perf dump", lambda: self._perf.dump())
+        self.register("perf schema", lambda: self._perf.schema())
+        self.register("config show", lambda: self._config.show())
+        self.register("config get", lambda name: {
+            name: self._config.get(name)
+        })
+        self.register("config set", self._config_set)
+        self.register(
+            "dump_ops_in_flight", self._tracker.dump_ops_in_flight
+        )
+        self.register("dump_historic_ops", self._tracker.dump_historic_ops)
+
+    @property
+    def op_tracker(self) -> OpTracker:
+        return self._tracker
+
+    def _config_set(self, name: str, *value_parts: str) -> dict[str, str]:
+        # accept space-containing values from the single-string dispatch
+        # form ("config set <name> plugin=tpu k=8 m=3")
+        self._config.set(name, " ".join(str(v) for v in value_parts))
+        return {"success": f"{name} = {self._config.get(name)}"}
+
+    def register(self, command: str, handler: Callable[..., Any]) -> None:
+        self._handlers[command] = handler
+
+    def handle(self, command: str, *args: str) -> Any:
+        """Dispatch `command` (longest-prefix match so 'config set x y'
+        parses as command 'config set' + args)."""
+        if command in self._handlers:
+            return self._handlers[command](*args)
+        parts = command.split()
+        for take in range(len(parts) - 1, 0, -1):
+            prefix = " ".join(parts[:take])
+            if prefix in self._handlers:
+                return self._handlers[prefix](*parts[take:], *args)
+        raise KeyError(f"unknown admin command {command!r}")

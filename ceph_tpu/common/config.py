@@ -1,0 +1,222 @@
+"""Typed config schema + layered resolution — the reference's option system.
+
+Re-expresses /root/reference/src/common/options.cc (1535 `Option(...)` schema
+entries with type/level/default/min-max/description/see_also) and
+config_proxy.h/config_obs.h:
+
+  * `Option` — one typed schema entry (TYPE_*, LEVEL_basic/advanced/dev,
+    default, optional min/max, description, see_also);
+  * `SCHEMA` — the framework's option inventory: every knob a subsystem
+    actually reads lives here, so `config show` is the source of truth
+    (the reference's EC/CRUSH/injection-relevant entries are mirrored by
+    name: erasure_code_dir options.cc:533, osd_erasure_code_plugins 2519,
+    osd_pool_default_erasure_code_profile, ms_inject_* 1044-1066,
+    heartbeat_inject_failure 822);
+  * `Config` — layered resolution: compiled default < config file values <
+    environment (CEPH_TPU_<NAME>) < runtime `set` (mon/admin-socket tier);
+    typed parsing + range validation on every write;
+  * observers — `md_config_obs_t`-style callbacks fired on runtime changes
+    (config_obs.h), keyed by option name.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+TYPE_UINT = "uint"
+TYPE_INT = "int"
+TYPE_STR = "str"
+TYPE_FLOAT = "float"
+TYPE_BOOL = "bool"
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Option:
+    name: str
+    type: str
+    level: str
+    default: Any
+    description: str = ""
+    min: float | None = None
+    max: float | None = None
+    see_also: tuple[str, ...] = ()
+
+    def parse(self, value: Any) -> Any:
+        try:
+            if self.type == TYPE_BOOL:
+                if isinstance(value, str):
+                    if value.lower() in ("true", "1", "yes", "on"):
+                        return True
+                    if value.lower() in ("false", "0", "no", "off"):
+                        return False
+                    raise ConfigError(f"{self.name}: bad bool {value!r}")
+                return bool(value)
+            if self.type in (TYPE_UINT, TYPE_INT):
+                v = int(value)
+                if self.type == TYPE_UINT and v < 0:
+                    raise ConfigError(f"{self.name}: must be >= 0")
+            elif self.type == TYPE_FLOAT:
+                v = float(value)
+            else:
+                return str(value)
+        except (TypeError, ValueError) as e:
+            raise ConfigError(f"{self.name}: {e}") from None
+        if self.min is not None and v < self.min:
+            raise ConfigError(f"{self.name}: {v} < min {self.min}")
+        if self.max is not None and v > self.max:
+            raise ConfigError(f"{self.name}: {v} > max {self.max}")
+        return v
+
+
+def _opt(name, type_, level, default, desc="", **kw):
+    return Option(name, type_, level, default, desc, **kw)
+
+
+#: the option inventory (names shared with the reference where the concept
+#: maps 1:1, so operators can carry their mental model over)
+SCHEMA: dict[str, Option] = {
+    o.name: o
+    for o in [
+        # erasure code (options.cc:533, 2519)
+        _opt("erasure_code_dir", TYPE_STR, LEVEL_ADVANCED, "",
+             "unused placeholder: plugins are python entry points here"),
+        _opt("osd_erasure_code_plugins", TYPE_STR, LEVEL_ADVANCED,
+             "jerasure isa lrc shec clay tpu",
+             "plugins allowed in profiles"),
+        _opt("osd_pool_default_erasure_code_profile", TYPE_STR,
+             LEVEL_ADVANCED,
+             "plugin=tpu technique=isa_cauchy k=8 m=3",
+             "default EC profile for new pools"),
+        # placement / mapping
+        _opt("crush_chunk_size", TYPE_UINT, LEVEL_DEV, 65536,
+             "x batch per device launch in the vectorized mapper"),
+        # fault injection (options.cc:1044-1066, 822)
+        _opt("ms_inject_socket_failures", TYPE_UINT, LEVEL_DEV, 0,
+             "inject a transient store failure every Nth op"),
+        _opt("ms_inject_delay_probability", TYPE_FLOAT, LEVEL_DEV, 0.0,
+             "probability of injecting a delay per op", min=0.0, max=1.0),
+        _opt("ms_inject_delay_max", TYPE_FLOAT, LEVEL_DEV, 1.0,
+             "max injected delay (seconds)"),
+        _opt("ms_inject_internal_delays", TYPE_FLOAT, LEVEL_DEV, 0.0,
+             "inject internal delays to induce races (seconds)"),
+        _opt("heartbeat_inject_failure", TYPE_UINT, LEVEL_DEV, 0,
+             "inject heartbeat failures for N seconds"),
+        _opt("objecter_inject_no_watch_ping", TYPE_BOOL, LEVEL_DEV, False,
+             "suppress watch pings"),
+        # data path
+        _opt("osd_pool_default_size", TYPE_UINT, LEVEL_BASIC, 3,
+             "replicas per replicated pool"),
+        _opt("osd_pool_default_pg_num", TYPE_UINT, LEVEL_BASIC, 32,
+             "PGs per new pool"),
+        _opt("osd_recovery_max_active", TYPE_UINT, LEVEL_ADVANCED, 3,
+             "concurrent recovery ops per OSD"),
+        _opt("osd_heartbeat_grace", TYPE_UINT, LEVEL_ADVANCED, 20,
+             "seconds before an unresponsive OSD is reported down"),
+        # bench / profiling
+        _opt("bench_profile_trace_dir", TYPE_STR, LEVEL_DEV, "",
+             "write jax.profiler traces here when set",
+             see_also=("bench_profile",)),
+        _opt("bench_profile", TYPE_BOOL, LEVEL_DEV, False,
+             "capture a jax.profiler trace around benchmark loops"),
+    ]
+}
+
+
+class Config:
+    """Layered, observed, typed configuration (config_proxy.h analogue)."""
+
+    ENV_PREFIX = "CEPH_TPU_"
+
+    def __init__(self, schema: dict[str, Option] | None = None):
+        self.schema = schema if schema is not None else SCHEMA
+        self._file: dict[str, Any] = {}
+        self._runtime: dict[str, Any] = {}
+        self._observers: dict[str, list[Callable[[str, Any], None]]] = {}
+
+    # -- reads --------------------------------------------------------------
+
+    def _opt(self, name: str) -> Option:
+        opt = self.schema.get(name)
+        if opt is None:
+            raise ConfigError(f"unknown option {name!r}")
+        return opt
+
+    def get(self, name: str) -> Any:
+        opt = self._opt(name)
+        if name in self._runtime:
+            return self._runtime[name]
+        env = os.environ.get(self.ENV_PREFIX + name.upper())
+        if env is not None:
+            return opt.parse(env)
+        if name in self._file:
+            return self._file[name]
+        return opt.default
+
+    def source_of(self, name: str) -> str:
+        self._opt(name)
+        if name in self._runtime:
+            return "override"
+        if os.environ.get(self.ENV_PREFIX + name.upper()) is not None:
+            return "env"
+        if name in self._file:
+            return "file"
+        return "default"
+
+    # -- writes -------------------------------------------------------------
+
+    def set(self, name: str, value: Any) -> None:
+        """Runtime override (the mon/injectargs tier); fires observers."""
+        opt = self._opt(name)
+        self._runtime[name] = opt.parse(value)
+        for cb in self._observers.get(name, []):
+            cb(name, self._runtime[name])
+
+    def rm(self, name: str) -> None:
+        self._opt(name)
+        self._runtime.pop(name, None)
+
+    def load_file_values(self, values: dict[str, Any]) -> None:
+        """Conf-file tier (between defaults and env)."""
+        for name, value in values.items():
+            self._file[name] = self._opt(name).parse(value)
+
+    def observe(self, name: str, cb: Callable[[str, Any], None]) -> None:
+        self._opt(name)
+        self._observers.setdefault(name, []).append(cb)
+
+    # -- dumps --------------------------------------------------------------
+
+    def show(self) -> dict[str, Any]:
+        """`config show`: effective value + source per option."""
+        return {
+            name: {"value": self.get(name), "source": self.source_of(name)}
+            for name in sorted(self.schema)
+        }
+
+    def dump_schema(self) -> dict[str, Any]:
+        return {
+            name: {
+                "type": o.type,
+                "level": o.level,
+                "default": o.default,
+                "description": o.description,
+                **({"min": o.min} if o.min is not None else {}),
+                **({"max": o.max} if o.max is not None else {}),
+                **({"see_also": list(o.see_also)} if o.see_also else {}),
+            }
+            for name, o in sorted(self.schema.items())
+        }
+
+
+#: process-wide config, like the CephContext-owned ConfigProxy
+config = Config()

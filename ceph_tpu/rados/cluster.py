@@ -36,7 +36,10 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ceph_tpu.common.admin import AdminCommands, OpTracker
+from ceph_tpu.common.config import Config
 from ceph_tpu.common.hash import ceph_str_hash_rjenkins
+from ceph_tpu.common.perf_counters import PerfCountersCollection
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import factory
 from ceph_tpu.osd.memstore import MemStore, ObjectStoreError
@@ -56,6 +59,39 @@ class MiniCluster:
     def __post_init__(self):
         for osd in range(self.osdmap.max_osd):
             self.stores[osd] = MemStore(osd_id=osd)
+        # aux plumbing: per-cluster config + perf counters + op timeline,
+        # all reachable through the admin command hub (`admin.handle(...)`)
+        self.config = Config()
+        self.perf = PerfCountersCollection()
+        self.admin = AdminCommands(
+            perf=self.perf, config=self.config, op_tracker=OpTracker()
+        )
+        log = self.perf.create("mini_cluster")
+        log.add_u64_counter("put_ops", "client writes")
+        log.add_u64_counter("put_bytes", "bytes written")
+        log.add_u64_counter("get_ops", "client reads")
+        log.add_u64_counter("get_bytes", "bytes read back")
+        log.add_u64_counter("degraded_reads", "reads that needed decode")
+        log.add_u64_counter("recovered_shards", "shards rebuilt by recover()")
+        log.add_u64_counter("injected_failures", "transient faults retried")
+        log.add_time_avg("put_latency", "put wall time")
+        log.add_time_avg("get_latency", "get wall time")
+        self.log = log
+        # the reference drives injection through config observers
+        # (md_config_obs_t); mirror that: changing the option at runtime
+        # rewires every store. Apply once up front too, so env/file-tier
+        # values (which fire no observer) reach the initial stores.
+        self.config.observe(
+            "ms_inject_socket_failures", self._apply_injection
+        )
+        self._apply_injection(
+            "ms_inject_socket_failures",
+            self.config.get("ms_inject_socket_failures"),
+        )
+
+    def _apply_injection(self, _name: str, value: int) -> None:
+        for store in self.stores.values():
+            store.inject_transient_every = int(value)
 
     # -- plumbing --------------------------------------------------------------
 
@@ -87,30 +123,50 @@ class MiniCluster:
         except ObjectStoreError as e:
             if e.code != "ECONN":
                 raise
+            self.log.inc("injected_failures")
             return fn(*args, **kw)
 
     # -- client API ------------------------------------------------------------
 
     def put(self, pool_id: int, name: str, data: bytes) -> None:
-        pg, acting = self.acting(pool_id, name)
-        ec = self.codec(pool_id)
-        if ec is None:  # replicated: full copy on every acting osd
-            for osd in acting:
-                if osd != CRUSH_ITEM_NONE:
-                    self._op(self.stores[osd].write, (pool_id, pg, name), data)
-        else:
-            encoded = ec.encode(range(ec.get_chunk_count()), data)
-            for shard, osd in enumerate(acting):
-                if osd == CRUSH_ITEM_NONE:
-                    continue  # degraded write: shard stays missing
-                self._op(
-                    self.stores[osd].write,
-                    (pool_id, pg, name, shard),
-                    encoded[shard],
-                )
-        self.registry[(pool_id, name)] = len(data)
+        with self.log.time("put_latency"), self.admin.op_tracker.track(
+            f"put {pool_id}/{name}"
+        ) as op:
+            pg, acting = self.acting(pool_id, name)
+            op.mark_event("placed")
+            ec = self.codec(pool_id)
+            if ec is None:  # replicated: full copy on every acting osd
+                for osd in acting:
+                    if osd != CRUSH_ITEM_NONE:
+                        self._op(
+                            self.stores[osd].write, (pool_id, pg, name), data
+                        )
+            else:
+                encoded = ec.encode(range(ec.get_chunk_count()), data)
+                op.mark_event("encoded")
+                for shard, osd in enumerate(acting):
+                    if osd == CRUSH_ITEM_NONE:
+                        continue  # degraded write: shard stays missing
+                    self._op(
+                        self.stores[osd].write,
+                        (pool_id, pg, name, shard),
+                        encoded[shard],
+                    )
+            op.mark_event("stored")
+            self.registry[(pool_id, name)] = len(data)
+            self.log.inc("put_ops")
+            self.log.inc("put_bytes", len(data))
 
     def get(self, pool_id: int, name: str) -> bytes:
+        with self.log.time("get_latency"), self.admin.op_tracker.track(
+            f"get {pool_id}/{name}"
+        ) as op:
+            out = self._get(pool_id, name, op)
+            self.log.inc("get_ops")
+            self.log.inc("get_bytes", len(out))
+            return out
+
+    def _get(self, pool_id: int, name: str, op) -> bytes:
         size = self.registry.get((pool_id, name))
         if size is None:
             raise KeyError(f"no such object {name!r} in pool {pool_id}")
@@ -132,7 +188,13 @@ class MiniCluster:
 
         # EC read: probe shard availability, then read only the minimum set
         available = self._probe_shards(pool_id, pg, name, ec, acting)
-        return self._read_min_and_decode(pool_id, pg, name, ec, available, size)
+        op.mark_event("probed")
+        want = {ec.chunk_index(i) for i in range(ec.get_data_chunk_count())}
+        if not want <= set(available):
+            self.log.inc("degraded_reads")  # a data chunk must be rebuilt
+        return self._read_min_and_decode(
+            pool_id, pg, name, ec, available, size, want
+        )
 
     def _probe_shards(
         self, pool_id, pg, name, ec, acting
@@ -149,12 +211,11 @@ class MiniCluster:
         return available
 
     def _read_min_and_decode(
-        self, pool_id, pg, name, ec, available, size
+        self, pool_id, pg, name, ec, available, size, want
     ) -> bytes:
         """Plan the minimum read set, fetch it, decode, truncate — replanning
         without any shard that fails mid-read (handle_sub_read error path,
         ECBackend.cc:985)."""
-        want = {ec.chunk_index(i) for i in range(ec.get_data_chunk_count())}
         while True:
             minimum = ec.minimum_to_decode(want, set(available))
             chunks: dict[int, bytes] = {}
@@ -189,7 +250,12 @@ class MiniCluster:
     def revive_osd(self, osd: int) -> None:
         """Revive with amnesia: the store comes back empty (recovery must
         rebuild), like an OSD replaced after data loss."""
-        self.stores[osd] = MemStore(osd_id=osd)
+        self.stores[osd] = MemStore(
+            osd_id=osd,
+            inject_transient_every=self.config.get(
+                "ms_inject_socket_failures"
+            ),
+        )
         self.osdmap.mark_up(osd)
 
     def recover(self, pool_id: int) -> int:
@@ -309,4 +375,5 @@ class MiniCluster:
                 )
                 available[shard] = osd
                 rebuilt += 1
+        self.log.inc("recovered_shards", rebuilt)
         return rebuilt

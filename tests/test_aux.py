@@ -1,0 +1,204 @@
+"""Aux subsystems: typed config schema with layered resolution + observers,
+perf counters with perf-dump JSON, admin command hub, op tracker, and their
+wiring into the mini data path (SURVEY §5; reference options.cc,
+perf_counters.h:59, admin_socket.cc, TrackedOp.h)."""
+
+import os
+
+import pytest
+
+from ceph_tpu.common.admin import AdminCommands, OpTracker
+from ceph_tpu.common.config import SCHEMA, Config, ConfigError
+from ceph_tpu.common.perf_counters import PerfCounters, PerfCountersCollection
+
+
+def test_config_layering_and_types():
+    cfg = Config()
+    # compiled default
+    assert cfg.get("osd_pool_default_size") == 3
+    assert cfg.source_of("osd_pool_default_size") == "default"
+    # file tier overrides default
+    cfg.load_file_values({"osd_pool_default_size": "5"})
+    assert cfg.get("osd_pool_default_size") == 5
+    assert cfg.source_of("osd_pool_default_size") == "file"
+    # env tier overrides file
+    os.environ["CEPH_TPU_OSD_POOL_DEFAULT_SIZE"] = "7"
+    try:
+        assert cfg.get("osd_pool_default_size") == 7
+        assert cfg.source_of("osd_pool_default_size") == "env"
+        # runtime tier overrides env
+        cfg.set("osd_pool_default_size", 9)
+        assert cfg.get("osd_pool_default_size") == 9
+        assert cfg.source_of("osd_pool_default_size") == "override"
+        cfg.rm("osd_pool_default_size")
+        assert cfg.get("osd_pool_default_size") == 7
+    finally:
+        del os.environ["CEPH_TPU_OSD_POOL_DEFAULT_SIZE"]
+
+
+def test_config_validation():
+    cfg = Config()
+    with pytest.raises(ConfigError):
+        cfg.set("osd_pool_default_size", -1)  # uint
+    with pytest.raises(ConfigError):
+        cfg.set("ms_inject_delay_probability", 1.5)  # max=1.0
+    with pytest.raises(ConfigError):
+        cfg.set("no_such_option", 1)
+    with pytest.raises(ConfigError):
+        cfg.set("osd_pool_default_size", "not-a-number")
+    # bool parsing
+    cfg.set("bench_profile", "true")
+    assert cfg.get("bench_profile") is True
+    cfg.set("bench_profile", "0")
+    assert cfg.get("bench_profile") is False
+
+
+def test_config_observers():
+    cfg = Config()
+    seen = []
+    cfg.observe("ms_inject_socket_failures", lambda n, v: seen.append((n, v)))
+    cfg.set("ms_inject_socket_failures", 10)
+    assert seen == [("ms_inject_socket_failures", 10)]
+
+
+def test_config_schema_dump():
+    cfg = Config()
+    schema = cfg.dump_schema()
+    assert schema["ms_inject_socket_failures"]["level"] == "dev"
+    assert schema["osd_pool_default_size"]["type"] == "uint"
+    assert len(schema) == len(SCHEMA)
+
+
+def test_perf_counters_dump():
+    coll = PerfCountersCollection()
+    log = coll.create("osd")
+    log.add_u64_counter("ops", "client ops")
+    log.add_u64("in_flight", "current in-flight")
+    log.add_time_avg("latency", "op latency")
+    log.add_histogram("sizes", "op sizes")
+    log.inc("ops", 3)
+    log.set("in_flight", 2)
+    log.tinc("latency", 0.5)
+    log.tinc("latency", 1.5)
+    log.hinc("sizes", 4096)
+    log.hinc("sizes", 5000)
+    log.hinc("sizes", 100)
+    dump = coll.dump()["osd"]
+    assert dump["ops"] == 3
+    assert dump["in_flight"] == 2
+    assert dump["latency"] == {"avgcount": 2, "sum": 2.0}
+    assert dump["sizes"] == {"64": 1, "4096": 2}
+    schema = coll.schema()["osd"]
+    assert schema["latency"]["type"] == "timeavg"
+
+
+def test_perf_timer_context():
+    log = PerfCounters("x")
+    log.add_time_avg("t")
+    with log.time("t"):
+        pass
+    assert log.dump()["t"]["avgcount"] == 1
+
+
+def test_op_tracker():
+    tracker = OpTracker(history_size=2, slow_op_seconds=0.0)
+    with tracker.track("put foo") as op:
+        op.mark_event("encoded")
+        in_flight = tracker.dump_ops_in_flight()
+        assert in_flight["num_ops"] == 1
+        assert in_flight["num_slow_ops"] == 1  # slow threshold 0
+        assert in_flight["ops"][0]["events"][0]["event"] == "encoded"
+    assert tracker.dump_ops_in_flight()["num_ops"] == 0
+    hist = tracker.dump_historic_ops()
+    assert hist["num_ops"] == 1
+    assert hist["ops"][0]["description"] == "put foo"
+    # ring is bounded
+    for i in range(5):
+        with tracker.track(f"op{i}"):
+            pass
+    assert tracker.dump_historic_ops()["num_ops"] == 2
+
+
+def test_admin_command_hub():
+    admin = AdminCommands(
+        perf=PerfCountersCollection(), config=Config(), op_tracker=OpTracker()
+    )
+    assert admin.handle("perf dump") == {}
+    show = admin.handle("config show")
+    assert show["osd_pool_default_size"]["value"] == 3
+    admin.handle("config set", "osd_pool_default_size", "5")
+    assert admin.handle("config get", "osd_pool_default_size") == {
+        "osd_pool_default_size": 5
+    }
+    # prefix parse: full command line in one string
+    admin.handle("config set osd_pool_default_size 7")
+    assert admin.handle("config get osd_pool_default_size") == {
+        "osd_pool_default_size": 7
+    }
+    with pytest.raises(KeyError):
+        admin.handle("bogus")
+
+
+def _mini_cluster():
+    from ceph_tpu.crush import builder as cb
+    from ceph_tpu.crush.types import BucketAlg, CrushMap, Tunables
+    from ceph_tpu.osd import OSDMap, PgPool
+    from ceph_tpu.osd.types import TYPE_ERASURE
+    from ceph_tpu.rados import MiniCluster
+
+    cmap = CrushMap(tunables=Tunables.jewel())
+    host_ids, host_weights, osd = [], [], 0
+    for h in range(6):
+        items = [osd, osd + 1]
+        osd += 2
+        b = cb.make_bucket(
+            cmap, -(h + 2), BucketAlg.STRAW2, 1, items, [0x10000] * 2
+        )
+        host_ids.append(b.id)
+        host_weights.append(b.weight)
+    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, host_ids, host_weights)
+    cb.make_simple_rule(cmap, 0, -1, 1, "indep", 0)
+    m = OSDMap(crush=cmap, max_osd=cmap.max_devices)
+    m.pools[1] = PgPool(pg_num=8, size=4, type=TYPE_ERASURE, crush_rule=0)
+    return MiniCluster(
+        osdmap=m,
+        profiles={1: {"plugin": "tpu", "k": "2", "m": "2"}},
+    )
+
+
+def test_cluster_counters_and_injection():
+    cluster = _mini_cluster()
+    data = b"aux wiring" * 300
+    cluster.put(1, "obj", data)
+    assert cluster.get(1, "obj") == data
+    dump = cluster.admin.handle("perf dump")["mini_cluster"]
+    assert dump["put_ops"] == 1
+    assert dump["get_ops"] == 1
+    assert dump["put_bytes"] == len(data)
+    assert dump["get_latency"]["avgcount"] == 1
+    assert dump["degraded_reads"] == 0
+
+    # degraded read bumps the counter
+    pg, acting = cluster.acting(1, "obj")
+    cluster.kill_osd(acting[0])
+    assert cluster.get(1, "obj") == data
+    dump = cluster.admin.handle("perf dump")["mini_cluster"]
+    assert dump["degraded_reads"] == 1
+
+    # config-observer-driven fault injection reaches every store and the
+    # retry path counts what it absorbed
+    cluster.admin.handle("config set", "ms_inject_socket_failures", "5")
+    assert all(
+        s.inject_transient_every == 5 for s in cluster.stores.values()
+    )
+    for i in range(20):
+        cluster.put(1, f"o{i}", data)
+        assert cluster.get(1, f"o{i}") == data
+    dump = cluster.admin.handle("perf dump")["mini_cluster"]
+    assert dump["injected_failures"] > 0
+
+    # historic op timeline captured put/get events
+    hist = cluster.admin.handle("dump_historic_ops")
+    assert hist["num_ops"] > 0
+    events = {e["event"] for op in hist["ops"] for e in op["events"]}
+    assert {"placed", "encoded", "stored"} <= events

@@ -154,14 +154,28 @@ def run_decode(ec, args) -> float:
 
 def main(argv=None) -> int:
     args = parse_args(argv if argv is not None else sys.argv[1:])
+    from ceph_tpu.common.config import config
     from ceph_tpu.ec.registry import factory
 
     profile = build_profile(args.parameter)
     ec = factory(args.plugin, profile)
-    if args.workload == "encode":
-        elapsed = run_encode(ec, args)
+
+    def run():
+        if args.workload == "encode":
+            return run_encode(ec, args)
+        return run_decode(ec, args)
+
+    # profiling hook (SURVEY §5): config-driven jax.profiler trace capture,
+    # the analogue of the reference's LTTng tracepoints around the op loop
+    if config.get("bench_profile"):
+        import jax
+
+        trace_dir = config.get("bench_profile_trace_dir") or "/tmp/ceph_tpu_trace"
+        with jax.profiler.trace(trace_dir):
+            elapsed = run()
+        print(f"# jax.profiler trace written to {trace_dir}", file=sys.stderr)
     else:
-        elapsed = run_decode(ec, args)
+        elapsed = run()
     kib = args.iterations * (args.size // 1024) * max(1, args.batch)
     print(f"{elapsed:.6f}\t{kib}")
     return 0
