@@ -1149,10 +1149,10 @@ def _map_rule_chunk(compiled, rule, tunables, xs, weight_vec, result_max):
     # one (mode, (N, w) array) per EMIT: the reference appends each emitted
     # working vector to the output independently (mapper.c EMIT), so firstn
     # compaction must not cross an indep block's positional NONE holes
-    return [
-        (firstn, np.asarray(jnp.stack(cols, axis=1)))
-        for firstn, cols in blocks
-    ]
+    # return DEVICE arrays: map_rule dispatches every chunk before fetching
+    # any result (a device->host fetch through the tunnel costs ~100 ms, so
+    # per-chunk sync fetches would serialize dispatch behind transfer)
+    return [(firstn, jnp.stack(cols, axis=1)) for firstn, cols in blocks]
 
 
 def map_rule(
@@ -1182,8 +1182,10 @@ def map_rule(
     xs = np.asarray(xs, dtype=np.int32)
     weight_vec = jnp.asarray(np.asarray(weight, dtype=np.int64))
 
-    pieces = []
-    len_pieces = []
+    # phase 1: dispatch every chunk (async under JAX); phase 2: fetch +
+    # assemble on host. Interleaving fetch with dispatch would stall the
+    # device behind each ~100 ms tunnel transfer.
+    chunk_blocks = []
     for lo in range(0, len(xs), chunk):
         part = xs[lo : lo + chunk]
         pad = 0
@@ -1194,9 +1196,15 @@ def map_rule(
             compiled, rule, cmap.tunables, jnp.asarray(part), weight_vec,
             result_max,
         )
-        res, lens = _assemble_blocks(blocks, len(part), result_max)
-        pieces.append(res[: len(part) - pad] if pad else res)
-        len_pieces.append(lens[: len(part) - pad] if pad else lens)
+        chunk_blocks.append((blocks, len(part), pad))
+
+    pieces = []
+    len_pieces = []
+    for blocks, n_part, pad in chunk_blocks:
+        host_blocks = [(f, np.asarray(cols)) for f, cols in blocks]
+        res, lens = _assemble_blocks(host_blocks, n_part, result_max)
+        pieces.append(res[: n_part - pad] if pad else res)
+        len_pieces.append(lens[: n_part - pad] if pad else lens)
     out = (
         np.concatenate(pieces, axis=0)
         if pieces
