@@ -1150,9 +1150,23 @@ def _map_rule_chunk(compiled, rule, tunables, xs, weight_vec, result_max):
     # working vector to the output independently (mapper.c EMIT), so firstn
     # compaction must not cross an indep block's positional NONE holes
     # return DEVICE arrays: map_rule dispatches every chunk before fetching
-    # any result (a device->host fetch through the tunnel costs ~100 ms, so
-    # per-chunk sync fetches would serialize dispatch behind transfer)
-    return [(firstn, jnp.stack(cols, axis=1)) for firstn, cols in blocks]
+    # any result (device->host rides a ~5 MB/s tunnel here, so transfer is
+    # the bottleneck: overlap it with compute and halve the bytes by packing
+    # results as int16 with NONE -> -32768 whenever every possible result
+    # (osd ids, and bucket ids for non-leaf choose rules) fits)
+    out = []
+    pack16 = compiled.max_devices < 0x7FFF and (
+        # bucket ids can be sparse: bound their magnitude, not their count
+        max((-b for b in compiled.source.buckets), default=0) < 0x7FFF
+    )
+    for firstn, cols in blocks:
+        stacked = jnp.stack(cols, axis=1)
+        if pack16:
+            stacked = jnp.where(
+                stacked == CRUSH_ITEM_NONE, jnp.int32(-0x8000), stacked
+            ).astype(jnp.int16)
+        out.append((firstn, stacked))
+    return out
 
 
 def map_rule(
@@ -1201,7 +1215,13 @@ def map_rule(
     pieces = []
     len_pieces = []
     for blocks, n_part, pad in chunk_blocks:
-        host_blocks = [(f, np.asarray(cols)) for f, cols in blocks]
+        host_blocks = []
+        for f, cols in blocks:
+            arr = np.asarray(cols)
+            if arr.dtype == np.int16:  # unpack the tunnel-friendly encoding
+                arr = arr.astype(np.int32)
+                arr[arr == -0x8000] = CRUSH_ITEM_NONE
+            host_blocks.append((f, arr))
         res, lens = _assemble_blocks(host_blocks, n_part, result_max)
         pieces.append(res[: n_part - pad] if pad else res)
         len_pieces.append(lens[: n_part - pad] if pad else lens)
