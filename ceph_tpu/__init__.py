@@ -19,6 +19,8 @@ Subpackages:
   common   — L0 runtime: hashes, typed config schema, perf counters,
              admin commands + op tracker
   parallel — device-mesh sharding helpers (shard_map over stripe batches)
+  native   — C++ layer: the dlopen'd erasure-code plugin ABI + CPU codec
+             (libec_native.so), built by ceph_tpu/native/build.py
 """
 
 __version__ = "0.1.0"

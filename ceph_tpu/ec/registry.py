@@ -95,6 +95,10 @@ def _register_builtin() -> None:
 
     registry.add("clay", ErasureCodeClay)
 
+    from ceph_tpu.ec.native import ErasureCodeNative
+
+    registry.add("native", ErasureCodeNative)
+
 
 _register_builtin()
 

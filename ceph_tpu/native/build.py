@@ -1,0 +1,61 @@
+"""Build driver for the native components: g++ -> libec_<name>.so.
+
+The reference ships its native codecs as autotools/cmake targets producing
+libec_*.so under <libdir>/erasure-code (loaded by ErasureCodePluginRegistry
+at runtime); here a single g++ invocation produces the same artifact shape
+next to the sources, rebuilt only when the source is newer (the pattern the
+test oracle shim uses, tests/c_oracle). No compiler -> None, and callers
+surface the reference's dlopen error path.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+
+#: the reference's naming contract: PLUGIN_PREFIX "libec_" PLUGIN_SUFFIX ".so"
+PLUGIN_PREFIX = "libec_"
+PLUGIN_SUFFIX = ".so"
+
+
+def plugin_path(name: str, directory: str | None = None) -> str:
+    return os.path.join(
+        directory or NATIVE_DIR, f"{PLUGIN_PREFIX}{name}{PLUGIN_SUFFIX}"
+    )
+
+
+def build_plugin(
+    name: str = "native",
+    source: str | None = None,
+    directory: str | None = None,
+) -> str | None:
+    """Compile `source` into libec_<name>.so; returns the path or None when
+    no toolchain is available. Rebuilds only when the source is newer."""
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        return None
+    source = source or os.path.join(NATIVE_DIR, "ec_plugin.cpp")
+    out = plugin_path(name, directory)
+    if (
+        os.path.exists(out)
+        and os.path.getmtime(out) >= os.path.getmtime(source)
+    ):
+        return out
+    from ceph_tpu import __version__
+
+    cmd = [
+        cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
+        f'-DCEPH_TPU_PLUGIN_VERSION="ceph-tpu-{__version__}"',
+        "-o", out, source,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        # never fall back silently to a stale .so: surface the diagnostics
+        raise RuntimeError(
+            f"building {out} failed:\n{e.stderr}"
+        ) from None
+    return out

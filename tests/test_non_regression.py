@@ -12,7 +12,7 @@ import os
 
 import pytest
 
-from tools.ec_non_regression import DEFAULT_PROFILES, check
+from tools.ec_non_regression import DEFAULT_PROFILES, check, plugin_available
 
 CORPUS = os.path.join(os.path.dirname(__file__), "corpus")
 
@@ -26,5 +26,7 @@ CORPUS = os.path.join(os.path.dirname(__file__), "corpus")
     ],
 )
 def test_corpus_profile(plugin, profile, sw):
+    if not plugin_available(plugin):
+        pytest.skip(f"plugin {plugin} needs a C++ toolchain")
     errors = check(CORPUS, plugin, profile, sw)
     assert not errors, "\n".join(errors)

@@ -55,7 +55,23 @@ DEFAULT_PROFILES: list[tuple[str, dict, int]] = [
     ("clay", {"k": "4", "m": "2", "d": "5"}, 4096),
     ("clay", {"k": "8", "m": "4", "d": "11"}, 98304),
     ("tpu", {"k": "8", "m": "3"}, 4096),
+    ("native", {"k": "6", "m": "3", "technique": "cauchy"}, 4096),
 ]
+
+
+def plugin_available(plugin: str) -> bool:
+    """The native plugin needs a C++ toolchain (or a prebuilt .so); every
+    other plugin is pure Python."""
+    if plugin != "native":
+        return True
+    import shutil
+
+    from ceph_tpu.native.build import plugin_path
+
+    return bool(
+        shutil.which("g++") or shutil.which("c++")
+        or os.path.exists(plugin_path("native"))
+    )
 
 
 def profile_dir(base: str, plugin: str, profile: dict, stripe_width: int) -> str:
@@ -133,6 +149,9 @@ def main() -> None:
 
     failures: list[str] = []
     for plugin, profile, sw in DEFAULT_PROFILES:
+        if not plugin_available(plugin):
+            print(f"skip plugin={plugin} (no toolchain)")
+            continue
         if args.create:
             print("create", create(args.base, plugin, profile, sw))
         else:
