@@ -1,0 +1,57 @@
+"""ceph_crc32c — the Castagnoli CRC the reference uses everywhere.
+
+Re-expresses /root/reference/src/include/crc32c.h (`ceph_crc32c(seed, data,
+len)`) / src/common/sctp_crc32.c: CRC-32C (polynomial 0x1EDC6F41, reflected
+0x82F63B78), bitwise-reflected in/out, NO final inversion — callers seed with
+-1 themselves (e.g. the EC deep-scrub shard hashes, ECBackend.cc:2482
+`bufferhash(-1)`, and ECUtil::HashInfo's cumulative shard hashes).
+
+The byte loop runs over a numpy view with a 256-entry table, sliced eight
+bytes per step (slice-by-8) so scrubbing megabyte shards stays usable from
+Python; parity vs the compiled reference sctp_crc32.c is pinned in
+tests/test_scrub.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_POLY = 0x82F63B78
+
+
+def _make_table() -> np.ndarray:
+    table = np.zeros((8, 256), dtype=np.uint32)
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ _POLY if c & 1 else c >> 1
+        table[0, n] = c
+    for k in range(1, 8):
+        for n in range(256):
+            c = table[k - 1, n]
+            table[k, n] = table[0, c & 0xFF] ^ (c >> 8)
+    return table
+
+
+_TABLE = _make_table()
+
+
+def ceph_crc32c(seed: int, data: bytes | np.ndarray) -> int:
+    """crc32c(seed, data) with ceph's conventions (no final xor)."""
+    crc = np.uint32(seed & 0xFFFFFFFF)
+    buf = np.frombuffer(bytes(data), dtype=np.uint8)
+    t = _TABLE
+    n8 = len(buf) // 8 * 8
+    if n8:
+        words = buf[:n8].reshape(-1, 8)
+        for row in words:
+            crc = np.uint32(
+                t[7, (crc ^ row[0]) & np.uint32(0xFF)]
+                ^ t[6, ((crc >> np.uint32(8)) ^ row[1]) & np.uint32(0xFF)]
+                ^ t[5, ((crc >> np.uint32(16)) ^ row[2]) & np.uint32(0xFF)]
+                ^ t[4, ((crc >> np.uint32(24)) ^ row[3]) & np.uint32(0xFF)]
+                ^ t[3, row[4]] ^ t[2, row[5]] ^ t[1, row[6]] ^ t[0, row[7]]
+            )
+    for b in buf[n8:]:
+        crc = np.uint32(t[0, (crc ^ b) & np.uint32(0xFF)] ^ (crc >> np.uint32(8)))
+    return int(crc)

@@ -27,6 +27,8 @@ class MemStore:
 
     osd_id: int
     objects: dict[tuple, bytes] = field(default_factory=dict)
+    #: key -> xattr map (HashInfo etc.), like ObjectStore::getattrs
+    attrs: dict[tuple, dict] = field(default_factory=dict)
     alive: bool = True
     eio_keys: set = field(default_factory=set)
     #: 1-in-N transient op failure (0 = off), ms_inject_socket_failures-style
@@ -48,10 +50,17 @@ class MemStore:
         if key is not None and key in self.eio_keys:
             raise ObjectStoreError("EIO", f"osd.{self.osd_id} EIO on {key}")
 
-    def write(self, key: tuple, data: bytes) -> None:
+    def write(self, key: tuple, data: bytes, attrs: dict | None = None) -> None:
         self._gate()
         self.objects[key] = bytes(data)
+        if attrs is not None:
+            self.attrs[key] = dict(attrs)
         self.writes += 1
+
+    def getattrs(self, key: tuple) -> dict:
+        """Object attributes (the xattr map real stores keep per object)."""
+        self._gate(key)
+        return self.attrs.get(key, {})
 
     def read(self, key: tuple, offset: int = 0, length: int | None = None) -> bytes:
         self._gate(key)
@@ -73,6 +82,7 @@ class MemStore:
     def remove(self, key: tuple) -> None:
         self._gate()
         self.objects.pop(key, None)
+        self.attrs.pop(key, None)
 
     def keys(self):
         return list(self.objects)

@@ -17,6 +17,10 @@ process, no wire protocol:
        via minimum_to_decode            ec_impl->minimum_to_decode (1605)
     -> decode on TPU when degraded      ECUtil::decode (2306)
 
+  scrub(deep)/repair()                  PGBackend::be_scan_list /
+    -> shard presence/size; deep adds   ECBackend::be_deep_scrub per-shard
+       crc32c vs stored HashInfo        cumulative CRC check (ECBackend.cc:2461)
+
   kill/revive osd + recover()           the qa Thrasher loop (ceph_manager.py:196)
     -> deterministic re-placement on the new map epoch, shard rebuild onto the
        new homes, CLAY pools reading only their repair sub-chunk fraction
@@ -42,8 +46,24 @@ from ceph_tpu.common.hash import ceph_str_hash_rjenkins
 from ceph_tpu.common.perf_counters import PerfCountersCollection
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import factory
+from ceph_tpu.common.crc import ceph_crc32c
+from ceph_tpu.osd.ecutil import HashInfo
 from ceph_tpu.osd.memstore import MemStore, ObjectStoreError
 from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE, OSDMap
+
+
+@dataclass
+class ScrubError:
+    """One inconsistency found by scrub: shard is None for replicated
+    pools; error is missing | size_mismatch | read_error | hinfo_missing |
+    digest_mismatch."""
+
+    pool_id: int
+    pg: int
+    name: str
+    shard: int | None
+    osd: int
+    error: str
 
 
 @dataclass
@@ -74,6 +94,8 @@ class MiniCluster:
         log.add_u64_counter("degraded_reads", "reads that needed decode")
         log.add_u64_counter("recovered_shards", "shards rebuilt by recover()")
         log.add_u64_counter("injected_failures", "transient faults retried")
+        log.add_u64_counter("scrubs", "scrub passes run")
+        log.add_u64_counter("scrub_errors", "inconsistencies found")
         log.add_time_avg("put_latency", "put wall time")
         log.add_time_avg("get_latency", "get wall time")
         self.log = log
@@ -144,6 +166,9 @@ class MiniCluster:
             else:
                 encoded = ec.encode(range(ec.get_chunk_count()), data)
                 op.mark_event("encoded")
+                # per-shard cumulative crc32c metadata, stored identically on
+                # every shard (ECUtil::HashInfo; verified by deep scrub)
+                hinfo = HashInfo.from_shards(encoded, ec.get_chunk_count())
                 for shard, osd in enumerate(acting):
                     if osd == CRUSH_ITEM_NONE:
                         continue  # degraded write: shard stays missing
@@ -151,6 +176,7 @@ class MiniCluster:
                         self.stores[osd].write,
                         (pool_id, pg, name, shard),
                         encoded[shard],
+                        attrs={"hinfo": hinfo},
                     )
             op.mark_event("stored")
             self.registry[(pool_id, name)] = len(data)
@@ -241,6 +267,166 @@ class MiniCluster:
             decoded[ec.chunk_index(i)] for i in range(ec.get_data_chunk_count())
         )
 
+    # -- scrub (PGBackend::be_scan_list / ECBackend::be_deep_scrub) ------------
+
+    def scrub(self, pool_id: int, deep: bool = False) -> list["ScrubError"]:
+        """Consistency check over every registered object's shards/replicas.
+
+        Shallow: presence + size agreement (PGBackend::be_scan_list,
+        PGBackend.cc:571). Deep additionally re-reads every shard and checks
+        its crc32c against the stored HashInfo (EC: ECBackend::be_deep_scrub,
+        ECBackend.cc:2461-2540) or against the replica majority (replicated
+        pools' data digest comparison). Faults found are returned, counted,
+        and left in place — `repair` acts on them.
+        """
+        ec = self.codec(pool_id)
+        errors: list[ScrubError] = []
+        for (pid, name), _ in list(self.registry.items()):
+            if pid != pool_id:
+                continue
+            pg, acting = self.acting(pool_id, name)
+            if ec is None:
+                errors.extend(
+                    self._scrub_replicated(pool_id, pg, name, acting, deep)
+                )
+            else:
+                errors.extend(
+                    self._scrub_ec(pool_id, pg, name, acting, ec, deep)
+                )
+        self.log.inc("scrubs")
+        self.log.inc("scrub_errors", len(errors))
+        return errors
+
+    @staticmethod
+    def _authoritative_size(sizes: dict[int, int], hinfo_size: int | None):
+        """The chunk size shards must agree on: the stored HashInfo's when
+        available (what ECBackend trusts), else a strict size majority, else
+        None (no safe authority — flag nothing rather than risk repair
+        deleting good shards on a tie)."""
+        if hinfo_size is not None:
+            return hinfo_size
+        counts: dict[int, int] = {}
+        for s in sizes.values():
+            counts[s] = counts.get(s, 0) + 1
+        best = max(counts, key=counts.get)
+        return best if counts[best] * 2 > len(sizes) else None
+
+    def _scrub_ec(self, pool_id, pg, name, acting, ec, deep):
+        errors = []
+        sizes: dict[int, int] = {}
+        hinfo_size = None
+        for shard, osd in enumerate(acting):
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            key = (pool_id, pg, name, shard)
+            store = self.stores[osd]
+            if not store.alive or key not in store.objects:
+                errors.append(ScrubError(pool_id, pg, name, shard, osd,
+                                         "missing"))
+                continue
+            sizes[shard] = len(store.objects[key])
+            if hinfo_size is None:
+                hinfo = store.attrs.get(key, {}).get("hinfo")
+                if hinfo is not None:
+                    hinfo_size = hinfo.total_chunk_size
+        if len(set(sizes.values())) > 1 or (
+            hinfo_size is not None
+            and any(s != hinfo_size for s in sizes.values())
+        ):
+            # shards of one object must share a chunk size (stripe_info_t)
+            auth = self._authoritative_size(sizes, hinfo_size)
+            for shard, size in sizes.items():
+                if auth is not None and size != auth:
+                    errors.append(ScrubError(pool_id, pg, name, shard,
+                                             acting[shard], "size_mismatch"))
+        if not deep:
+            return errors
+        for shard, osd in enumerate(acting):
+            if osd == CRUSH_ITEM_NONE or shard not in sizes:
+                continue
+            key = (pool_id, pg, name, shard)
+            store = self.stores[osd]
+            try:
+                # through the client retry contract: a single injected
+                # transient fault must not read as permanent corruption
+                data = self._op(store.read, key)
+                hinfo = self._op(store.getattrs, key).get("hinfo")
+            except ObjectStoreError:
+                errors.append(ScrubError(pool_id, pg, name, shard, osd,
+                                         "read_error"))
+                continue
+            if hinfo is None:
+                errors.append(ScrubError(pool_id, pg, name, shard, osd,
+                                         "hinfo_missing"))
+                continue
+            if ceph_crc32c(0xFFFFFFFF, data) != hinfo.get_chunk_hash(shard):
+                errors.append(ScrubError(pool_id, pg, name, shard, osd,
+                                         "digest_mismatch"))
+        return errors
+
+    def _scrub_replicated(self, pool_id, pg, name, acting, deep):
+        errors = []
+        key = (pool_id, pg, name)
+        digests: dict[int, int] = {}
+        sizes: dict[int, int] = {}
+        for osd in acting:
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            store = self.stores[osd]
+            if not store.alive or key not in store.objects:
+                errors.append(ScrubError(pool_id, pg, name, None, osd,
+                                         "missing"))
+                continue
+            sizes[osd] = len(store.objects[key])
+            if deep:
+                try:
+                    digests[osd] = ceph_crc32c(
+                        0xFFFFFFFF, self._op(store.read, key)
+                    )
+                except ObjectStoreError:
+                    errors.append(ScrubError(pool_id, pg, name, None, osd,
+                                             "read_error"))
+        if len(set(sizes.values())) > 1:
+            auth = self._authoritative_size(sizes, None)
+            for osd, size in sizes.items():
+                if auth is not None and size != auth:
+                    errors.append(ScrubError(pool_id, pg, name, None, osd,
+                                             "size_mismatch"))
+        if deep and len(set(digests.values())) > 1:
+            # auth copy = the digest majority (ties -> the primary's copy),
+            # like the reference's be_select_auth_object
+            counts: dict[int, int] = {}
+            for d in digests.values():
+                counts[d] = counts.get(d, 0) + 1
+            best = max(counts.values())
+            majority = {d for d, c in counts.items() if c == best}
+            auth = next(
+                d for o, d in digests.items() if d in majority
+            )
+            for osd, d in digests.items():
+                if d != auth:
+                    errors.append(ScrubError(pool_id, pg, name, None, osd,
+                                             "digest_mismatch"))
+        return errors
+
+    def repair(self, pool_id: int) -> int:
+        """Deep-scrub, drop every inconsistent copy, rebuild via recover()
+        (the `ceph pg repair` flow)."""
+        errors = self.scrub(pool_id, deep=True)
+        for e in errors:
+            if e.error == "missing":
+                continue  # nothing stored to drop
+            store = self.stores[e.osd]
+            key = (
+                (e.pool_id, e.pg, e.name)
+                if e.shard is None
+                else (e.pool_id, e.pg, e.name, e.shard)
+            )
+            store.objects.pop(key, None)
+            store.attrs.pop(key, None)
+            store.eio_keys.discard(key)
+        return self.recover(pool_id)
+
     # -- failure / recovery (the thrasher loop) --------------------------------
 
     def kill_osd(self, osd: int) -> None:
@@ -320,20 +506,36 @@ class MiniCluster:
                     available[shard] = stray
                 if osd != CRUSH_ITEM_NONE:
                     missing.append((shard, osd))
+            def hinfo_of(avail: dict[int, int]) -> dict | None:
+                for s, o in avail.items():
+                    a = self.stores[o].attrs.get((pool_id, pg, name, s))
+                    if a and "hinfo" in a:
+                        return {"hinfo": a["hinfo"]}
+                return None
+
             for shard, osd in missing:
                 key = (pool_id, pg, name, shard)
                 if shard in available:
                     # log-based recovery: the shard survives on a stray OSD,
                     # push the copy instead of decoding (ReplicatedBackend-
-                    # style pull/push vs full rebuild)
-                    self._op(
-                        self.stores[osd].write,
-                        key,
-                        self.stores[available[shard]].objects[key],
-                    )
-                    available[shard] = osd
-                    rebuilt += 1
-                    continue
+                    # style pull/push vs full rebuild) — but verify the pull
+                    # against its own hinfo first, else a silently-corrupted
+                    # stray re-infects the acting home on every repair pass
+                    src = self.stores[available[shard]]
+                    pulled = src.objects[key]
+                    hinfo = src.attrs.get(key, {}).get("hinfo")
+                    good = hinfo is None or ceph_crc32c(
+                        0xFFFFFFFF, pulled
+                    ) == hinfo.get_chunk_hash(shard)
+                    if good:
+                        self._op(
+                            self.stores[osd].write, key, pulled,
+                            attrs=src.attrs.get(key),
+                        )
+                        available[shard] = osd
+                        rebuilt += 1
+                        continue
+                    del available[shard]  # corrupt source: decode instead
                 sub_total = ec.get_sub_chunk_count()
                 while True:  # re-plan without any source that fails mid-read
                     minimum = ec.minimum_to_decode({shard}, set(available))
@@ -372,6 +574,7 @@ class MiniCluster:
                     self.stores[osd].write,
                     (pool_id, pg, name, shard),
                     decoded[shard],
+                    attrs=hinfo_of(available),
                 )
                 available[shard] = osd
                 rebuilt += 1
