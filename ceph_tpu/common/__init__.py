@@ -6,4 +6,6 @@
   perf_counters — PerfCounters blocks with perf-dump JSON (perf_counters.h)
   admin         — admin command hub + TrackedOp/OpTracker op timeline
                   (admin_socket.cc, TrackedOp.h)
+  crc           — ceph_crc32c (crc32c.h / sctp_crc32.c)
+  compressor    — compression plugin registry (src/compressor/)
 """

@@ -321,10 +321,11 @@ class OSDMap:
         # primary affinity, which reorders replicated up-sets): few by
         # construction, re-run through the exact scalar pipeline
         out = np.where(valid | none, raw, CRUSH_ITEM_NONE).astype(np.int32)
+        # ps < pg_num guards against stale entries after a pool shrink
         overridden = {
             pg[1]
             for pg in list(self.pg_upmap) + list(self.pg_upmap_items)
-            if pg[0] == pool_id
+            if pg[0] == pool_id and pg[1] < pool.pg_num
         }
         aff = self.osd_primary_affinity
         if aff is not None:

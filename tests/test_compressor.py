@@ -1,0 +1,59 @@
+"""Compressor plugin framework (reference src/compressor/): registry
+behavior, round trips per algorithm, and the COMP_* mode/ratio policy."""
+
+import pytest
+
+from ceph_tpu.common.compressor import (
+    COMP_AGGRESSIVE,
+    COMP_FORCE,
+    COMP_NONE,
+    COMP_PASSIVE,
+    HINT_COMPRESSIBLE,
+    HINT_INCOMPRESSIBLE,
+    CompressorError,
+    factory,
+    registry,
+)
+
+
+def test_registry_lists_and_rejects_unknown():
+    algs = registry.get_algorithms()
+    assert "zlib" in algs  # zstd is optional (absent-plugin case)
+    with pytest.raises(CompressorError):
+        factory("snappy9000")
+
+
+@pytest.mark.parametrize("alg", registry.get_algorithms())
+def test_roundtrip(alg):
+    c = factory(alg)
+    data = b"the quick brown fox " * 500
+    out = c.compress(data)
+    assert len(out) < len(data)
+    assert c.decompress(out) == data
+
+
+def test_mode_policy():
+    import os
+
+    c = factory("zlib")
+    compressible = b"a" * 4096
+    incompressible = os.urandom(4096)
+
+    assert c.maybe_compress(compressible, COMP_NONE) == (False, compressible)
+    # passive compresses only when hinted
+    assert c.maybe_compress(compressible, COMP_PASSIVE)[0] is False
+    assert c.maybe_compress(
+        compressible, COMP_PASSIVE, HINT_COMPRESSIBLE
+    )[0] is True
+    # aggressive compresses unless hinted incompressible
+    assert c.maybe_compress(compressible, COMP_AGGRESSIVE)[0] is True
+    assert c.maybe_compress(
+        compressible, COMP_AGGRESSIVE, HINT_INCOMPRESSIBLE
+    )[0] is False
+    # the required-ratio guard discards useless compression...
+    ok, payload = c.maybe_compress(incompressible, COMP_AGGRESSIVE)
+    assert ok is False and payload == incompressible
+    # ...unless forced
+    ok, payload = c.maybe_compress(incompressible, COMP_FORCE)
+    assert ok is True
+    assert c.decompress(payload) == incompressible
