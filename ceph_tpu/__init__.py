@@ -14,10 +14,10 @@ Subpackages:
              reference codec families (jerasure/isa RS, shec, lrc, clay)
   crush    — CRUSH placement: data model, NumPy oracle, batched JAX mapper
   osd      — cluster map (OSDMap placement pipeline, balancer) + MemStore
-  rados    — MiniCluster: the end-to-end striped data path (put/get,
-             degraded reads, recovery, fault injection)
+  rados    — MiniCluster: the end-to-end data path (put/get, degraded
+             reads, recovery, scrub/repair, fault injection) + Striper
   common   — L0 runtime: hashes, typed config schema, perf counters,
-             admin commands + op tracker
+             admin commands + op tracker, crc32c, compressors, throttle
   parallel — device-mesh sharding helpers (shard_map over stripe batches)
   native   — C++ layer: the dlopen'd erasure-code plugin ABI + CPU codec
              (libec_native.so), built by ceph_tpu/native/build.py

@@ -8,4 +8,5 @@
                   (admin_socket.cc, TrackedOp.h)
   crc           — ceph_crc32c (crc32c.h / sctp_crc32.c)
   compressor    — compression plugin registry (src/compressor/)
+  throttle      — counting backpressure (src/common/Throttle)
 """

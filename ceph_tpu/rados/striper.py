@@ -1,0 +1,128 @@
+"""Client-side striping — the libradosstriper / Striper analogue.
+
+Re-expresses /root/reference/src/osdc/Striper.cc:file_to_extents (the RADOS
+striping layout: stripe_unit su, stripe_count sc, object_size os) and
+libradosstriper's write/read: a large logical "file" is cut into su-sized
+blocks dealt round-robin across sc objects per object set, objects named
+`<soid>.%016x` exactly as the reference formats them (Striper.cc:47
+"%s.%016llx").
+
+This is the framework's long-sequence scaling axis (SURVEY §5): one logical
+stream fans out across many RADOS objects, each of which the data path then
+places via CRUSH and erasure-codes on the TPU — so a single striped write
+exercises placement + encode over stripe_count × k devices at once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class StripeLayout:
+    """file_layout_t's placement-relevant subset."""
+
+    stripe_unit: int = 1 << 16
+    stripe_count: int = 4
+    object_size: int = 1 << 18
+
+    def __post_init__(self):
+        if self.stripe_unit <= 0 or self.stripe_count <= 0:
+            raise ValueError("stripe_unit and stripe_count must be positive")
+        if self.object_size < self.stripe_unit:
+            raise ValueError("object_size must be >= stripe_unit")
+        if self.object_size % self.stripe_unit:
+            raise ValueError("object_size must be a multiple of stripe_unit")
+
+
+def file_to_extents(
+    layout: StripeLayout, offset: int, length: int
+) -> dict[int, list[tuple[int, int, int]]]:
+    """[offset, offset+length) -> {object_no: [(obj_off, len, file_off)]}.
+
+    The loop is the reference's block walk (Striper.cc:129-166): block ->
+    (stripeno, stripepos) -> object set -> object number and intra-object
+    offset."""
+    su = layout.stripe_unit
+    sc = layout.stripe_count
+    if sc == 1:
+        su = layout.object_size  # Striper.cc:132-135
+    stripes_per_object = layout.object_size // su
+
+    extents: dict[int, list[tuple[int, int, int]]] = {}
+    cur = offset
+    left = length
+    while left > 0:
+        blockno = cur // su
+        stripeno = blockno // sc
+        stripepos = blockno % sc
+        objectsetno = stripeno // stripes_per_object
+        objectno = objectsetno * sc + stripepos
+        block_start = (stripeno % stripes_per_object) * su
+        block_off = cur % su
+        n = min(left, su - block_off)
+        extents.setdefault(objectno, []).append(
+            (block_start + block_off, n, cur)
+        )
+        cur += n
+        left -= n
+    return extents
+
+
+def object_name(soid: str, objectno: int) -> str:
+    return f"{soid}.{objectno:016x}"  # Striper.cc:47 object_format
+
+
+class Striper:
+    """libradosstriper-style striped write/read over a MiniCluster pool."""
+
+    def __init__(self, cluster, pool_id: int,
+                 layout: StripeLayout | None = None):
+        self.cluster = cluster
+        self.pool_id = pool_id
+        self.layout = layout or StripeLayout()
+        #: striped-object sizes (libradosstriper keeps this in a striper.size
+        #: xattr on the first object; the mini data path has no partial-object
+        #: xattr API, so the striper tracks it — same recovery properties,
+        #: since MiniCluster.registry already plays the PG-log role)
+        self.sizes: dict[str, int] = {}
+
+    def write(self, soid: str, data: bytes) -> int:
+        """Full-object striped write; returns the number of RADOS objects."""
+        extents = file_to_extents(self.layout, 0, len(data))
+        for objectno, runs in sorted(extents.items()):
+            end = max(obj_off + n for obj_off, n, _ in runs)
+            buf = bytearray(end)
+            for obj_off, n, file_off in runs:
+                buf[obj_off : obj_off + n] = data[file_off : file_off + n]
+            self.cluster.put(
+                self.pool_id, object_name(soid, objectno), bytes(buf)
+            )
+        self.sizes[soid] = len(data)
+        return len(extents)
+
+    def read(self, soid: str, offset: int = 0,
+             length: int | None = None) -> bytes:
+        size = self.sizes.get(soid)
+        if size is None:
+            raise KeyError(f"no striped object {soid!r}")
+        if length is None:
+            length = size - offset
+        length = max(0, min(length, size - offset))
+        if length == 0:
+            return b""
+        out = bytearray(length)
+        objects: dict[int, bytes] = {}
+        for objectno, runs in file_to_extents(
+            self.layout, offset, length
+        ).items():
+            if objectno not in objects:
+                objects[objectno] = self.cluster.get(
+                    self.pool_id, object_name(soid, objectno)
+                )
+            blob = objects[objectno]
+            for obj_off, n, file_off in runs:
+                out[file_off - offset : file_off - offset + n] = blob[
+                    obj_off : obj_off + n
+                ]
+        return bytes(out)
