@@ -9,4 +9,5 @@
   crc           — ceph_crc32c (crc32c.h / sctp_crc32.c)
   compressor    — compression plugin registry (src/compressor/)
   throttle      — counting backpressure (src/common/Throttle)
+  log           — dout-style subsystem logging + recent ring (src/log)
 """

@@ -128,6 +128,14 @@ SCHEMA: dict[str, Option] = {
              see_also=("bench_profile",)),
         _opt("bench_profile", TYPE_BOOL, LEVEL_DEV, False,
              "capture a jax.profiler trace around benchmark loops"),
+        # dout subsystem levels (src/common/subsys.h-style "1/5" defaults:
+        # emitted at the configured level, ring-gathered up to 5; see
+        # ceph_tpu.common.log)
+        *[
+            _opt(f"debug_{subsys}", TYPE_INT, LEVEL_ADVANCED, 1,
+                 f"emitted debug level for the {subsys} subsystem")
+            for subsys in ("osd", "crush", "ec", "rados", "bench")
+        ],
     ]
 }
 

@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 from ceph_tpu.common.admin import AdminCommands, OpTracker
 from ceph_tpu.common.config import Config
 from ceph_tpu.common.hash import ceph_str_hash_rjenkins
+from ceph_tpu.common.log import LogRegistry
 from ceph_tpu.common.perf_counters import PerfCountersCollection
 from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.ec.registry import factory
@@ -86,6 +87,10 @@ class MiniCluster:
         self.admin = AdminCommands(
             perf=self.perf, config=self.config, op_tracker=OpTracker()
         )
+        self.logs = LogRegistry(config=self.config)
+        self.dlog = self.logs.get_logger("rados")
+        self.admin.register("log dump", self.logs.dump_recent)
+        self.admin.register("log clear", self.logs.clear)
         log = self.perf.create("mini_cluster")
         log.add_u64_counter("put_ops", "client writes")
         log.add_u64_counter("put_bytes", "bytes written")
@@ -179,6 +184,9 @@ class MiniCluster:
                         attrs={"hinfo": hinfo},
                     )
             op.mark_event("stored")
+            if (d := self.dlog.dout(5)) is not None:
+                d(f"put {pool_id}/{name} pg {pg} acting {acting} "
+                  f"{len(data)} bytes")
             self.registry[(pool_id, name)] = len(data)
             self.log.inc("put_ops")
             self.log.inc("put_bytes", len(data))
@@ -218,6 +226,9 @@ class MiniCluster:
         want = {ec.chunk_index(i) for i in range(ec.get_data_chunk_count())}
         if not want <= set(available):
             self.log.inc("degraded_reads")  # a data chunk must be rebuilt
+            if (d := self.dlog.dout(1)) is not None:
+                d(f"degraded read {pool_id}/{name}: shards "
+                  f"{sorted(set(want) - set(available))} missing")
         return self._read_min_and_decode(
             pool_id, pg, name, ec, available, size, want
         )
@@ -430,6 +441,8 @@ class MiniCluster:
     # -- failure / recovery (the thrasher loop) --------------------------------
 
     def kill_osd(self, osd: int) -> None:
+        if (d := self.dlog.dout(1)) is not None:
+            d(f"osd.{osd} down")
         self.stores[osd].alive = False
         self.osdmap.mark_down(osd)
 
@@ -579,4 +592,6 @@ class MiniCluster:
                 available[shard] = osd
                 rebuilt += 1
         self.log.inc("recovered_shards", rebuilt)
+        if (d := self.dlog.dout(1)) is not None:
+            d(f"recovery pool {pool_id}: rebuilt {rebuilt} shards")
         return rebuilt
