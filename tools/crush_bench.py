@@ -64,8 +64,18 @@ def bench_c(cmap, n_pgs: int, replicas: int, weight) -> float | None:
         + f"\nbenchrun 0 0 {n_pgs} {replicas} {len(weight)} {wtxt}\n"
     )
     t0 = time.perf_counter()
-    subprocess.run([shim], input=text, capture_output=True, text=True, check=True)
-    return time.perf_counter() - t0
+    proc = subprocess.run(
+        [shim], input=text, capture_output=True, text=True, check=True
+    )
+    wall = time.perf_counter() - t0
+    # prefer the shim's self-timed mapping loop (excludes spawn + map parse);
+    # an elapsed that rounds to 0 (e.g. --pgs 0) falls back to wall clock
+    for line in proc.stdout.splitlines():
+        if line.startswith("elapsed "):
+            parsed = float(line.split()[1])
+            if parsed > 0:
+                return parsed
+    return wall
 
 
 def validate(cmap, compiled, jax_out, replicas, weight, n_check: int):
