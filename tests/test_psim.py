@@ -1,0 +1,31 @@
+"""psim toy simulator (reference: src/tools/psim.cc)."""
+
+import re
+
+
+def test_psim_counts_balance(tmp_path, capsys, monkeypatch):
+    import tools.psim as psim
+    from tools.osdmaptool import main as osdmaptool_main
+
+    # shrink the workload for test speed
+    monkeypatch.setattr(psim, "FILES", 200)
+    mapfn = str(tmp_path / "om.json")
+    assert osdmaptool_main(
+        [mapfn, "--createsimple", "12", "--with-default-pool",
+         "--pg-bits", "4"]
+    ) == 0
+    capsys.readouterr()
+    assert psim.main([mapfn]) == 0
+    out = capsys.readouterr().out
+    rows = re.findall(r"^osd\.(\d+)\t(\d+)\t(\d+)\t(\d+)$", out, re.M)
+    assert len(rows) == 12
+    total = sum(int(c) for _, c, _, _ in rows)
+    # 10 ns-equivalents x 200 files x 4 blocks, 3 replicas each
+    assert total == 10 * 200 * 4 * 3
+    assert re.search(r"^avg \d+ stddev [\d.]+", out, re.M)
+
+
+def test_psim_missing_map(capsys):
+    import tools.psim as psim
+
+    assert psim.main(["/nonexistent/map.json"]) == 1
