@@ -140,30 +140,9 @@ def test_admin_command_hub():
 
 
 def _mini_cluster():
-    from ceph_tpu.crush import builder as cb
-    from ceph_tpu.crush.types import BucketAlg, CrushMap, Tunables
-    from ceph_tpu.osd import OSDMap, PgPool
-    from ceph_tpu.osd.types import TYPE_ERASURE
-    from ceph_tpu.rados import MiniCluster
+    from tests.conftest import make_mini_cluster
 
-    cmap = CrushMap(tunables=Tunables.jewel())
-    host_ids, host_weights, osd = [], [], 0
-    for h in range(6):
-        items = [osd, osd + 1]
-        osd += 2
-        b = cb.make_bucket(
-            cmap, -(h + 2), BucketAlg.STRAW2, 1, items, [0x10000] * 2
-        )
-        host_ids.append(b.id)
-        host_weights.append(b.weight)
-    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, host_ids, host_weights)
-    cb.make_simple_rule(cmap, 0, -1, 1, "indep", 0)
-    m = OSDMap(crush=cmap, max_osd=cmap.max_devices)
-    m.pools[1] = PgPool(pg_num=8, size=4, type=TYPE_ERASURE, crush_rule=0)
-    return MiniCluster(
-        osdmap=m,
-        profiles={1: {"plugin": "tpu", "k": "2", "m": "2"}},
-    )
+    return make_mini_cluster()
 
 
 def test_cluster_counters_and_injection():

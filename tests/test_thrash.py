@@ -1,0 +1,118 @@
+"""Randomized thrashing with a consistency oracle — the RadosModel tier.
+
+The reference's qa Thrasher (qa/tasks/ceph_manager.py:103: kill_osd 196,
+revive_osd 380) randomly kills/revives OSDs while ceph_test_rados drives a
+randomized op model (src/test/osd/RadosModel.h) whose in-memory model is the
+consistency oracle. Same structure here: a seeded random schedule of
+put/overwrite/get/kill/revive/recover/scrub against MiniCluster, with
+a plain dict as the oracle; every read must match the model exactly and
+every scrubbed epoch must end consistent.
+
+Invariant maintained by the schedule (mirroring the thrasher's own limits):
+never more OSDs simultaneously dead than the pools' fault tolerance (m for
+EC, size-1 replicated), so every object must stay readable at all times.
+"""
+
+import numpy as np
+import pytest
+
+POOLS = {
+    "ec": 1,
+    "rep": 2,
+}
+
+
+def build_cluster():
+    from tests.conftest import make_mini_cluster
+
+    return make_mini_cluster(
+        n_hosts=8,
+        pools=(
+            ("ec", POOLS["ec"], {"plugin": "tpu", "k": "4", "m": "2"}, 6),
+            ("rep", POOLS["rep"], None, 3),
+        ),
+    )
+
+
+#: simultaneous-death budget: EC m=2 and rep size-1=2 both tolerate 2
+MAX_DEAD = 2
+
+
+@pytest.mark.parametrize("seed", [1, 7])
+def test_thrash_with_consistency_oracle(seed):
+    rng = np.random.default_rng(seed)
+    cluster = build_cluster()
+    model: dict[tuple[int, str], bytes] = {}  # the RadosModel oracle
+    dead: list[int] = []
+
+    def payload() -> bytes:
+        n = int(rng.integers(1, 6000))
+        return rng.integers(0, 256, n, np.uint8).tobytes()
+
+    def check_all():
+        for (pool, name), want in model.items():
+            assert cluster.get(pool, name) == want, (pool, name)
+
+    ops = 0
+    for step in range(220):
+        op = rng.choice(
+            ["put", "put", "put", "get", "get", "overwrite", "kill",
+             "revive", "recover", "scrub"],
+        )
+        pool = int(rng.choice(list(POOLS.values())))
+        if op == "put":
+            ops += 1
+            name = f"o{int(rng.integers(0, 40))}"
+            data = payload()
+            cluster.put(pool, name, data)
+            model[(pool, name)] = data
+        elif op == "overwrite" and model:
+            ops += 1
+            keys = sorted(model)
+            pool, name = keys[int(rng.integers(0, len(keys)))]
+            data = payload()
+            cluster.put(pool, name, data)
+            model[(pool, name)] = data
+        elif op == "get" and model:
+            ops += 1
+            keys = sorted(model)
+            key = keys[int(rng.integers(0, len(keys)))]
+            assert cluster.get(*key) == model[key], key
+        elif op == "kill" and len(dead) < MAX_DEAD:
+            # chooseleaf spreads over hosts, so any MAX_DEAD osds (even two
+            # on one host) cost at most MAX_DEAD shards/copies per object
+            alive = [
+                o for o in range(cluster.osdmap.max_osd) if o not in dead
+            ]
+            victim = int(rng.choice(alive))
+            cluster.kill_osd(victim)
+            dead.append(victim)
+        elif op == "revive" and dead:
+            osd = dead.pop(int(rng.integers(0, len(dead))))
+            cluster.revive_osd(osd)
+            # amnesiac revival: rebuild what the new map expects of it
+            for pid in POOLS.values():
+                cluster.recover(pid)
+        elif op == "recover":
+            cluster.recover(pool)
+        elif op == "scrub":
+            # scrub must never invent errors on a cluster whose faults are
+            # only whole-OSD deaths; missing shards on dead/remapped homes
+            # are expected, digest errors are not
+            for e in cluster.scrub(pool, deep=True):
+                assert e.error in ("missing",), e
+            ops += 1
+        if step % 60 == 59:
+            check_all()  # full consistency sweep
+
+    # final: revive everything, recover, deep scrub ends clean
+    while dead:
+        cluster.revive_osd(dead.pop())
+    for pid in POOLS.values():
+        cluster.recover(pid)
+        cluster.repair(pid)
+        assert cluster.scrub(pid, deep=True) == []
+    check_all()
+    assert ops > 100  # the schedule really exercised the data path
+    dump = cluster.admin.handle("perf dump")["mini_cluster"]
+    assert dump["put_ops"] + dump["get_ops"] > 0
