@@ -1,0 +1,199 @@
+"""denc-lite: deterministic versioned binary encoding.
+
+The reference threads every wire/disk struct through bufferlist encoders with
+a versioned envelope (ENCODE_START/DECODE_START in
+/root/reference/src/include/encoding.h): each struct writes
+
+    u8 struct_v . u8 struct_compat . u32 struct_len . <payload>
+
+so old decoders can (a) refuse blobs whose `struct_compat` is newer than what
+they understand and (b) skip trailing payload bytes a newer encoder appended —
+that skip rule is what makes rolling upgrades possible, and the
+`ceph-dencoder` + ceph-object-corpus harness pins the exact bytes across
+releases (SURVEY §4 tier 2).
+
+This module re-expresses that contract: little-endian fixed-width primitives
+(the reference encodes everything little-endian via ceph_le types), u32
+length-prefixed blobs/strings/containers (matching encode(std::vector) /
+encode(std::map) shapes), and the versioned envelope with the same
+skip-unknown-suffix semantics. No reference bytes are reproduced — the layout
+rules are the contract, the structs encoded with it are ours.
+
+tests/test_encoding.py carries a small golden corpus (hex blobs committed in
+the repo) playing the role of ceph-object-corpus: any byte drift fails.
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+class DecodeError(ValueError):
+    pass
+
+
+class Encoder:
+    def __init__(self) -> None:
+        self._parts: list[bytes] = []
+
+    # -- primitives (little-endian, like ceph_le##) ---------------------------
+
+    def u8(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<B", v))
+        return self
+
+    def u16(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<H", v))
+        return self
+
+    def u32(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<I", v))
+        return self
+
+    def u64(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<Q", v))
+        return self
+
+    def s32(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<i", v))
+        return self
+
+    def s64(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<q", v))
+        return self
+
+    def f64(self, v: float) -> "Encoder":
+        self._parts.append(struct.pack("<d", v))
+        return self
+
+    def boolean(self, v: bool) -> "Encoder":
+        return self.u8(1 if v else 0)
+
+    # -- length-prefixed payloads ---------------------------------------------
+
+    def blob(self, v: bytes) -> "Encoder":
+        self.u32(len(v))
+        self._parts.append(bytes(v))
+        return self
+
+    def string(self, v: str) -> "Encoder":
+        return self.blob(v.encode("utf-8"))
+
+    def raw(self, v: bytes) -> "Encoder":
+        self._parts.append(bytes(v))
+        return self
+
+    def list(self, items, item_fn) -> "Encoder":
+        """u32 count + items, the encode(std::vector) shape."""
+        self.u32(len(items))
+        for it in items:
+            item_fn(self, it)
+        return self
+
+    def mapping(self, items: dict, key_fn, val_fn) -> "Encoder":
+        """u32 count + sorted (key, value) pairs.
+
+        std::map iterates in key order, which is what makes the reference's
+        map encodings deterministic; dicts are sorted here for the same
+        guarantee.
+        """
+        keys = sorted(items)
+        self.u32(len(keys))
+        for k in keys:
+            key_fn(self, k)
+            val_fn(self, items[k])
+        return self
+
+    # -- versioned envelope (ENCODE_START semantics) --------------------------
+
+    def struct(self, version: int, compat: int, body_fn) -> "Encoder":
+        body = Encoder()
+        body_fn(body)
+        payload = body.bytes()
+        self.u8(version).u8(compat).u32(len(payload))
+        self._parts.append(payload)
+        return self
+
+    def bytes(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Decoder:
+    def __init__(self, data: bytes, offset: int = 0, end: int | None = None):
+        self._data = data
+        self._off = offset
+        self._end = len(data) if end is None else end
+
+    def _take(self, n: int) -> bytes:
+        if self._off + n > self._end:
+            raise DecodeError(
+                f"buffer underrun: need {n} bytes at {self._off}, end {self._end}"
+            )
+        v = self._data[self._off : self._off + n]
+        self._off += n
+        return v
+
+    def remaining(self) -> int:
+        return self._end - self._off
+
+    def u8(self) -> int:
+        return struct.unpack("<B", self._take(1))[0]
+
+    def u16(self) -> int:
+        return struct.unpack("<H", self._take(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self._take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self._take(8))[0]
+
+    def s32(self) -> int:
+        return struct.unpack("<i", self._take(4))[0]
+
+    def s64(self) -> int:
+        return struct.unpack("<q", self._take(8))[0]
+
+    def f64(self) -> float:
+        return struct.unpack("<d", self._take(8))[0]
+
+    def boolean(self) -> bool:
+        return self.u8() != 0
+
+    def blob(self) -> bytes:
+        return self._take(self.u32())
+
+    def string(self) -> str:
+        return self.blob().decode("utf-8")
+
+    def raw(self, n: int) -> bytes:
+        return self._take(n)
+
+    def list(self, item_fn) -> list:
+        return [item_fn(self) for _ in range(self.u32())]
+
+    def mapping(self, key_fn, val_fn) -> dict:
+        n = self.u32()
+        out = {}
+        for _ in range(n):
+            k = key_fn(self)
+            out[k] = val_fn(self)
+        return out
+
+    def struct(self, understood_version: int, body_fn):
+        """DECODE_START: refuse blobs from a future incompatible encoder,
+        decode the payload, skip any suffix a newer-but-compatible encoder
+        appended."""
+        version = self.u8()
+        compat = self.u8()
+        length = self.u32()
+        if compat > understood_version:
+            raise DecodeError(
+                f"struct compat {compat} > understood version {understood_version}"
+            )
+        if self._off + length > self._end:
+            raise DecodeError("struct length exceeds buffer")
+        body = Decoder(self._data, self._off, self._off + length)
+        result = body_fn(body, version)
+        self._off += length  # skip anything body_fn did not consume
+        return result

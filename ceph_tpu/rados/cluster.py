@@ -33,7 +33,13 @@ client (the Objecter's resend contract), EIO poisoning of individual shards
 
 The cluster-level object registry stands in for the PG log (PGLog.cc): real
 OSDs discover objects per PG from their logs during peering; here recovery
-iterates the registry and asks the SAME placement/decode questions.
+iterates the registry and asks the SAME placement/decode questions. Each
+entry carries the object's version (object_info_t::version,
+osd_types.h:object_info_t): every put bumps it and stamps it on each
+replica/shard, and reads, recovery, and scrub accept only copies whose
+stamp matches — otherwise a kill -> write -> revive -> overwrite -> re-kill
+sequence could deterministically re-map onto a stray holding the older
+version and serve stale (or version-mixed) data.
 """
 
 from __future__ import annotations
@@ -56,8 +62,8 @@ from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE, OSDMap
 @dataclass
 class ScrubError:
     """One inconsistency found by scrub: shard is None for replicated
-    pools; error is missing | size_mismatch | read_error | hinfo_missing |
-    digest_mismatch."""
+    pools; error is missing | stale | size_mismatch | read_error |
+    hinfo_missing | digest_mismatch."""
 
     pool_id: int
     pg: int
@@ -68,14 +74,22 @@ class ScrubError:
 
 
 @dataclass
+class ObjectInfo:
+    """Registry entry per object — size + write version (object_info_t)."""
+
+    size: int
+    version: int
+
+
+@dataclass
 class MiniCluster:
     osdmap: OSDMap
     #: pool id -> erasure profile (with "plugin"), or None for replicated
     profiles: dict[int, dict | None] = field(default_factory=dict)
     stores: dict[int, MemStore] = field(default_factory=dict)
     _codecs: dict[int, object] = field(default_factory=dict)
-    #: (pool, name) -> object size; the PG-log stand-in (see module doc)
-    registry: dict[tuple[int, str], int] = field(default_factory=dict)
+    #: (pool, name) -> ObjectInfo; the PG-log stand-in (see module doc)
+    registry: dict[tuple[int, str], ObjectInfo] = field(default_factory=dict)
 
     def __post_init__(self):
         for osd in range(self.osdmap.max_osd):
@@ -161,12 +175,15 @@ class MiniCluster:
         ) as op:
             pg, acting = self.acting(pool_id, name)
             op.mark_event("placed")
+            prev = self.registry.get((pool_id, name))
+            ver = 1 if prev is None else prev.version + 1
             ec = self.codec(pool_id)
             if ec is None:  # replicated: full copy on every acting osd
                 for osd in acting:
                     if osd != CRUSH_ITEM_NONE:
                         self._op(
-                            self.stores[osd].write, (pool_id, pg, name), data
+                            self.stores[osd].write, (pool_id, pg, name), data,
+                            attrs={"ver": ver},
                         )
             else:
                 encoded = ec.encode(range(ec.get_chunk_count()), data)
@@ -181,13 +198,13 @@ class MiniCluster:
                         self.stores[osd].write,
                         (pool_id, pg, name, shard),
                         encoded[shard],
-                        attrs={"hinfo": hinfo},
+                        attrs={"hinfo": hinfo, "ver": ver},
                     )
             op.mark_event("stored")
             if (d := self.dlog.dout(5)) is not None:
                 d(f"put {pool_id}/{name} pg {pg} acting {acting} "
-                  f"{len(data)} bytes")
-            self.registry[(pool_id, name)] = len(data)
+                  f"{len(data)} bytes v{ver}")
+            self.registry[(pool_id, name)] = ObjectInfo(len(data), ver)
             self.log.inc("put_ops")
             self.log.inc("put_bytes", len(data))
 
@@ -201,27 +218,35 @@ class MiniCluster:
             return out
 
     def _get(self, pool_id: int, name: str, op) -> bytes:
-        size = self.registry.get((pool_id, name))
-        if size is None:
+        info = self.registry.get((pool_id, name))
+        if info is None:
             raise KeyError(f"no such object {name!r} in pool {pool_id}")
+        size = info.size
         pg, acting = self.acting(pool_id, name)
         ec = self.codec(pool_id)
         if ec is None:
             key = (pool_id, pg, name)
             candidates = [o for o in acting if o != CRUSH_ITEM_NONE]
-            # stray fallback: previous-interval OSDs may still hold copies
+            # stray fallback: previous-interval OSDs may still hold copies —
+            # but only at the current write version (module doc: strays can
+            # deterministically re-enter the acting set holding old data)
             candidates += [o for o in self.stores if o not in candidates]
             for osd in candidates:
-                if key not in self.stores[osd].objects:
+                store = self.stores[osd]
+                if key not in store.objects:
+                    continue
+                if store.attrs.get(key, {}).get("ver") != info.version:
                     continue
                 try:
-                    return self._op(self.stores[osd].read, key)
+                    return self._op(store.read, key)
                 except ObjectStoreError:
                     continue
             raise ErasureCodeError(5, f"no live replica of {name!r}")
 
         # EC read: probe shard availability, then read only the minimum set
-        available = self._probe_shards(pool_id, pg, name, ec, acting)
+        available = self._probe_shards(
+            pool_id, pg, name, ec, acting, info.version
+        )
         op.mark_event("probed")
         want = {ec.chunk_index(i) for i in range(ec.get_data_chunk_count())}
         if not want <= set(available):
@@ -234,16 +259,22 @@ class MiniCluster:
         )
 
     def _probe_shards(
-        self, pool_id, pg, name, ec, acting
+        self, pool_id, pg, name, ec, acting, version
     ) -> dict[int, int]:
-        """shard -> osd for every readable shard at its acting home."""
+        """shard -> osd for every readable current-version shard at its
+        acting home."""
         available: dict[int, int] = {}
         for shard, osd in enumerate(acting):
             if osd == CRUSH_ITEM_NONE:
                 continue
             store = self.stores[osd]
             key = (pool_id, pg, name, shard)
-            if store.alive and key not in store.eio_keys and key in store.objects:
+            if (
+                store.alive
+                and key not in store.eio_keys
+                and key in store.objects
+                and store.attrs.get(key, {}).get("ver") == version
+            ):
                 available[shard] = osd
         return available
 
@@ -292,17 +323,21 @@ class MiniCluster:
         """
         ec = self.codec(pool_id)
         errors: list[ScrubError] = []
-        for (pid, name), _ in list(self.registry.items()):
+        for (pid, name), info in list(self.registry.items()):
             if pid != pool_id:
                 continue
             pg, acting = self.acting(pool_id, name)
             if ec is None:
                 errors.extend(
-                    self._scrub_replicated(pool_id, pg, name, acting, deep)
+                    self._scrub_replicated(
+                        pool_id, pg, name, acting, deep, info.version
+                    )
                 )
             else:
                 errors.extend(
-                    self._scrub_ec(pool_id, pg, name, acting, ec, deep)
+                    self._scrub_ec(
+                        pool_id, pg, name, acting, ec, deep, info.version
+                    )
                 )
         self.log.inc("scrubs")
         self.log.inc("scrub_errors", len(errors))
@@ -322,7 +357,7 @@ class MiniCluster:
         best = max(counts, key=counts.get)
         return best if counts[best] * 2 > len(sizes) else None
 
-    def _scrub_ec(self, pool_id, pg, name, acting, ec, deep):
+    def _scrub_ec(self, pool_id, pg, name, acting, ec, deep, version):
         errors = []
         sizes: dict[int, int] = {}
         hinfo_size = None
@@ -334,6 +369,11 @@ class MiniCluster:
             if not store.alive or key not in store.objects:
                 errors.append(ScrubError(pool_id, pg, name, shard, osd,
                                          "missing"))
+                continue
+            if store.attrs.get(key, {}).get("ver") != version:
+                # an older write interval's shard at the acting home
+                errors.append(ScrubError(pool_id, pg, name, shard, osd,
+                                         "stale"))
                 continue
             sizes[shard] = len(store.objects[key])
             if hinfo_size is None:
@@ -375,7 +415,7 @@ class MiniCluster:
                                          "digest_mismatch"))
         return errors
 
-    def _scrub_replicated(self, pool_id, pg, name, acting, deep):
+    def _scrub_replicated(self, pool_id, pg, name, acting, deep, version):
         errors = []
         key = (pool_id, pg, name)
         digests: dict[int, int] = {}
@@ -387,6 +427,10 @@ class MiniCluster:
             if not store.alive or key not in store.objects:
                 errors.append(ScrubError(pool_id, pg, name, None, osd,
                                          "missing"))
+                continue
+            if store.attrs.get(key, {}).get("ver") != version:
+                errors.append(ScrubError(pool_id, pg, name, None, osd,
+                                         "stale"))
                 continue
             sizes[osd] = len(store.objects[key])
             if deep:
@@ -468,14 +512,16 @@ class MiniCluster:
         """
         ec = self.codec(pool_id)
         rebuilt = 0
-        for (pid, name), size in list(self.registry.items()):
+        for (pid, name), info in list(self.registry.items()):
             if pid != pool_id:
                 continue
+            ver = info.version
             pg, acting = self.acting(pool_id, name)
             if ec is None:
                 key = (pool_id, pg, name)
                 data = None
-                # acting homes first, then stray stores (MissingLoc contract)
+                # acting homes first, then stray stores (MissingLoc contract);
+                # only current-version copies are valid pull sources
                 candidates = [o for o in acting if o != CRUSH_ITEM_NONE]
                 candidates += [o for o in self.stores if o not in candidates]
                 for osd in candidates:
@@ -484,16 +530,21 @@ class MiniCluster:
                         store.alive
                         and key in store.objects
                         and key not in store.eio_keys
+                        and store.attrs.get(key, {}).get("ver") == ver
                     ):
                         data = store.objects[key]
                         break
                 if data is None:
                     continue
                 for osd in acting:
-                    if osd != CRUSH_ITEM_NONE and (
-                        key not in self.stores[osd].objects
+                    if osd == CRUSH_ITEM_NONE:
+                        continue
+                    st = self.stores[osd]
+                    if (
+                        key not in st.objects
+                        or st.attrs.get(key, {}).get("ver") != ver
                     ):
-                        self._op(self.stores[osd].write, key, data)
+                        self._op(st.write, key, data, attrs={"ver": ver})
                         rebuilt += 1
                 continue
 
@@ -505,7 +556,12 @@ class MiniCluster:
 
             def readable(osd: int, key: tuple) -> bool:
                 st = self.stores[osd]
-                return st.alive and key in st.objects and key not in st.eio_keys
+                return (
+                    st.alive
+                    and key in st.objects
+                    and key not in st.eio_keys
+                    and st.attrs.get(key, {}).get("ver") == ver
+                )
 
             for shard, osd in enumerate(acting):
                 key = (pool_id, pg, name, shard)
@@ -519,12 +575,12 @@ class MiniCluster:
                     available[shard] = stray
                 if osd != CRUSH_ITEM_NONE:
                     missing.append((shard, osd))
-            def hinfo_of(avail: dict[int, int]) -> dict | None:
+            def hinfo_of(avail: dict[int, int]) -> dict:
                 for s, o in avail.items():
                     a = self.stores[o].attrs.get((pool_id, pg, name, s))
                     if a and "hinfo" in a:
-                        return {"hinfo": a["hinfo"]}
-                return None
+                        return {"hinfo": a["hinfo"], "ver": ver}
+                return {"ver": ver}
 
             for shard, osd in missing:
                 key = (pool_id, pg, name, shard)

@@ -1,0 +1,139 @@
+"""denc-lite: round trips, envelope compat semantics, and a golden corpus.
+
+The golden blobs play the role the ceph-object-corpus submodule plays for
+ceph-dencoder (SURVEY §4 tier 2): committed bytes that must never drift."""
+
+import pytest
+
+from ceph_tpu.common.encoding import DecodeError, Decoder, Encoder
+
+
+def test_primitive_round_trip():
+    e = (
+        Encoder()
+        .u8(0xAB)
+        .u16(0xBEEF)
+        .u32(0xDEADBEEF)
+        .u64(0x0123456789ABCDEF)
+        .s32(-7)
+        .s64(-(1 << 40))
+        .f64(3.5)
+        .boolean(True)
+        .blob(b"\x00\x01\x02")
+        .string("pg_pool_t")
+    )
+    d = Decoder(e.bytes())
+    assert d.u8() == 0xAB
+    assert d.u16() == 0xBEEF
+    assert d.u32() == 0xDEADBEEF
+    assert d.u64() == 0x0123456789ABCDEF
+    assert d.s32() == -7
+    assert d.s64() == -(1 << 40)
+    assert d.f64() == 3.5
+    assert d.boolean() is True
+    assert d.blob() == b"\x00\x01\x02"
+    assert d.string() == "pg_pool_t"
+    assert d.remaining() == 0
+
+
+def test_containers_round_trip_and_map_determinism():
+    e = Encoder().list([3, 1, 2], lambda enc, v: enc.u32(v))
+    assert Decoder(e.bytes()).list(lambda d: d.u32()) == [3, 1, 2]
+
+    m = {5: "five", 1: "one", 3: "three"}
+    e1 = Encoder().mapping(m, lambda enc, k: enc.u32(k), lambda enc, v: enc.string(v))
+    # insertion order must not matter (std::map key order)
+    m2 = {1: "one", 3: "three", 5: "five"}
+    e2 = Encoder().mapping(m2, lambda enc, k: enc.u32(k), lambda enc, v: enc.string(v))
+    assert e1.bytes() == e2.bytes()
+    assert Decoder(e1.bytes()).mapping(lambda d: d.u32(), lambda d: d.string()) == m
+
+
+def test_envelope_skips_newer_compatible_suffix():
+    # a "v2" encoder appends a field a v1 decoder does not know about
+    blob = (
+        Encoder()
+        .struct(2, 1, lambda b: b.u32(42).string("extra-v2-field"))
+        .u32(0xCAFE)  # data following the struct must still be reachable
+        .bytes()
+    )
+    d = Decoder(blob)
+
+    def v1_reader(body, version):
+        assert version == 2
+        return body.u32()  # v1 only knows the first field
+
+    assert d.struct(1, v1_reader) == 42
+    assert d.u32() == 0xCAFE  # suffix was skipped correctly
+
+
+def test_envelope_refuses_incompatible_future_struct():
+    blob = Encoder().struct(3, 3, lambda b: b.u32(1)).bytes()
+    with pytest.raises(DecodeError, match="compat 3"):
+        Decoder(blob).struct(2, lambda b, v: b.u32())
+
+
+def test_envelope_length_beyond_buffer_rejected():
+    blob = bytearray(Encoder().struct(1, 1, lambda b: b.u32(7)).bytes())
+    blob[2] = 0xFF  # corrupt struct_len low byte
+    with pytest.raises(DecodeError, match="length exceeds"):
+        Decoder(bytes(blob)).struct(1, lambda b, v: b.u32())
+
+
+def test_underrun_raises():
+    with pytest.raises(DecodeError, match="underrun"):
+        Decoder(b"\x01").u32()
+
+
+# -- golden corpus ------------------------------------------------------------
+
+def _encode_sample() -> bytes:
+    """A representative struct: nested envelope, map, list, blob."""
+    return (
+        Encoder()
+        .struct(
+            1,
+            1,
+            lambda b: b.string("pool")
+            .u64(12345)
+            .mapping(
+                {2: b"\xde\xad", 0: b"\xbe\xef"},
+                lambda enc, k: enc.u32(k),
+                lambda enc, v: enc.blob(v),
+            )
+            .list([-1, 0, 1], lambda enc, v: enc.s32(v))
+            .struct(2, 1, lambda inner: inner.boolean(False).f64(-0.5)),
+        )
+        .bytes()
+    )
+
+
+def test_golden_corpus_no_drift():
+    got = _encode_sample().hex()
+    expected = (
+        "01014700000004000000706f6f6c393000000000000002000000000000000200"
+        "0000beef0200000002000000dead03000000ffffffff00000000010000000201"
+        "0900000000000000000000e0bf"
+    )
+    assert got == expected, got
+
+
+def test_golden_corpus_decodes():
+    d = Decoder(_encode_sample())
+
+    def body(b, version):
+        assert version == 1
+        name = b.string()
+        num = b.u64()
+        m = b.mapping(lambda dd: dd.u32(), lambda dd: dd.blob())
+        lst = b.list(lambda dd: dd.s32())
+        inner = b.struct(2, lambda bb, v: (bb.boolean(), bb.f64()))
+        return name, num, m, lst, inner
+
+    assert d.struct(1, body) == (
+        "pool",
+        12345,
+        {0: b"\xbe\xef", 2: b"\xde\xad"},
+        [-1, 0, 1],
+        (False, -0.5),
+    )

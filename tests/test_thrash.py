@@ -98,9 +98,10 @@ def test_thrash_with_consistency_oracle(seed):
         elif op == "scrub":
             # scrub must never invent errors on a cluster whose faults are
             # only whole-OSD deaths; missing shards on dead/remapped homes
-            # are expected, digest errors are not
+            # and stale strays re-entering an acting set after a remap are
+            # expected, digest errors are not
             for e in cluster.scrub(pool, deep=True):
-                assert e.error in ("missing",), e
+                assert e.error in ("missing", "stale"), e
             ops += 1
         if step % 60 == 59:
             check_all()  # full consistency sweep
@@ -116,3 +117,52 @@ def test_thrash_with_consistency_oracle(seed):
     assert ops > 100  # the schedule really exercised the data path
     dump = cluster.admin.handle("perf dump")["mini_cluster"]
     assert dump["put_ops"] + dump["get_ops"] > 0
+
+
+@pytest.mark.parametrize("pool", sorted(POOLS.values()))
+def test_stale_stray_never_resurrected(pool):
+    """kill+out -> write -> revive+in -> overwrite -> re-kill+out must not
+    serve the old version: marking the victim out makes CRUSH remap its
+    position to a stand-in; after the second out the SAME stand-in
+    deterministically re-enters the acting set still holding v1, and only
+    the version stamp (the registry's object_info_t role) keeps it out of
+    the read set. A down-but-in OSD leaves a NONE hole instead (no remap),
+    which is why this needs out, exactly like the reference."""
+    cluster = build_cluster()
+    name = "resurrect-me"
+    a0 = cluster.acting(pool, name)[1]
+    victim = next(o for o in a0 if o != 0x7FFFFFFF)
+
+    def fail(osd):
+        cluster.kill_osd(osd)
+        cluster.osdmap.mark_out(osd)
+
+    def rejoin(osd):
+        cluster.revive_osd(osd)
+        cluster.osdmap.reweight(osd, 0x10000)
+
+    fail(victim)
+    a1 = cluster.acting(pool, name)[1]
+    assert victim not in a1
+    standins = [o for o in a1 if o not in a0 and o != 0x7FFFFFFF]
+    assert standins  # out (unlike down) really remaps the position
+    v1 = b"\x01" * 4096
+    cluster.put(pool, name, v1)
+
+    rejoin(victim)
+    cluster.recover(pool)
+    assert cluster.acting(pool, name)[1] == a0  # back to the original homes
+
+    v2 = b"\x02" * 4100
+    cluster.put(pool, name, v2)  # the stand-in now holds a stale v1 stray
+
+    fail(victim)  # deterministically re-maps onto the stray
+    assert cluster.acting(pool, name)[1] == a1
+    assert cluster.get(pool, name) == v2
+
+    # scrub sees the stale copy for what it is, and repair replaces it
+    stales = [e for e in cluster.scrub(pool, deep=True) if e.error == "stale"]
+    assert stales, "the stale stray must be visible to scrub"
+    cluster.repair(pool)
+    assert cluster.scrub(pool, deep=True) == []
+    assert cluster.get(pool, name) == v2
