@@ -1,0 +1,38 @@
+"""msg: the wire-transport layer (L1).
+
+The reference's Messenger stack (src/msg: Messenger::create at
+Messenger.h:149, AsyncMessenger epoll loops, ProtocolV2 framing) carries
+every daemon-to-daemon and client-to-daemon message. Here the same contracts
+ride asyncio TCP on the host: deterministic crc-protected framing
+(frames.py), an entity-addressed Messenger with Dispatcher fast-dispatch,
+per-connection Policy (lossy client vs stateful lossless server) with
+seq/ack resend, cephx-style HMAC session auth + message signing, throttle
+backpressure, and config-driven fault injection (ms_inject_socket_failures,
+options.cc:1044-1066).
+
+TPU data-plane traffic does NOT go through this layer: bulk shard math moves
+between chips over ICI/DCN as XLA collectives (ceph_tpu.parallel); the
+messenger is the host control/data plane the reference's L1 provides —
+placement, sub-ops, maps, heartbeats.
+"""
+
+from ceph_tpu.msg.frames import Frame, FrameError, Message, Tag
+from ceph_tpu.msg.messenger import (
+    AsyncThrottle,
+    Connection,
+    Dispatcher,
+    Messenger,
+    Policy,
+)
+
+__all__ = [
+    "AsyncThrottle",
+    "Connection",
+    "Dispatcher",
+    "Frame",
+    "FrameError",
+    "Message",
+    "Messenger",
+    "Policy",
+    "Tag",
+]

@@ -1,0 +1,142 @@
+"""ProtocolV2-lite: the on-wire framing.
+
+The reference frames every exchange after the banner as tagged,
+crc-protected segments (src/msg/async/frames_v2.h: preamble with tag +
+segment count + per-segment crc32c; ProtocolV2.cc drives the handshake tag
+sequence HELLO -> AUTH_* -> SESSION). The same shape here, simplified to one
+segment per frame:
+
+    u32 magic | u8 tag | u32 len | payload[len] | u32 crc32c(payload)
+    [ + 16-byte truncated HMAC-SHA256 when the session is signing ]
+
+The trailing signature is the analogue of secure-mode rx/tx signing
+(msgr2 "crc mode with signatures"; CEPH_MSG_AUTH message signing in
+ProtocolV1): integrity + authenticity per frame under the session key, no
+encryption (the reference's default mode is crc, not secure, too).
+
+Messages (Tag.MESSAGE payloads) are denc-lite structs carrying
+(type, tid, seq, map_epoch, data) — the envelope fields every Message
+subclass in src/messages/ shares via its ceph_msg_header (type, seq, tid)
+plus the osd-op epoch the OSD uses to drop ops from stale clients.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+from dataclasses import dataclass
+from enum import IntEnum
+
+from ceph_tpu.common.crc import ceph_crc32c
+from ceph_tpu.common.encoding import Decoder, Encoder
+
+MAGIC = 0x43455054  # "CEPT"
+BANNER = b"ceph_tpu msgr v2\n"
+SIG_LEN = 16
+
+
+class FrameError(Exception):
+    pass
+
+
+class Tag(IntEnum):
+    HELLO = 1
+    AUTH_REQUEST = 2
+    AUTH_CHALLENGE = 3
+    AUTH_PROOF = 4
+    AUTH_DONE = 5
+    MESSAGE = 6
+    ACK = 7
+    KEEPALIVE = 8
+    RESET = 9
+
+
+@dataclass
+class Frame:
+    tag: Tag
+    payload: bytes
+
+    def encode(self, session_key: bytes | None = None) -> bytes:
+        e = (
+            Encoder()
+            .u32(MAGIC)
+            .u8(int(self.tag))
+            .blob(self.payload)
+            .u32(ceph_crc32c(0xFFFFFFFF, self.payload))
+        )
+        out = e.bytes()
+        if session_key is not None:
+            out += hmac_mod.new(session_key, out, hashlib.sha256).digest()[:SIG_LEN]
+        return out
+
+
+def frame_header_len() -> int:
+    return 4 + 1 + 4  # magic + tag + blob length prefix
+
+
+async def read_frame(reader, session_key: bytes | None = None) -> Frame:
+    """Read one frame from an asyncio StreamReader, verifying crc (and the
+    signature when the session is signing)."""
+    head = await reader.readexactly(frame_header_len())
+    d = Decoder(head)
+    magic = d.u32()
+    if magic != MAGIC:
+        raise FrameError(f"bad magic {magic:#x}")
+    tag = d.u8()
+    length = d.u32()
+    if length > 1 << 30:
+        raise FrameError(f"frame too large: {length}")
+    rest = await reader.readexactly(length + 4)
+    payload, crc_bytes = rest[:length], rest[length:]
+    if session_key is not None:
+        sig = await reader.readexactly(SIG_LEN)
+        want = hmac_mod.new(
+            session_key, head + rest, hashlib.sha256
+        ).digest()[:SIG_LEN]
+        if not hmac_mod.compare_digest(sig, want):
+            raise FrameError("frame signature mismatch")
+    if Decoder(crc_bytes).u32() != ceph_crc32c(0xFFFFFFFF, payload):
+        raise FrameError("frame crc mismatch")
+    try:
+        return Frame(Tag(tag), payload)
+    except ValueError as e:
+        raise FrameError(f"unknown tag {tag}") from e
+
+
+@dataclass
+class Message:
+    """The typed message envelope (ceph_msg_header essentials)."""
+
+    type: str  #: e.g. "osd_op", "osd_map", "ping" — src/messages/ analogue
+    tid: int = 0  #: client transaction id (resend correlation)
+    seq: int = 0  #: per-connection sequence (lossless resend/dedup)
+    epoch: int = 0  #: sender's map epoch (stale-op fencing)
+    data: bytes = b""
+
+    def encode(self) -> bytes:
+        return (
+            Encoder()
+            .struct(
+                1,
+                1,
+                lambda b: b.string(self.type)
+                .u64(self.tid)
+                .u64(self.seq)
+                .u64(self.epoch)
+                .blob(self.data),
+            )
+            .bytes()
+        )
+
+    @staticmethod
+    def decode(raw: bytes) -> "Message":
+        def body(b, version):
+            return Message(
+                type=b.string(),
+                tid=b.u64(),
+                seq=b.u64(),
+                epoch=b.u64(),
+                data=b.blob(),
+            )
+
+        return Decoder(raw).struct(1, body)

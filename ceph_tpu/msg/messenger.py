@@ -1,0 +1,527 @@
+"""The Messenger: entity-addressed async transport with resend semantics.
+
+Re-expresses the reference's messenger contracts (SURVEY §2.4) on asyncio
+TCP instead of epoll worker threads:
+
+  * `Messenger` owns a listening endpoint and a set of `Connection`s,
+    created lazily by `connect()` (Messenger::create + get_connection,
+    src/msg/Messenger.h:149; AsyncMessenger.cc).
+  * A `Dispatcher` receives every inbound message on its connection's
+    ordered stream (`ms_dispatch`, fast-dispatch analogue) plus accept and
+    reset events (`ms_handle_accept`, `ms_handle_reset`).
+  * `Policy` picks lossy vs lossless semantics (Messenger::Policy:
+    lossy_client / stateful_server ...). Lossless connections number every
+    message (seq), ack on receipt, resend un-acked messages in order after a
+    reconnect, and the receiving side drops duplicates by seq — the
+    ProtocolV1 lossless resend contract — with per-peer in_seq state owned
+    by the Messenger so dedup survives connection instances.
+  * Auth is cephx-shaped (src/auth): shared-secret keyring, server
+    challenge, HMAC proof, then a per-session key derived from
+    (secret, both nonces) signs every subsequent frame (message signing).
+    A wrong or missing key is refused with RESET before any message flows.
+  * Backpressure: an `AsyncThrottle` bounds in-flight dispatch bytes per
+    messenger (Policy::throttler_bytes, src/common/Throttle.cc usage in
+    AsyncConnection) — reads stall when the dispatcher falls behind.
+  * Fault injection straight from config (options.cc:1044-1066):
+    `ms_inject_socket_failures` = 1-in-N chance per frame I/O to drop the
+    socket; `ms_inject_internal_delays` = seconds to sleep around I/O.
+
+Delivery guarantees (tested in tests/test_messenger.py): lossless pairs
+deliver exactly once, in order, across injected socket failures; lossy
+connections may drop on failure but never duplicate or reorder.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac as hmac_mod
+import os
+import random
+from dataclasses import dataclass, field
+
+from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.msg.frames import (
+    BANNER,
+    Frame,
+    FrameError,
+    Message,
+    Tag,
+    read_frame,
+)
+
+
+@dataclass(frozen=True)
+class Policy:
+    """Connection semantics, Messenger::Policy."""
+
+    lossy: bool
+    #: reconnect on failure from this side (client of a stateful session)
+    client: bool = True
+
+    @staticmethod
+    def lossy_client() -> "Policy":
+        return Policy(lossy=True, client=True)
+
+    @staticmethod
+    def lossless_client() -> "Policy":
+        return Policy(lossy=False, client=True)
+
+    @staticmethod
+    def stateful_server() -> "Policy":
+        return Policy(lossy=False, client=False)
+
+
+class Dispatcher:
+    """Override any subset; all methods may be coroutines or plain."""
+
+    async def ms_dispatch(self, conn: "Connection", msg: Message) -> None:
+        pass
+
+    async def ms_handle_accept(self, conn: "Connection") -> None:
+        pass
+
+    async def ms_handle_reset(self, conn: "Connection") -> None:
+        pass
+
+
+async def _call(fn, *args):
+    r = fn(*args)
+    if asyncio.iscoroutine(r):
+        await r
+
+
+class AsyncThrottle:
+    """asyncio flavor of common/Throttle: bounds in-flight units."""
+
+    def __init__(self, max_units: int):
+        self._max = max_units
+        self._count = 0
+        self._cond = asyncio.Condition()
+
+    @property
+    def current(self) -> int:
+        return self._count
+
+    def _should_wait(self, c: int) -> bool:
+        if not self._max:
+            return False
+        return self._count + c > self._max and not (
+            c > self._max and self._count == 0
+        )
+
+    async def get(self, c: int = 1) -> None:
+        async with self._cond:
+            await self._cond.wait_for(lambda: not self._should_wait(c))
+            self._count += c
+
+    async def put(self, c: int = 1) -> None:
+        async with self._cond:
+            self._count = max(0, self._count - c)
+            self._cond.notify_all()
+
+
+class _InjectingStream:
+    """Wraps (reader, writer) applying config-driven fault injection to
+    every frame I/O — the transport-level ms_inject_* hooks."""
+
+    def __init__(self, reader, writer, messenger: "Messenger"):
+        self.reader = reader
+        self.writer = writer
+        self._m = messenger
+
+    async def _maybe_inject(self) -> None:
+        # Always yield once per frame: a burst of writes whose drain()
+        # completes synchronously (socket buffer has room) would otherwise
+        # starve the event loop, so the reader task never sees the ACKs the
+        # peer is streaming back and the resend window cannot shrink.
+        await asyncio.sleep(0)
+        m = self._m
+        delay = m.config.get("ms_inject_internal_delays")
+        if delay:
+            await asyncio.sleep(delay * m._rng.random())
+        every = m.config.get("ms_inject_socket_failures")
+        if every and m._rng.randrange(every) == 0:
+            m.injected_failures += 1
+            self.writer.close()
+            raise ConnectionResetError("injected socket failure")
+
+    async def send(self, frame: Frame, session_key: bytes | None) -> None:
+        await self._maybe_inject()
+        self.writer.write(frame.encode(session_key))
+        await self.writer.drain()
+
+    async def recv(self, session_key: bytes | None) -> Frame:
+        await self._maybe_inject()
+        return await read_frame(self.reader, session_key)
+
+
+class Connection:
+    """One peer session. Outgoing connections own the reconnect loop;
+    incoming ones are replaced by the next accept from the same peer."""
+
+    def __init__(
+        self,
+        messenger: "Messenger",
+        peer_addr: tuple[str, int] | None,
+        policy: Policy,
+        outgoing: bool,
+    ):
+        self.messenger = messenger
+        self.peer_addr = peer_addr
+        self.peer_name: str | None = None
+        self.policy = policy
+        self.outgoing = outgoing
+        self.session_key: bytes | None = None
+        self.out_seq = 0
+        self._unacked: list[Message] = []
+        self._send_q: asyncio.Queue = asyncio.Queue()
+        self._stream: _InjectingStream | None = None
+        self._closed = False
+        self._ready = asyncio.Event()
+        self._tasks: list[asyncio.Task] = []
+
+    # -- public API -----------------------------------------------------------
+
+    def send_message(self, msg: Message) -> None:
+        """Queue a message; never blocks (AsyncConnection::send_message)."""
+        if self._closed:
+            return
+        self.out_seq += 1
+        msg.seq = self.out_seq
+        if not self.policy.lossy:
+            self._unacked.append(msg)
+            if self.peer_name is not None:
+                # accepted (server-side) connections are re-created per
+                # accept; persisting the counter keeps seqs monotonic per
+                # peer across instances so the far side's dedup holds
+                self.messenger._peer_out_seq[self.peer_name] = self.out_seq
+        self._send_q.put_nowait(("msg", msg))
+
+    def send_keepalive(self) -> None:
+        if not self._closed:
+            self._send_q.put_nowait(("frame", Frame(Tag.KEEPALIVE, b"")))
+
+    async def close(self) -> None:
+        self._closed = True
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        if self._stream is not None:
+            self._stream.writer.close()
+            self._stream = None
+
+    @property
+    def is_connected(self) -> bool:
+        return self._stream is not None and self._ready.is_set()
+
+    # -- outgoing side --------------------------------------------------------
+
+    def _start_outgoing(self) -> None:
+        self._tasks.append(asyncio.create_task(self._run_outgoing()))
+
+    async def _run_outgoing(self) -> None:
+        backoff = 0.01
+        while not self._closed:
+            try:
+                reader, writer = await asyncio.open_connection(*self.peer_addr)
+                stream = _InjectingStream(reader, writer, self.messenger)
+                await self._client_handshake(stream)
+                self._stream = stream
+                backoff = 0.01
+                # Start reading BEFORE replaying so ACKs for replayed
+                # messages are processed as they come back: the un-acked
+                # window then shrinks monotonically across attempts and a
+                # high injected-failure rate still makes forward progress.
+                read_task = asyncio.create_task(self._read_loop(stream))
+                writer_task = None
+                try:
+                    # lossless: replay the un-acked window in order before
+                    # anything newly queued (requeue_sent, the ProtocolV1
+                    # contract); the writer must stay off until the replay
+                    # is done or new messages could overtake old seqs and
+                    # trip the receiver's duplicate filter
+                    if not self.policy.lossy:
+                        for m in list(self._unacked):
+                            if m not in self._unacked:
+                                continue  # acked while we were replaying
+                            await stream.send(
+                                Frame(Tag.MESSAGE, m.encode()),
+                                self.session_key,
+                            )
+                    self._ready.set()
+                    writer_task = asyncio.create_task(
+                        self._write_loop(stream)
+                    )
+                    await read_task
+                finally:
+                    for t in (read_task, writer_task):
+                        if t is not None:
+                            t.cancel()
+                            try:
+                                await t
+                            except (asyncio.CancelledError, Exception):
+                                pass
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+            self._ready.clear()
+            self._stream = None
+            if self._closed or self.policy.lossy:
+                if not self._closed:
+                    self._closed = True
+                    await _call(
+                        self.messenger.dispatcher.ms_handle_reset, self
+                    )
+                return
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 1.0)
+
+    async def _client_handshake(self, stream: _InjectingStream) -> None:
+        m = self.messenger
+        stream.writer.write(BANNER)
+        await stream.writer.drain()
+        if await stream.reader.readexactly(len(BANNER)) != BANNER:
+            raise FrameError("bad banner")
+        hello = Encoder().string(m.name).bytes()
+        await stream.send(Frame(Tag.HELLO, hello), None)
+        reply = await stream.recv(None)
+        if reply.tag != Tag.HELLO:
+            raise FrameError(f"expected HELLO, got {reply.tag}")
+        self.peer_name = Decoder(reply.payload).string()
+        if m.keyring is None:
+            return
+        secret = m.keyring.get(m.name)
+        if secret is None:
+            raise FrameError(f"no key for {m.name} in local keyring")
+        nonce_c = os.urandom(16)
+        await stream.send(
+            Frame(
+                Tag.AUTH_REQUEST,
+                Encoder().string(m.name).blob(nonce_c).bytes(),
+            ),
+            None,
+        )
+        chal = await stream.recv(None)
+        if chal.tag == Tag.RESET:
+            raise FrameError("auth refused")
+        if chal.tag != Tag.AUTH_CHALLENGE:
+            raise FrameError(f"expected AUTH_CHALLENGE, got {chal.tag}")
+        nonce_s = Decoder(chal.payload).blob()
+        proof = hmac_mod.new(
+            secret, nonce_c + nonce_s, hashlib.sha256
+        ).digest()
+        await stream.send(Frame(Tag.AUTH_PROOF, proof), None)
+        done = await stream.recv(None)
+        if done.tag != Tag.AUTH_DONE:
+            raise FrameError("auth refused")
+        self.session_key = _session_key(secret, nonce_c, nonce_s)
+
+    # -- shared loops ---------------------------------------------------------
+
+    async def _write_loop(self, stream: _InjectingStream) -> None:
+        while True:
+            kind, item = await self._send_q.get()
+            if kind == "msg":
+                frame = Frame(Tag.MESSAGE, item.encode())
+            else:
+                frame = item
+            await stream.send(frame, self.session_key)
+
+    async def _read_loop(self, stream: _InjectingStream) -> None:
+        m = self.messenger
+        while True:
+            frame = await stream.recv(self.session_key)
+            if frame.tag == Tag.MESSAGE:
+                msg = Message.decode(frame.payload)
+                # ack on receipt, then dedup by per-peer in_seq
+                if not self.policy.lossy:
+                    self._send_q.put_nowait(
+                        (
+                            "frame",
+                            Frame(
+                                Tag.ACK, Encoder().u64(msg.seq).bytes()
+                            ),
+                        )
+                    )
+                    last = m._peer_in_seq.get(self.peer_name, 0)
+                    if msg.seq <= last:
+                        continue  # duplicate from a resend window
+                    m._peer_in_seq[self.peer_name] = msg.seq
+                size = max(1, len(msg.data))
+                await m.dispatch_throttle.get(size)
+                try:
+                    await _call(m.dispatcher.ms_dispatch, self, msg)
+                finally:
+                    await m.dispatch_throttle.put(size)
+            elif frame.tag == Tag.ACK:
+                acked = Decoder(frame.payload).u64()
+                self._unacked = [
+                    mm for mm in self._unacked if mm.seq > acked
+                ]
+            elif frame.tag == Tag.KEEPALIVE:
+                pass
+            elif frame.tag == Tag.RESET:
+                raise ConnectionResetError("peer reset")
+            else:
+                raise FrameError(f"unexpected tag {frame.tag}")
+
+
+def _session_key(secret: bytes, nonce_c: bytes, nonce_s: bytes) -> bytes:
+    return hmac_mod.new(
+        secret, b"session" + nonce_c + nonce_s, hashlib.sha256
+    ).digest()
+
+
+class Messenger:
+    """One endpoint: a name, an optional listening address, connections."""
+
+    def __init__(
+        self,
+        name: str,
+        config=None,
+        keyring: dict[str, bytes] | None = None,
+        dispatch_throttle_bytes: int = 0,
+        seed: int | None = None,
+    ):
+        from ceph_tpu.common.config import Config
+
+        self.name = name
+        self.config = config if config is not None else Config()
+        self.keyring = keyring
+        self.dispatcher: Dispatcher = Dispatcher()
+        self.dispatch_throttle = AsyncThrottle(dispatch_throttle_bytes)
+        self._server: asyncio.base_events.Server | None = None
+        self.my_addr: tuple[str, int] | None = None
+        self._conns: dict[tuple[str, int], Connection] = {}
+        self._accepted: list[Connection] = []
+        self._peer_in_seq: dict[str | None, int] = {}
+        self._peer_out_seq: dict[str, int] = {}
+        self._rng = random.Random(seed)
+        self.injected_failures = 0
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def bind(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._server = await asyncio.start_server(self._accept, host, port)
+        self.my_addr = self._server.sockets[0].getsockname()[:2]
+
+    async def shutdown(self) -> None:
+        for conn in list(self._conns.values()) + list(self._accepted):
+            await conn.close()
+        self._conns.clear()
+        self._accepted.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- client side ----------------------------------------------------------
+
+    def connect(
+        self, addr: tuple[str, int], policy: Policy | None = None
+    ) -> Connection:
+        """Get (or lazily create) the connection to addr
+        (Messenger::connect_to / get_connection)."""
+        addr = tuple(addr)
+        conn = self._conns.get(addr)
+        if conn is not None and not conn._closed:
+            return conn
+        conn = Connection(
+            self, addr, policy or Policy.lossless_client(), outgoing=True
+        )
+        self._conns[addr] = conn
+        conn._start_outgoing()
+        return conn
+
+    async def wait_connected(self, conn: Connection, timeout: float = 5.0):
+        await asyncio.wait_for(conn._ready.wait(), timeout)
+
+    # -- server side ----------------------------------------------------------
+
+    async def _accept(self, reader, writer) -> None:
+        stream = _InjectingStream(reader, writer, self)
+        conn = Connection(
+            self, None, Policy.stateful_server(), outgoing=False
+        )
+        try:
+            if await reader.readexactly(len(BANNER)) != BANNER:
+                raise FrameError("bad banner")
+            writer.write(BANNER)
+            await writer.drain()
+            hello = await stream.recv(None)
+            if hello.tag != Tag.HELLO:
+                raise FrameError("expected HELLO")
+            conn.peer_name = Decoder(hello.payload).string()
+            conn.peer_addr = writer.get_extra_info("peername")[:2]
+            conn.out_seq = self._peer_out_seq.get(conn.peer_name, 0)
+            await stream.send(
+                Frame(Tag.HELLO, Encoder().string(self.name).bytes()), None
+            )
+            if self.keyring is not None:
+                if not await self._server_auth(stream, conn):
+                    writer.close()
+                    return
+            conn._stream = stream
+            conn._ready.set()
+            self._accepted.append(conn)
+            await _call(self.dispatcher.ms_handle_accept, conn)
+            writer_task = asyncio.create_task(conn._write_loop(stream))
+            conn._tasks.append(writer_task)
+            try:
+                await conn._read_loop(stream)
+            finally:
+                writer_task.cancel()
+                try:
+                    await writer_task
+                except (asyncio.CancelledError, Exception):
+                    pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass
+        finally:
+            conn._ready.clear()
+            conn._stream = None
+            if conn in self._accepted:
+                self._accepted.remove(conn)
+            writer.close()
+            if not conn._closed:
+                await _call(self.dispatcher.ms_handle_reset, conn)
+
+    async def _server_auth(
+        self, stream: _InjectingStream, conn: Connection
+    ) -> bool:
+        req = await stream.recv(None)
+        if req.tag != Tag.AUTH_REQUEST:
+            await stream.send(Frame(Tag.RESET, b""), None)
+            return False
+        d = Decoder(req.payload)
+        claimed = d.string()
+        nonce_c = d.blob()
+        secret = self.keyring.get(claimed)
+        if secret is None or claimed != conn.peer_name:
+            await stream.send(Frame(Tag.RESET, b""), None)
+            return False
+        nonce_s = os.urandom(16)
+        await stream.send(
+            Frame(Tag.AUTH_CHALLENGE, Encoder().blob(nonce_s).bytes()), None
+        )
+        proof = await stream.recv(None)
+        want = hmac_mod.new(
+            secret, nonce_c + nonce_s, hashlib.sha256
+        ).digest()
+        if proof.tag != Tag.AUTH_PROOF or not hmac_mod.compare_digest(
+            proof.payload, want
+        ):
+            await stream.send(Frame(Tag.RESET, b""), None)
+            return False
+        await stream.send(Frame(Tag.AUTH_DONE, b""), None)
+        conn.session_key = _session_key(secret, nonce_c, nonce_s)
+        return True
