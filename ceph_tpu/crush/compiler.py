@@ -373,7 +373,24 @@ def _item_name(cmap: CrushMap, item: int) -> str:
 
 
 def decompile_crushmap(cmap: CrushMap) -> str:
-    """CrushMap -> text, mirroring CrushCompiler::decompile's exact format."""
+    """CrushMap -> text, mirroring CrushCompiler::decompile's exact format.
+
+    Type ids with no registered name get a synthesized `type<N>` entry so
+    the output always re-compiles (the grammar requires bucket and
+    chooseleaf types to be declared names); maps built via the compiler or
+    with named types are unaffected."""
+    type_names = dict(cmap.type_names)
+    used_types = {b.type for b in cmap.buckets.values()}
+    for rule in cmap.rules.values():
+        for step in rule.steps:
+            if step.op in (
+                RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSE_INDEP,
+                RuleOp.CHOOSELEAF_FIRSTN, RuleOp.CHOOSELEAF_INDEP,
+            ):
+                used_types.add(step.arg2)
+    for tid in sorted(used_types):
+        type_names.setdefault(tid, f"type{tid}")
+
     out: list[str] = ["# begin crush map\n"]
     t = cmap.tunables
     for name, default in LEGACY_TUNABLES.items():
@@ -383,15 +400,16 @@ def decompile_crushmap(cmap: CrushMap) -> str:
 
     out.append("\n# devices\n")
     for dev in range(cmap.max_devices):
-        if dev in cmap.item_names:
-            line = f"device {dev} {cmap.item_names[dev]}"
-            if dev in cmap.device_classes:
-                line += f" class {cmap.device_classes[dev]}"
-            out.append(line + "\n")
+        # every slot is declared (named or `device<N>` fallback) so items
+        # can always resolve on re-compile, as the reference decompiler does
+        line = f"device {dev} {_item_name(cmap, dev)}"
+        if dev in cmap.device_classes:
+            line += f" class {cmap.device_classes[dev]}"
+        out.append(line + "\n")
 
     out.append("\n# types\n")
-    for type_id in sorted(cmap.type_names):
-        out.append(f"type {type_id} {cmap.type_names[type_id]}\n")
+    for type_id in sorted(type_names):
+        out.append(f"type {type_id} {type_names[type_id]}\n")
 
     out.append("\n# buckets\n")
     done: set[int] = set()
@@ -404,7 +422,7 @@ def decompile_crushmap(cmap: CrushMap) -> str:
         for item in b.items:
             if item < 0:
                 emit_bucket(item)
-        type_name = cmap.type_names.get(b.type, str(b.type))
+        type_name = type_names[b.type]
         out.append(f"{type_name} {_item_name(cmap, bid)} {{\n")
         out.append(f"\tid {bid}\t\t# do not change unnecessarily\n")
         out.append(f"\t# weight {_fixedpoint(b.weight)}\n")
@@ -474,7 +492,7 @@ def decompile_crushmap(cmap: CrushMap) -> str:
                     in (RuleOp.CHOOSE_FIRSTN, RuleOp.CHOOSELEAF_FIRSTN)
                     else "indep"
                 )
-                tname = cmap.type_names.get(step.arg2, str(step.arg2))
+                tname = type_names[step.arg2]
                 out.append(
                     f"\tstep {verb} {mode} {step.arg1} type {tname}\n"
                 )

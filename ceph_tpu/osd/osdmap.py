@@ -62,6 +62,9 @@ class OSDMap:
     )
     pg_temp: dict[tuple[int, int], list[int]] = field(default_factory=dict)
     primary_temp: dict[tuple[int, int], int] = field(default_factory=dict)
+    #: profile name -> k/v profile, stored in the map like the reference
+    #: (OSDMap::erasure_code_profiles; the mon validates + commits them)
+    erasure_code_profiles: dict[str, dict] = field(default_factory=dict)
 
     def __post_init__(self):
         n = self.max_osd
@@ -444,3 +447,328 @@ class OSDMap:
         if changed:
             self.epoch += 1
         return changed
+
+
+# -- incremental maps + encoding (OSDMap::Incremental, OSDMap.cc:encode) ------
+#
+# The reference distributes maps as versioned deltas: the mon commits an
+# OSDMap::Incremental per epoch (OSDMap.h:class Incremental) and every daemon
+# applies them in sequence; full maps are only sent to newcomers. The same
+# protocol here, encoded with denc-lite (ceph_tpu.common.encoding). The crush
+# map travels as its canonical crushtool text (compiled back on decode) —
+# byte-for-byte deterministic and human-auditable, the role the reference's
+# binary crush bufferlist plays.
+
+from dataclasses import dataclass as _dataclass, field as _field
+
+from ceph_tpu.common.encoding import Decoder as _Decoder, Encoder as _Encoder
+
+
+def _enc_pg(e, pg: tuple) -> None:
+    e.u64(pg[0]).u64(pg[1])
+
+
+def _dec_pg(d) -> tuple:
+    return (d.u64(), d.u64())
+
+
+def _enc_pool(e, p: PgPool) -> None:
+    e.struct(
+        1,
+        1,
+        lambda b: b.u32(p.pg_num)
+        .u32(p.pgp_num)
+        .u32(p.size)
+        .u32(p.min_size)
+        .u8(p.type)
+        .u32(p.crush_rule)
+        .u64(p.flags)
+        .string(p.erasure_code_profile),
+    )
+
+
+def _dec_pool(d) -> PgPool:
+    def body(b, version):
+        return PgPool(
+            pg_num=b.u32(),
+            pgp_num=b.u32(),
+            size=b.u32(),
+            min_size=b.u32(),
+            type=b.u8(),
+            crush_rule=b.u32(),
+            flags=b.u64(),
+            erasure_code_profile=b.string(),
+        )
+
+    return d.struct(1, body)
+
+
+def _enc_profile(e, prof: dict) -> None:
+    e.mapping(
+        {str(k): str(v) for k, v in prof.items()},
+        lambda enc, k: enc.string(k),
+        lambda enc, v: enc.string(v),
+    )
+
+
+def _dec_profile(d) -> dict:
+    return d.mapping(lambda dd: dd.string(), lambda dd: dd.string())
+
+
+@_dataclass
+class Incremental:
+    """One epoch's delta (OSDMap::Incremental, src/osd/OSDMap.h).
+
+    `epoch` is the epoch the delta PRODUCES: apply_incremental refuses it
+    unless the map is currently at epoch-1, which is what makes the mon's
+    commit sequence gap-free."""
+
+    epoch: int
+    new_max_osd: int | None = None
+    #: full crush replacement as canonical crushtool text (None = unchanged)
+    new_crush_text: str | None = None
+    new_up: list = _field(default_factory=list)
+    new_down: list = _field(default_factory=list)
+    #: osd -> 16.16 weight (0 = out); CEPH_OSD_IN = 0x10000
+    new_weight: dict = _field(default_factory=dict)
+    #: osd -> 16.16 primary affinity
+    new_primary_affinity: dict = _field(default_factory=dict)
+    new_pools: dict = _field(default_factory=dict)
+    old_pools: list = _field(default_factory=list)
+    new_erasure_code_profiles: dict = _field(default_factory=dict)
+    old_erasure_code_profiles: list = _field(default_factory=list)
+    new_pg_upmap: dict = _field(default_factory=dict)
+    old_pg_upmap: list = _field(default_factory=list)
+    new_pg_upmap_items: dict = _field(default_factory=dict)
+    old_pg_upmap_items: list = _field(default_factory=list)
+    #: pg -> acting override; empty list clears (OSDMap.cc new_pg_temp)
+    new_pg_temp: dict = _field(default_factory=dict)
+    #: pg -> primary; -1 clears
+    new_primary_temp: dict = _field(default_factory=dict)
+
+    def encode(self) -> bytes:
+        def body(b):
+            b.u64(self.epoch)
+            b.s32(-1 if self.new_max_osd is None else self.new_max_osd)
+            b.boolean(self.new_crush_text is not None)
+            if self.new_crush_text is not None:
+                b.string(self.new_crush_text)
+            b.list(sorted(self.new_up), lambda e, v: e.u32(v))
+            b.list(sorted(self.new_down), lambda e, v: e.u32(v))
+            b.mapping(self.new_weight, lambda e, k: e.u32(k),
+                      lambda e, v: e.u64(v))
+            b.mapping(self.new_primary_affinity, lambda e, k: e.u32(k),
+                      lambda e, v: e.u64(v))
+            b.mapping(self.new_pools, lambda e, k: e.u64(k), _enc_pool)
+            b.list(sorted(self.old_pools), lambda e, v: e.u64(v))
+            b.mapping(self.new_erasure_code_profiles,
+                      lambda e, k: e.string(k), _enc_profile)
+            b.list(sorted(self.old_erasure_code_profiles),
+                   lambda e, v: e.string(v))
+            b.mapping(self.new_pg_upmap, _enc_pg,
+                      lambda e, v: e.list(v, lambda ee, o: ee.s32(o)))
+            b.list(sorted(self.old_pg_upmap), _enc_pg)
+            b.mapping(
+                self.new_pg_upmap_items, _enc_pg,
+                lambda e, v: e.list(
+                    v, lambda ee, p: ee.s32(p[0]).s32(p[1])
+                ),
+            )
+            b.list(sorted(self.old_pg_upmap_items), _enc_pg)
+            b.mapping(self.new_pg_temp, _enc_pg,
+                      lambda e, v: e.list(v, lambda ee, o: ee.s32(o)))
+            b.mapping(self.new_primary_temp, _enc_pg,
+                      lambda e, v: e.s32(v))
+
+        return _Encoder().struct(1, 1, body).bytes()
+
+    @staticmethod
+    def decode(raw: bytes) -> "Incremental":
+        def body(b, version):
+            inc = Incremental(epoch=b.u64())
+            nmo = b.s32()
+            inc.new_max_osd = None if nmo < 0 else nmo
+            if b.boolean():
+                inc.new_crush_text = b.string()
+            inc.new_up = b.list(lambda d: d.u32())
+            inc.new_down = b.list(lambda d: d.u32())
+            inc.new_weight = b.mapping(lambda d: d.u32(), lambda d: d.u64())
+            inc.new_primary_affinity = b.mapping(
+                lambda d: d.u32(), lambda d: d.u64()
+            )
+            inc.new_pools = b.mapping(lambda d: d.u64(), _dec_pool)
+            inc.old_pools = b.list(lambda d: d.u64())
+            inc.new_erasure_code_profiles = b.mapping(
+                lambda d: d.string(), _dec_profile
+            )
+            inc.old_erasure_code_profiles = b.list(lambda d: d.string())
+            inc.new_pg_upmap = b.mapping(
+                _dec_pg, lambda d: d.list(lambda dd: dd.s32())
+            )
+            inc.old_pg_upmap = b.list(_dec_pg)
+            inc.new_pg_upmap_items = b.mapping(
+                _dec_pg,
+                lambda d: d.list(lambda dd: (dd.s32(), dd.s32())),
+            )
+            inc.old_pg_upmap_items = b.list(_dec_pg)
+            inc.new_pg_temp = b.mapping(
+                _dec_pg, lambda d: d.list(lambda dd: dd.s32())
+            )
+            inc.new_primary_temp = b.mapping(_dec_pg, lambda d: d.s32())
+            return inc
+
+        return _Decoder(raw).struct(1, body)
+
+
+def apply_incremental(self, inc: Incremental) -> None:
+    """OSDMap::apply_incremental (OSDMap.cc): strict epoch+1 sequencing."""
+    if inc.epoch != self.epoch + 1:
+        raise ValueError(
+            f"incremental for epoch {inc.epoch} cannot apply to map at "
+            f"epoch {self.epoch}"
+        )
+    if inc.new_max_osd is not None and inc.new_max_osd != self.max_osd:
+        n = inc.new_max_osd
+
+        def grow(arr, fill, dtype):
+            out = np.full(n, fill, dtype=dtype)
+            out[: min(len(arr), n)] = arr[: min(len(arr), n)]
+            return out
+
+        self.osd_exists = grow(self.osd_exists, True, bool)
+        self.osd_up = grow(self.osd_up, True, bool)
+        self.osd_weight = grow(self.osd_weight, 0x10000, np.int64)
+        if self.osd_primary_affinity is not None:
+            self.osd_primary_affinity = grow(
+                self.osd_primary_affinity, DEFAULT_PRIMARY_AFFINITY, np.int64
+            )
+        self.max_osd = n
+    if inc.new_crush_text is not None:
+        from ceph_tpu.crush.compiler import compile_crushmap
+
+        self.crush = compile_crushmap(inc.new_crush_text)
+        self.invalidate_compiled()
+    for osd in inc.new_up:
+        self.osd_up[osd] = True
+    for osd in inc.new_down:
+        self.osd_up[osd] = False
+    for osd, w in inc.new_weight.items():
+        self.osd_weight[osd] = w
+    if inc.new_primary_affinity:
+        if self.osd_primary_affinity is None:
+            self.osd_primary_affinity = np.full(
+                self.max_osd, DEFAULT_PRIMARY_AFFINITY, dtype=np.int64
+            )
+        for osd, a in inc.new_primary_affinity.items():
+            self.osd_primary_affinity[osd] = a
+    for pid, pool in inc.new_pools.items():
+        self.pools[pid] = pool
+    for pid in inc.old_pools:
+        self.pools.pop(pid, None)
+    for name, prof in inc.new_erasure_code_profiles.items():
+        self.erasure_code_profiles[name] = dict(prof)
+    for name in inc.old_erasure_code_profiles:
+        self.erasure_code_profiles.pop(name, None)
+    self.pg_upmap.update(inc.new_pg_upmap)
+    for pg in inc.old_pg_upmap:
+        self.pg_upmap.pop(pg, None)
+    self.pg_upmap_items.update(inc.new_pg_upmap_items)
+    for pg in inc.old_pg_upmap_items:
+        self.pg_upmap_items.pop(pg, None)
+    for pg, acting in inc.new_pg_temp.items():
+        if acting:
+            self.pg_temp[pg] = list(acting)
+        else:
+            self.pg_temp.pop(pg, None)
+    for pg, primary in inc.new_primary_temp.items():
+        if primary >= 0:
+            self.primary_temp[pg] = primary
+        else:
+            self.primary_temp.pop(pg, None)
+    self.epoch = inc.epoch
+
+
+def encode_osdmap(self) -> bytes:
+    """Full map for newcomers (OSDMap::encode)."""
+    from ceph_tpu.crush.compiler import decompile_crushmap
+
+    crush_text = decompile_crushmap(self.crush)
+
+    def body(b):
+        b.u64(self.epoch)
+        b.u32(self.max_osd)
+        b.string(crush_text)
+        b.blob(np.asarray(self.osd_exists, np.uint8).tobytes())
+        b.blob(np.asarray(self.osd_up, np.uint8).tobytes())
+        b.list(
+            [int(w) for w in self.osd_weight], lambda e, v: e.u64(v)
+        )
+        b.boolean(self.osd_primary_affinity is not None)
+        if self.osd_primary_affinity is not None:
+            b.list(
+                [int(a) for a in self.osd_primary_affinity],
+                lambda e, v: e.u64(v),
+            )
+        b.mapping(self.pools, lambda e, k: e.u64(k), _enc_pool)
+        b.mapping(self.erasure_code_profiles, lambda e, k: e.string(k),
+                  _enc_profile)
+        b.mapping(self.pg_upmap, _enc_pg,
+                  lambda e, v: e.list(v, lambda ee, o: ee.s32(o)))
+        b.mapping(
+            self.pg_upmap_items, _enc_pg,
+            lambda e, v: e.list(v, lambda ee, p: ee.s32(p[0]).s32(p[1])),
+        )
+        b.mapping(self.pg_temp, _enc_pg,
+                  lambda e, v: e.list(v, lambda ee, o: ee.s32(o)))
+        b.mapping(self.primary_temp, _enc_pg, lambda e, v: e.s32(v))
+
+    return _Encoder().struct(1, 1, body).bytes()
+
+
+def decode_osdmap(raw: bytes) -> "OSDMap":
+    from ceph_tpu.crush.compiler import compile_crushmap
+
+    def body(b, version):
+        epoch = b.u64()
+        max_osd = b.u32()
+        crush = compile_crushmap(b.string())
+        exists = np.frombuffer(b.blob(), np.uint8).astype(bool)
+        up = np.frombuffer(b.blob(), np.uint8).astype(bool)
+        weight = np.array(b.list(lambda d: d.u64()), dtype=np.int64)
+        paff = None
+        if b.boolean():
+            paff = np.array(b.list(lambda d: d.u64()), dtype=np.int64)
+        m = OSDMap(
+            crush=crush,
+            max_osd=max_osd,
+            epoch=epoch,
+            osd_exists=exists,
+            osd_up=up,
+            osd_weight=weight,
+            osd_primary_affinity=paff,
+        )
+        m.pools = b.mapping(lambda d: d.u64(), _dec_pool)
+        m.erasure_code_profiles = b.mapping(
+            lambda d: d.string(), _dec_profile
+        )
+        m.pg_upmap = b.mapping(
+            _dec_pg, lambda d: d.list(lambda dd: dd.s32())
+        )
+        m.pg_upmap_items = b.mapping(
+            _dec_pg, lambda d: d.list(lambda dd: (dd.s32(), dd.s32()))
+        )
+        m.pg_temp = b.mapping(
+            _dec_pg, lambda d: d.list(lambda dd: dd.s32())
+        )
+        m.primary_temp = b.mapping(_dec_pg, lambda d: d.s32())
+        return m
+
+    return _Decoder(raw).struct(1, body)
+
+
+# bound here so the dataclass body above stays focused on placement; these
+# names are the public API (map.apply_incremental(inc), map.encode(),
+# OSDMap.decode(raw))
+OSDMap.apply_incremental = apply_incremental
+OSDMap.encode = encode_osdmap
+OSDMap.decode = staticmethod(decode_osdmap)

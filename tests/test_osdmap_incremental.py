@@ -1,0 +1,148 @@
+"""Incremental map protocol: strict sequencing, delta semantics, and
+wire round trips (OSDMap::Incremental / OSDMap::encode analogues)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.compiler import decompile_crushmap
+from ceph_tpu.osd.osdmap import Incremental, OSDMap
+from ceph_tpu.osd.types import TYPE_ERASURE, PgPool
+
+
+def make_map():
+    from tests.conftest import make_mini_cluster
+
+    return make_mini_cluster(n_hosts=4).osdmap
+
+
+def test_apply_incremental_sequencing():
+    m = make_map()
+    e = m.epoch
+    inc = Incremental(epoch=e + 2)  # gap: must be refused
+    with pytest.raises(ValueError, match="epoch"):
+        m.apply_incremental(inc)
+    m.apply_incremental(Incremental(epoch=e + 1))
+    assert m.epoch == e + 1
+
+
+def test_incremental_deltas_match_direct_mutation():
+    a = make_map()
+    b = OSDMap.decode(a.encode())  # independent twin
+    e = a.epoch
+
+    # direct mutation on a
+    a.mark_down(3)          # epoch e+1
+    a.mark_out(3)           # epoch e+2
+    a.reweight(5, 0x8000)   # epoch e+3
+    a.pools[9] = PgPool(pg_num=8, size=4, type=TYPE_ERASURE, crush_rule=0)
+    a.erasure_code_profiles["p"] = {"k": "2", "m": "2", "plugin": "tpu"}
+    a.pg_upmap_items[(9, 3)] = [(1, 2)]
+    a.pg_temp[(9, 4)] = [0, 1, 2, 3]
+    a.primary_temp[(9, 4)] = 1
+
+    # the same story as three committed deltas on b
+    b.apply_incremental(Incremental(epoch=e + 1, new_down=[3]))
+    b.apply_incremental(Incremental(epoch=e + 2, new_weight={3: 0}))
+    b.apply_incremental(
+        Incremental(
+            epoch=e + 3,
+            new_weight={5: 0x8000},
+            new_pools={9: PgPool(pg_num=8, size=4, type=TYPE_ERASURE,
+                                 crush_rule=0)},
+            new_erasure_code_profiles={
+                "p": {"k": "2", "m": "2", "plugin": "tpu"}
+            },
+            new_pg_upmap_items={(9, 3): [(1, 2)]},
+            new_pg_temp={(9, 4): [0, 1, 2, 3]},
+            new_primary_temp={(9, 4): 1},
+        )
+    )
+
+    assert b.epoch == e + 3
+    assert bool(b.osd_up[3]) is False and int(b.osd_weight[3]) == 0
+    assert int(b.osd_weight[5]) == 0x8000
+    # identical placement semantics end-to-end
+    for pid in list(a.pools):
+        for ps in range(a.pools[pid].pg_num):
+            assert a.pg_to_up_acting_osds(pid, ps) == b.pg_to_up_acting_osds(
+                pid, ps
+            ), (pid, ps)
+
+
+def test_pg_temp_clear_and_primary_temp_clear():
+    m = make_map()
+    e = m.epoch
+    m.apply_incremental(
+        Incremental(epoch=e + 1, new_pg_temp={(1, 0): [1, 2]},
+                    new_primary_temp={(1, 0): 2})
+    )
+    assert m.pg_temp[(1, 0)] == [1, 2]
+    m.apply_incremental(
+        Incremental(epoch=e + 2, new_pg_temp={(1, 0): []},
+                    new_primary_temp={(1, 0): -1})
+    )
+    assert (1, 0) not in m.pg_temp and (1, 0) not in m.primary_temp
+
+
+def test_crush_change_via_incremental_reroutes_placement():
+    m = make_map()
+    before = {ps: m.pg_to_up_acting_osds(1, ps) for ps in range(8)}
+    text = decompile_crushmap(m.crush)
+    # drop one host's item weight to zero in the crushmap text (the root
+    # bucket's first child entry, not the informational `# weight` comment)
+    new_text = text.replace(
+        "item bucket2 weight 2.000", "item bucket2 weight 0.000"
+    )
+    assert new_text != text
+    m.apply_incremental(
+        Incremental(epoch=m.epoch + 1, new_crush_text=new_text)
+    )
+    after = {ps: m.pg_to_up_acting_osds(1, ps) for ps in range(8)}
+    assert before != after  # the topology change really re-routed PGs
+
+
+def test_incremental_encode_decode_round_trip():
+    inc = Incremental(
+        epoch=42,
+        new_max_osd=12,
+        new_crush_text="# crush map\n",
+        new_up=[1, 2],
+        new_down=[3],
+        new_weight={3: 0, 7: 0x12345},
+        new_primary_affinity={2: 0x8000},
+        new_pools={5: PgPool(pg_num=16, size=3)},
+        old_pools=[4],
+        new_erasure_code_profiles={"prof": {"k": "4", "m": "2"}},
+        old_erasure_code_profiles=["old"],
+        new_pg_upmap={(5, 1): [0, 1, 2]},
+        old_pg_upmap=[(5, 2)],
+        new_pg_upmap_items={(5, 3): [(1, 9)]},
+        old_pg_upmap_items=[(5, 4)],
+        new_pg_temp={(5, 5): [2, 1], (5, 6): []},
+        new_primary_temp={(5, 5): 1, (5, 6): -1},
+    )
+    got = Incremental.decode(inc.encode())
+    assert got == inc
+    # determinism: encode(decode(x)) == x
+    assert got.encode() == inc.encode()
+
+
+def test_full_map_encode_decode_round_trip():
+    m = make_map()
+    m.mark_down(2)
+    m.osd_weight[4] = 0x9000
+    m.erasure_code_profiles["p"] = {"k": "2", "m": "1"}
+    m.pg_temp[(1, 2)] = [5, 6]
+    raw = m.encode()
+    m2 = OSDMap.decode(raw)
+    assert m2.epoch == m.epoch
+    assert m2.max_osd == m.max_osd
+    assert np.array_equal(m2.osd_up, m.osd_up)
+    assert np.array_equal(m2.osd_weight, m.osd_weight)
+    assert m2.erasure_code_profiles == m.erasure_code_profiles
+    assert m2.encode() == raw  # deterministic re-encode
+    for pid in m.pools:
+        for ps in range(m.pools[pid].pg_num):
+            assert m.pg_to_up_acting_osds(pid, ps) == m2.pg_to_up_acting_osds(
+                pid, ps
+            )
