@@ -136,6 +136,11 @@ class Decoder:
     def remaining(self) -> int:
         return self._end - self._off
 
+    @property
+    def offset(self) -> int:
+        """Current read position (for record-stream consumers)."""
+        return self._off
+
     def u8(self) -> int:
         return struct.unpack("<B", self._take(1))[0]
 

@@ -1,0 +1,155 @@
+"""KV layer + transactional object store: atomicity, crash recovery from a
+torn WAL tail, and the KStore surface (collections, attrs, omap)."""
+
+import os
+
+import pytest
+
+from ceph_tpu.common.kv import FileDB, KVTransaction, MemDB
+from ceph_tpu.osd.ecutil import HashInfo
+from ceph_tpu.osd.objectstore import KStore, StoreError, Transaction
+
+
+# -- kv -----------------------------------------------------------------------
+
+def test_memdb_batch_and_iterate():
+    db = MemDB()
+    db.submit_transaction(
+        KVTransaction()
+        .set(b"p", b"b", b"2")
+        .set(b"p", b"a", b"1")
+        .set(b"q", b"x", b"9")
+    )
+    assert db.get(b"p", b"a") == b"1"
+    assert [k[1] for k, _ in db.iterate(b"p")] == [b"a", b"b"]
+    db.submit_transaction(KVTransaction().rm_prefix(b"p"))
+    assert list(db.iterate(b"p")) == []
+    assert db.get(b"q", b"x") == b"9"
+
+
+def test_filedb_durability_and_compact(tmp_path):
+    path = str(tmp_path / "db")
+    db = FileDB(path)
+    db.submit_transaction(KVTransaction().set(b"m", b"k1", b"v1"))
+    db.submit_transaction(KVTransaction().set(b"m", b"k2", b"v2"))
+    db.close()
+
+    db2 = FileDB(path)  # reopen: WAL replay
+    assert db2.get(b"m", b"k1") == b"v1"
+    assert db2.get(b"m", b"k2") == b"v2"
+    db2.compact()
+    db2.submit_transaction(KVTransaction().rm(b"m", b"k1"))
+    db2.close()
+
+    db3 = FileDB(path)  # snapshot + post-compact WAL
+    assert db3.get(b"m", b"k1") is None
+    assert db3.get(b"m", b"k2") == b"v2"
+    db3.close()
+
+
+def test_filedb_discards_torn_wal_tail(tmp_path):
+    """A crash mid-append must lose ONLY the torn record, atomically."""
+    path = str(tmp_path / "db")
+    db = FileDB(path)
+    db.submit_transaction(KVTransaction().set(b"m", b"good", b"1"))
+    db.submit_transaction(KVTransaction().set(b"m", b"also", b"2"))
+    db.close()
+
+    wal = os.path.join(path, "wal")
+    raw = open(wal, "rb").read()
+    # torn write: half the final record
+    open(wal, "wb").write(raw[: len(raw) - 7])
+    db2 = FileDB(path)
+    assert db2.get(b"m", b"good") == b"1"
+    assert db2.get(b"m", b"also") is None  # discarded whole, not half-applied
+    db2.close()
+
+    # corrupt (bit-flipped) tail record: same discipline
+    open(wal, "wb").write(raw[:-5] + bytes([raw[-5] ^ 0xFF]) + raw[-4:])
+    db3 = FileDB(path)
+    assert db3.get(b"m", b"good") == b"1"
+    assert db3.get(b"m", b"also") is None
+    db3.close()
+
+
+# -- object store -------------------------------------------------------------
+
+def make_store(tmp_path=None):
+    if tmp_path is None:
+        return KStore()
+    return KStore(FileDB(str(tmp_path / "store")))
+
+
+def test_kstore_transaction_surface():
+    st = make_store()
+    hi = HashInfo(4096, [1, 2, 3])
+    st.queue_transaction(
+        Transaction()
+        .create_collection("pg_1_0")
+        .write("pg_1_0", "obj-a", b"hello", attrs={"ver": 3, "hinfo": hi})
+        .touch("pg_1_0", "obj-b")
+        .omap_setkeys("pg_1_0", "obj-a", {b"k1": b"v1", b"k2": b"v2"})
+    )
+    assert st.collection_exists("pg_1_0")
+    assert st.read("pg_1_0", "obj-a") == b"hello"
+    attrs = st.getattrs("pg_1_0", "obj-a")
+    assert attrs["ver"] == 3 and attrs["hinfo"] == hi
+    assert st.read("pg_1_0", "obj-b") == b""
+    assert st.omap_get("pg_1_0", "obj-a") == {b"k1": b"v1", b"k2": b"v2"}
+    assert sorted(st.list_objects("pg_1_0")) == ["obj-a", "obj-b"]
+
+    st.queue_transaction(
+        Transaction()
+        .omap_rmkeys("pg_1_0", "obj-a", [b"k1"])
+        .remove("pg_1_0", "obj-b")
+    )
+    assert st.omap_get("pg_1_0", "obj-a") == {b"k2": b"v2"}
+    assert not st.exists("pg_1_0", "obj-b")
+    with pytest.raises(StoreError, match="does not exist"):
+        st.read("pg_1_0", "obj-b")
+
+
+def test_kstore_remove_collection_drops_rows():
+    st = make_store()
+    st.queue_transaction(
+        Transaction()
+        .create_collection("pg_1_0")
+        .create_collection("pg_1_1")
+        .write("pg_1_0", "o", b"x", attrs={"ver": 1})
+        .omap_setkeys("pg_1_0", "o", {b"a": b"b"})
+        .write("pg_1_1", "keep", b"y")
+    )
+    st.queue_transaction(Transaction().remove_collection("pg_1_0"))
+    assert not st.collection_exists("pg_1_0")
+    assert st.list_objects("pg_1_0") == []
+    assert st.omap_get("pg_1_0", "o") == {}
+    assert st.read("pg_1_1", "keep") == b"y"
+
+
+def test_kstore_restart_resumes_exactly(tmp_path):
+    """The OSD-restart story: reopen the store and find the last committed
+    transaction, attrs and omap intact."""
+    st = make_store(tmp_path)
+    st.queue_transaction(
+        Transaction()
+        .create_collection("pg_2_3")
+        .write("pg_2_3", "shard", b"\x01" * 512,
+               attrs={"ver": 7, "hinfo": HashInfo(512, [9, 9])})
+        .omap_setkeys("pg_2_3", "pglog", {b"0000007": b"entry"})
+    )
+    st.db.close()
+
+    st2 = KStore(FileDB(str(tmp_path / "store")))
+    assert st2.read("pg_2_3", "shard") == b"\x01" * 512
+    assert st2.getattrs("pg_2_3", "shard")["ver"] == 7
+    assert st2.omap_get("pg_2_3", "pglog") == {b"0000007": b"entry"}
+    st2.db.close()
+
+
+def test_touch_does_not_clobber():
+    st = make_store()
+    st.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", b"data")
+    )
+    st.queue_transaction(Transaction().touch("c", "o"))
+    assert st.read("c", "o") == b"data"
