@@ -122,6 +122,18 @@ SCHEMA: dict[str, Option] = {
              "concurrent recovery ops per OSD"),
         _opt("osd_heartbeat_grace", TYPE_UINT, LEVEL_ADVANCED, 20,
              "seconds before an unresponsive OSD is reported down"),
+        _opt("osd_heartbeat_interval", TYPE_FLOAT, LEVEL_ADVANCED, 6.0,
+             "seconds between peer pings"),
+        # monitor (mon_lease: Paxos.cc lease_interval; the election timeout
+        # plays Elector.cc's plugged election_timeout role)
+        _opt("mon_lease", TYPE_FLOAT, LEVEL_ADVANCED, 5.0,
+             "leader lease renewal interval (seconds)"),
+        _opt("mon_lease_ack_timeout_factor", TYPE_FLOAT, LEVEL_ADVANCED,
+             4.0, "lease multiples a peon waits before calling an election"),
+        _opt("mon_election_timeout", TYPE_FLOAT, LEVEL_ADVANCED, 5.0,
+             "seconds an election proposal waits for a quorum"),
+        _opt("mon_osd_min_down_reporters", TYPE_UINT, LEVEL_ADVANCED, 1,
+             "distinct reporters required to mark an OSD down"),
         # bench / profiling
         _opt("bench_profile_trace_dir", TYPE_STR, LEVEL_DEV, "",
              "write jax.profiler traces here when set",

@@ -1,0 +1,806 @@
+"""Monitor: rank election + Paxos commits + the OSDMonitor service.
+
+Shapes mirrored from the reference (src/mon):
+
+  * Election by rank (Elector.cc): every mon proposes itself; a mon that
+    hears a proposal from a LOWER rank defers and acks, a higher-ranked
+    proposal makes it counter-propose; the proposer declares victory once a
+    majority (counting itself) acks. The election epoch rises monotonically
+    and fences stale traffic.
+  * Paxos (Paxos.cc): the leader drives begin/accept/commit for one value
+    at a time — versioned, strictly sequential (version = last_committed+1).
+    Election acks double as the collect phase: they carry each peon's
+    last_committed and any accepted-but-uncommitted value, so a new leader
+    first syncs itself forward, re-proposes the highest-pn pending value,
+    and brings lagging peons up with explicit catch-up entries. Proposal
+    numbers are (election_epoch << 8 | rank) so every new reign outranks
+    the last. Leases (px_lease) keep peons from calling elections while the
+    leader is healthy; a missed lease window triggers one.
+  * Services (PaxosService): every committed value is tagged with a service
+    name; the only v1 service is "osdmap", whose values are OSDMap
+    Incrementals (OSDMonitor.cc): pool/profile admin (EC profiles validated
+    by instantiating the codec, OSDMonitor.cc:6814), osd boot registering
+    the daemon's address, failure reports gated by
+    mon_osd_min_down_reporters (prepare_failure, OSDMonitor.cc:2874), and
+    pg-temp requests from peering primaries.
+  * Subscriptions (Monitor::handle_subscribe): daemons/clients say "osdmap
+    from epoch E" and receive the incrementals they miss (or a full map if
+    too far behind), then every future commit as it happens.
+
+All state that must survive a crash sits in a KeyValueDB under the "paxos"
+and "osdmap" prefixes (MonitorDBStore role); a restarted mon rejoins with
+its history intact.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+from dataclasses import dataclass
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.common.kv import KeyValueDB, KVTransaction, MemDB
+from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy
+from ceph_tpu.osd.osdmap import Incremental, OSDMap
+
+_META = b"paxos_meta"
+_VALS = b"paxos"
+
+
+def _vkey(version: int) -> bytes:
+    return b"%016x" % version
+
+
+@dataclass
+class MonMap:
+    """rank -> address; names are mon.<rank> (the reference's MonMap)."""
+
+    addrs: list[tuple[str, int]]
+
+    @property
+    def size(self) -> int:
+        return len(self.addrs)
+
+    @property
+    def majority(self) -> int:
+        return self.size // 2 + 1
+
+    def name(self, rank: int) -> str:
+        return f"mon.{rank}"
+
+
+class Monitor(Dispatcher):
+    def __init__(
+        self,
+        rank: int,
+        monmap: MonMap,
+        initial_osdmap: OSDMap,
+        db: KeyValueDB | None = None,
+        config: Config | None = None,
+        keyring: dict[str, bytes] | None = None,
+    ):
+        self.rank = rank
+        self.monmap = monmap
+        self.config = config if config is not None else Config()
+        self.db = db if db is not None else MemDB()
+        self.name = monmap.name(rank)
+        self.messenger = Messenger(
+            self.name, config=self.config, keyring=keyring
+        )
+        self.messenger.dispatcher = self
+
+        # election state
+        self.state = "electing"
+        self.election_epoch = self._load_u64(b"election_epoch", 0)
+        self.leader_rank: int | None = None
+        self.quorum: set[int] = set()
+        self._acks: dict[int, dict] = {}
+        self._election_task: asyncio.Task | None = None
+        self._lease_task: asyncio.Task | None = None
+        self._last_lease = 0.0
+
+        # paxos state (persisted)
+        self.last_committed = self._load_u64(b"last_committed", 0)
+        self.promised_pn = self._load_u64(b"promised_pn", 0)
+        self._pending = self._load_pending()
+        self._propose_q: list[tuple[str, bytes, asyncio.Future]] = []
+        self._in_flight: dict | None = None
+
+        # osdmap service state
+        self.osdmap = OSDMap.decode(initial_osdmap.encode())
+        self._osdmap_base_epoch = self.osdmap.epoch
+        self._replay_committed()
+        #: peer_name -> (connection, from_epoch) map subscribers
+        self._subs: dict[str, object] = {}
+        #: failed osd -> set of reporter names (OSDMonitor failure_info)
+        self._failure_reports: dict[int, set[str]] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = False
+
+    # -- persistence helpers --------------------------------------------------
+
+    def _load_u64(self, key: bytes, default: int) -> int:
+        raw = self.db.get(_META, key)
+        return default if raw is None else Decoder(raw).u64()
+
+    def _store_meta(self, txn: KVTransaction, key: bytes, v: int) -> None:
+        txn.set(_META, key, Encoder().u64(v).bytes())
+
+    def _load_pending(self):
+        raw = self.db.get(_META, b"pending")
+        if raw is None:
+            return None
+        d = Decoder(raw)
+        return {"pn": d.u64(), "version": d.u64(), "value": d.blob()}
+
+    def _store_pending(self, txn: KVTransaction, pending) -> None:
+        if pending is None:
+            txn.rm(_META, b"pending")
+        else:
+            txn.set(
+                _META,
+                b"pending",
+                Encoder()
+                .u64(pending["pn"])
+                .u64(pending["version"])
+                .blob(pending["value"])
+                .bytes(),
+            )
+
+    def _replay_committed(self) -> None:
+        """Rebuild the in-memory osdmap from the committed paxos log."""
+        for v in range(1, self.last_committed + 1):
+            raw = self.db.get(_VALS, _vkey(v))
+            if raw is not None:
+                self._apply_value(raw)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def bind(self) -> None:
+        """Bind the endpoint; port 0 back-fills the shared monmap with the
+        kernel-assigned port (test clusters bind everyone before anyone
+        campaigns, so peer addresses are always real)."""
+        host, port = self.monmap.addrs[self.rank]
+        await self.messenger.bind(host, port)
+        self.monmap.addrs[self.rank] = tuple(self.messenger.my_addr)
+
+    def go(self) -> None:
+        self._tasks.append(asyncio.create_task(self._lease_watchdog()))
+        self.start_election()
+
+    async def start(self) -> None:
+        await self.bind()
+        self.go()
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for extra in (self._election_task, self._lease_task):
+            if extra is not None:
+                self._tasks.append(extra)
+        self._election_task = self._lease_task = None
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.messenger.shutdown()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.state == "leader"
+
+    def _peer_conn(self, rank: int):
+        return self.messenger.connect(
+            tuple(self.monmap.addrs[rank]), Policy.lossless_client()
+        )
+
+    def _bcast(self, msg_type: str, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        for r in range(self.monmap.size):
+            if r != self.rank:
+                self._peer_conn(r).send_message(
+                    Message(type=msg_type, data=data)
+                )
+
+    def _send(self, rank_or_conn, msg_type: str, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        conn = (
+            self._peer_conn(rank_or_conn)
+            if isinstance(rank_or_conn, int)
+            else rank_or_conn
+        )
+        conn.send_message(Message(type=msg_type, data=data))
+
+    # -- election -------------------------------------------------------------
+
+    def start_election(self) -> None:
+        if self._stopped:
+            return
+        self.state = "electing"
+        self.leader_rank = None
+        self.election_epoch += 1
+        txn = KVTransaction()
+        self._store_meta(txn, b"election_epoch", self.election_epoch)
+        self.db.submit_transaction(txn)
+        self._acks = {}
+        self._bcast(
+            "el_propose",
+            {
+                "epoch": self.election_epoch,
+                "rank": self.rank,
+                "last_committed": self.last_committed,
+            },
+        )
+        if self._election_task is not None:
+            self._election_task.cancel()
+        self._election_task = asyncio.create_task(self._election_timer())
+        # single-mon cluster: instant victory
+        self._maybe_win()
+
+    async def _election_timer(self) -> None:
+        timeout = self.config.get("mon_election_timeout")
+        await asyncio.sleep(timeout * (1 + random.random() * 0.2))
+        if self.state == "electing" and not self._stopped:
+            self.start_election()
+
+    def _maybe_win(self) -> None:
+        if self.state != "electing":
+            return
+        if len(self._acks) + 1 >= self.monmap.majority:
+            self.state = "leader"
+            self.leader_rank = self.rank
+            self.quorum = {self.rank} | set(self._acks)
+            self._bcast(
+                "el_victory",
+                {
+                    "epoch": self.election_epoch,
+                    "leader": self.rank,
+                    "quorum": sorted(self.quorum),
+                },
+            )
+            if self._election_task is not None:
+                self._election_task.cancel()
+                self._election_task = None
+            if self._lease_task is not None:
+                self._lease_task.cancel()
+            self._lease_task = asyncio.create_task(self._lease_loop())
+            self._tasks.append(
+                asyncio.create_task(self._post_election_sync())
+            )
+
+    async def _post_election_sync(self) -> None:
+        """Collect phase: catch up from any peon ahead of us, then
+        re-propose the highest-pn uncommitted value (Paxos.cc collect/
+        handle_last semantics)."""
+        ahead = [
+            (info["last_committed"], r)
+            for r, info in self._acks.items()
+            if info["last_committed"] > self.last_committed
+        ]
+        if ahead:
+            _, r = max(ahead)
+            self._send(
+                r, "px_fetch", {"from": self.last_committed + 1,
+                                "to_rank": self.rank}
+            )
+            return  # sync continues when entries arrive
+        self._finish_election_sync()
+
+    def _finish_election_sync(self) -> None:
+        pendings = [
+            info["pending"]
+            for info in self._acks.values()
+            if info.get("pending") is not None
+        ]
+        if self._pending is not None:
+            pendings.append(
+                {
+                    "pn": self._pending["pn"],
+                    "version": self._pending["version"],
+                    "value": self._pending["value"].hex(),
+                }
+            )
+        live = [
+            p for p in pendings if p["version"] == self.last_committed + 1
+        ]
+        if live:
+            best = max(live, key=lambda p: p["pn"])
+            self._tasks.append(
+                asyncio.create_task(
+                    self._drive_proposal(bytes.fromhex(best["value"]), None)
+                )
+            )
+        self._kick_propose_queue()
+
+    async def _lease_loop(self) -> None:
+        interval = self.config.get("mon_lease")
+        while self.is_leader and not self._stopped:
+            self._bcast(
+                "px_lease",
+                {"epoch": self.election_epoch,
+                 "last_committed": self.last_committed},
+            )
+            await asyncio.sleep(interval)
+
+    async def _lease_watchdog(self) -> None:
+        interval = self.config.get("mon_lease")
+        factor = self.config.get("mon_lease_ack_timeout_factor")
+        loop = asyncio.get_event_loop()
+        self._last_lease = loop.time()
+        while not self._stopped:
+            await asyncio.sleep(interval)
+            if self.state == "peon" and (
+                loop.time() - self._last_lease > interval * factor
+            ):
+                self.start_election()
+
+    # -- paxos ----------------------------------------------------------------
+
+    def _pn(self) -> int:
+        return (self.election_epoch << 8) | self.rank
+
+    async def propose(self, service: str, payload: bytes) -> None:
+        """Queue a value for commit; resolves when committed locally."""
+        fut = asyncio.get_event_loop().create_future()
+        value = Encoder().string(service).blob(payload).bytes()
+        self._propose_q.append((service, value, fut))
+        self._kick_propose_queue()
+        await fut
+
+    def _kick_propose_queue(self) -> None:
+        if (
+            self.is_leader
+            and self._in_flight is None
+            and self._propose_q
+        ):
+            _service, value, fut = self._propose_q.pop(0)
+            self._tasks.append(
+                asyncio.create_task(self._drive_proposal(value, fut))
+            )
+
+    async def _drive_proposal(self, value: bytes, fut) -> None:
+        version = self.last_committed + 1
+        pn = self._pn()
+        self._in_flight = {
+            "pn": pn,
+            "version": version,
+            "value": value,
+            "accepts": {self.rank},
+            "fut": fut,
+        }
+        txn = KVTransaction()
+        self._store_pending(
+            txn, {"pn": pn, "version": version, "value": value}
+        )
+        self._store_meta(txn, b"promised_pn", pn)
+        self.db.submit_transaction(txn)
+        self.promised_pn = pn
+        self._pending = {"pn": pn, "version": version, "value": value}
+        self._bcast(
+            "px_begin",
+            {"epoch": self.election_epoch, "pn": pn, "version": version,
+             "value": value.hex()},
+        )
+        self._check_accepts()
+
+    def _check_accepts(self) -> None:
+        fl = self._in_flight
+        if fl is None:
+            return
+        if len(fl["accepts"]) >= self.monmap.majority:
+            self._commit_value(fl["version"], fl["value"])
+            self._bcast(
+                "px_commit",
+                {"epoch": self.election_epoch, "version": fl["version"],
+                 "value": fl["value"].hex()},
+            )
+            if fl["fut"] is not None and not fl["fut"].done():
+                fl["fut"].set_result(None)
+            self._in_flight = None
+            self._kick_propose_queue()
+
+    def _commit_value(self, version: int, value: bytes) -> None:
+        if version != self.last_committed + 1:
+            return
+        txn = KVTransaction()
+        txn.set(_VALS, _vkey(version), value)
+        self._store_meta(txn, b"last_committed", version)
+        self._store_pending(txn, None)
+        self.db.submit_transaction(txn)
+        self.last_committed = version
+        self._pending = None
+        self._apply_value(value)
+        self._publish_maps()
+
+    def _apply_value(self, value: bytes) -> None:
+        d = Decoder(value)
+        service = d.string()
+        payload = d.blob()
+        if service == "osdmap":
+            inc = Incremental.decode(payload)
+            if inc.epoch == self.osdmap.epoch + 1:
+                self.osdmap.apply_incremental(inc)
+
+    # -- map subscription / publication ---------------------------------------
+
+    def _inc_for_epoch(self, epoch: int) -> bytes | None:
+        """Committed incremental bytes producing map `epoch`, if retained."""
+        # paxos version v produced map epoch base + v (1:1, osdmap-only mon)
+        v = epoch - self._osdmap_base_epoch
+        raw = self.db.get(_VALS, _vkey(v)) if v >= 1 else None
+        if raw is None:
+            return None
+        d = Decoder(raw)
+        if d.string() != "osdmap":
+            return None
+        return d.blob()
+
+    def _map_payload(self, from_epoch: int) -> dict:
+        """Incrementals (from_epoch, current] or a full map."""
+        incs = []
+        e = from_epoch + 1
+        while e <= self.osdmap.epoch:
+            raw = self._inc_for_epoch(e)
+            if raw is None:
+                return {"full": self.osdmap.encode().hex(),
+                        "epoch": self.osdmap.epoch}
+            incs.append(raw.hex())
+            e += 1
+        return {"incs": incs, "epoch": self.osdmap.epoch}
+
+    def _publish_maps(self) -> None:
+        for peer, (conn, from_epoch) in list(self._subs.items()):
+            if from_epoch < self.osdmap.epoch:
+                self._send(conn, "osd_map", self._map_payload(from_epoch))
+                self._subs[peer] = (conn, self.osdmap.epoch)
+
+    # -- dispatch -------------------------------------------------------------
+
+    async def ms_dispatch(self, conn, msg: Message) -> None:
+        p = json.loads(msg.data) if msg.data else {}
+        handler = getattr(self, f"_h_{msg.type}", None)
+        if handler is not None:
+            await handler(conn, p)
+
+    async def ms_handle_reset(self, conn) -> None:
+        # losing the leader's session forces a new election
+        if (
+            self.state == "peon"
+            and self.leader_rank is not None
+            and conn.peer_name == self.monmap.name(self.leader_rank)
+        ):
+            self.start_election()
+
+    # election messages
+
+    async def _h_el_propose(self, conn, p) -> None:
+        if p["epoch"] > self.election_epoch:
+            self.election_epoch = p["epoch"]
+            self.state = "electing"
+        if p["rank"] < self.rank:
+            pending = None
+            if self._pending is not None:
+                pending = {
+                    "pn": self._pending["pn"],
+                    "version": self._pending["version"],
+                    "value": self._pending["value"].hex(),
+                }
+            self._send(
+                p["rank"],
+                "el_ack",
+                {
+                    "epoch": p["epoch"],
+                    "rank": self.rank,
+                    "last_committed": self.last_committed,
+                    "pending": pending,
+                },
+            )
+            if self._election_task is not None:
+                self._election_task.cancel()
+            self._election_task = asyncio.create_task(
+                self._election_timer()
+            )
+        elif self.state != "electing" or p["epoch"] >= self.election_epoch:
+            # a higher rank is campaigning: counter-propose ourselves
+            self.start_election()
+
+    async def _h_el_ack(self, conn, p) -> None:
+        if p["epoch"] != self.election_epoch:
+            return
+        if self.state == "leader":
+            # a straggler acked after victory: fold it into the quorum and
+            # re-announce so it becomes a peon of this reign
+            if p["rank"] not in self.quorum:
+                self._acks[p["rank"]] = p
+                self.quorum.add(p["rank"])
+                self._bcast(
+                    "el_victory",
+                    {"epoch": self.election_epoch, "leader": self.rank,
+                     "quorum": sorted(self.quorum)},
+                )
+            return
+        if self.state != "electing":
+            return
+        self._acks[p["rank"]] = p
+        self._maybe_win()
+
+    async def _h_el_victory(self, conn, p) -> None:
+        if p["epoch"] < self.election_epoch:
+            return
+        self.election_epoch = p["epoch"]
+        self.state = "peon"
+        self.leader_rank = p["leader"]
+        self.quorum = set(p["quorum"])
+        self._last_lease = asyncio.get_event_loop().time()
+        if self._election_task is not None:
+            self._election_task.cancel()
+            self._election_task = None
+
+    # paxos messages
+
+    async def _h_px_begin(self, conn, p) -> None:
+        if p["pn"] >= self.promised_pn and (
+            p["version"] == self.last_committed + 1
+        ):
+            value = bytes.fromhex(p["value"])
+            txn = KVTransaction()
+            self._store_meta(txn, b"promised_pn", p["pn"])
+            self._store_pending(
+                txn,
+                {"pn": p["pn"], "version": p["version"], "value": value},
+            )
+            self.db.submit_transaction(txn)
+            self.promised_pn = p["pn"]
+            self._pending = {
+                "pn": p["pn"], "version": p["version"], "value": value
+            }
+            self._send(
+                conn,
+                "px_accept",
+                {"pn": p["pn"], "version": p["version"],
+                 "rank": self.rank},
+            )
+        else:
+            self._send(
+                conn,
+                "px_nack",
+                {"rank": self.rank,
+                 "last_committed": self.last_committed},
+            )
+
+    async def _h_px_accept(self, conn, p) -> None:
+        fl = self._in_flight
+        if fl is not None and p["pn"] == fl["pn"] and (
+            p["version"] == fl["version"]
+        ):
+            fl["accepts"].add(p["rank"])
+            self._check_accepts()
+
+    async def _h_px_nack(self, conn, p) -> None:
+        # the peon is behind: ship it the committed entries it lacks
+        if p["last_committed"] < self.last_committed:
+            entries = {
+                v: self.db.get(_VALS, _vkey(v)).hex()
+                for v in range(p["last_committed"] + 1,
+                               self.last_committed + 1)
+            }
+            self._send(
+                p["rank"], "px_entries",
+                {"entries": entries, "to_rank": p["rank"]},
+            )
+
+    async def _h_px_commit(self, conn, p) -> None:
+        value = bytes.fromhex(p["value"])
+        if p["version"] == self.last_committed + 1:
+            self._commit_value(p["version"], value)
+        elif p["version"] > self.last_committed + 1 and (
+            self.leader_rank is not None
+        ):
+            self._send(
+                self.leader_rank, "px_fetch",
+                {"from": self.last_committed + 1, "to_rank": self.rank},
+            )
+
+    async def _h_px_fetch(self, conn, p) -> None:
+        entries = {}
+        v = p["from"]
+        while v <= self.last_committed:
+            raw = self.db.get(_VALS, _vkey(v))
+            if raw is not None:
+                entries[v] = raw.hex()
+            v += 1
+        self._send(conn, "px_entries", {"entries": entries})
+
+    async def _h_px_entries(self, conn, p) -> None:
+        for v in sorted(int(k) for k in p["entries"]):
+            if v == self.last_committed + 1:
+                self._commit_value(v, bytes.fromhex(p["entries"][str(v)]))
+        if self.is_leader:
+            # a post-election sync may now be complete
+            self._finish_election_sync()
+
+    async def _h_px_lease(self, conn, p) -> None:
+        if self.state == "peon":
+            self._last_lease = asyncio.get_event_loop().time()
+            if p["last_committed"] > self.last_committed and (
+                self.leader_rank is not None
+            ):
+                self._send(
+                    self.leader_rank, "px_fetch",
+                    {"from": self.last_committed + 1,
+                     "to_rank": self.rank},
+                )
+
+    # subscriptions + client commands
+
+    async def _h_sub(self, conn, p) -> None:
+        self._subs[conn.peer_name] = (conn, p.get("from", 0))
+        self._send(conn, "osd_map", self._map_payload(p.get("from", 0)))
+        self._subs[conn.peer_name] = (conn, self.osdmap.epoch)
+
+    async def _h_mon_command(self, conn, p) -> None:
+        if not self.is_leader:
+            self._send(
+                conn, "mon_command_reply",
+                {"tid": p.get("tid"), "redirect": self.leader_rank},
+            )
+            return
+        try:
+            result = await self._run_command(p)
+            reply = {"tid": p.get("tid"), "ok": True, "result": result}
+        except Exception as e:  # commands reply, never crash the mon
+            reply = {"tid": p.get("tid"), "ok": False, "error": str(e)}
+        self._send(conn, "mon_command_reply", reply)
+
+    async def _h_osd_failure(self, conn, p) -> None:
+        """OSDMonitor::prepare_failure: count distinct reporters."""
+        if not self.is_leader:
+            return
+        target = p["target"]
+        if self.osdmap.is_down(target):
+            return
+        self._failure_reports.setdefault(target, set()).add(conn.peer_name)
+        need = self.config.get("mon_osd_min_down_reporters")
+        if len(self._failure_reports[target]) >= need:
+            del self._failure_reports[target]
+            await self._propose_osdmap(
+                Incremental(epoch=self.osdmap.epoch + 1,
+                            new_down=[target])
+            )
+
+    async def _h_osd_boot(self, conn, p) -> None:
+        if not self.is_leader:
+            return
+        osd = p["osd"]
+        inc = Incremental(
+            epoch=self.osdmap.epoch + 1,
+            new_up=[osd],
+            new_osd_addrs={osd: tuple(p["addr"])},
+        )
+        if osd >= self.osdmap.max_osd:
+            inc.new_max_osd = osd + 1
+        self._failure_reports.pop(osd, None)
+        await self._propose_osdmap(inc)
+
+    async def _h_pg_temp(self, conn, p) -> None:
+        """Peering primaries request temp mappings (MOSDPGTemp)."""
+        if not self.is_leader:
+            return
+        pg = tuple(p["pgid"])
+        acting = list(p["acting"])
+        if self.osdmap.pg_temp.get(pg, []) == acting:
+            return
+        await self._propose_osdmap(
+            Incremental(epoch=self.osdmap.epoch + 1,
+                        new_pg_temp={pg: acting})
+        )
+
+    # -- the OSDMonitor command surface ---------------------------------------
+
+    async def _propose_osdmap(self, inc: Incremental) -> None:
+        await self.propose("osdmap", inc.encode())
+
+    async def _run_command(self, p: dict) -> dict:
+        cmd = p["cmd"]
+        args = p.get("args", {})
+        if cmd == "osd pool create":
+            return await self._cmd_pool_create(args)
+        if cmd == "osd erasure-code-profile set":
+            profile = dict(args["profile"])
+            # validate by instantiating the codec (OSDMonitor.cc:6814)
+            from ceph_tpu.ec.registry import factory
+
+            plugin = profile.get("plugin", "tpu")
+            factory(plugin, {k: v for k, v in profile.items()
+                             if k != "plugin"})
+            await self._propose_osdmap(
+                Incremental(
+                    epoch=self.osdmap.epoch + 1,
+                    new_erasure_code_profiles={args["name"]: profile},
+                )
+            )
+            return {}
+        if cmd == "osd down":
+            await self._propose_osdmap(
+                Incremental(epoch=self.osdmap.epoch + 1,
+                            new_down=[args["osd"]])
+            )
+            return {}
+        if cmd == "osd out":
+            await self._propose_osdmap(
+                Incremental(epoch=self.osdmap.epoch + 1,
+                            new_weight={args["osd"]: 0})
+            )
+            return {}
+        if cmd == "osd in":
+            await self._propose_osdmap(
+                Incremental(epoch=self.osdmap.epoch + 1,
+                            new_weight={args["osd"]: 0x10000})
+            )
+            return {}
+        if cmd == "osd crush set":
+            await self._propose_osdmap(
+                Incremental(epoch=self.osdmap.epoch + 1,
+                            new_crush_text=args["crush_text"])
+            )
+            return {}
+        if cmd == "status":
+            return {
+                "epoch": self.osdmap.epoch,
+                "leader": self.leader_rank,
+                "quorum": sorted(self.quorum),
+                "election_epoch": self.election_epoch,
+                "num_osds": self.osdmap.max_osd,
+                "num_up": int(self.osdmap.osd_up.sum()),
+                "pools": sorted(self.osdmap.pools),
+            }
+        raise ValueError(f"unknown command {cmd!r}")
+
+    async def _cmd_pool_create(self, args: dict) -> dict:
+        from ceph_tpu.osd.types import (
+            TYPE_ERASURE,
+            TYPE_REPLICATED,
+            PgPool,
+        )
+
+        pool_id = args["pool_id"]
+        if pool_id in self.osdmap.pools:
+            raise ValueError(f"pool {pool_id} exists")
+        profile_name = args.get("erasure_code_profile", "")
+        if profile_name:
+            profile = self.osdmap.erasure_code_profiles.get(profile_name)
+            if profile is None:
+                raise ValueError(
+                    f"no erasure-code profile {profile_name!r}"
+                )
+            k = int(profile.get("k", 2))
+            m = int(profile.get("m", 1))
+            pool = PgPool(
+                pg_num=args.get("pg_num",
+                                self.config.get("osd_pool_default_pg_num")),
+                size=k + m,
+                min_size=k,
+                type=TYPE_ERASURE,
+                crush_rule=args["crush_rule"],
+                erasure_code_profile=profile_name,
+            )
+        else:
+            size = args.get("size",
+                            self.config.get("osd_pool_default_size"))
+            pool = PgPool(
+                pg_num=args.get("pg_num",
+                                self.config.get("osd_pool_default_pg_num")),
+                size=size,
+                min_size=max(1, size - 1),
+                type=TYPE_REPLICATED,
+                crush_rule=args["crush_rule"],
+            )
+        await self._propose_osdmap(
+            Incremental(epoch=self.osdmap.epoch + 1,
+                        new_pools={pool_id: pool})
+        )
+        return {"pool_id": pool_id}

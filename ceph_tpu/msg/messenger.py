@@ -191,10 +191,13 @@ class Connection:
         msg.seq = self.out_seq
         if not self.policy.lossy:
             self._unacked.append(msg)
-            if self.peer_name is not None:
+            if not self.outgoing and self.peer_name is not None:
                 # accepted (server-side) connections are re-created per
                 # accept; persisting the counter keeps seqs monotonic per
-                # peer across instances so the far side's dedup holds
+                # peer across instances so the far side's dedup holds.
+                # Outgoing connections persist as objects and keep their
+                # own counter — and two peers that dial EACH OTHER hold two
+                # independent sessions, so the counters must never mix.
                 self.messenger._peer_out_seq[self.peer_name] = self.out_seq
         self._send_q.put_nowait(("msg", msg))
 
@@ -350,10 +353,14 @@ class Connection:
                             ),
                         )
                     )
-                    last = m._peer_in_seq.get(self.peer_name, 0)
+                    # dedup state is per (peer, session direction): the
+                    # session we dialed and the one the peer dialed carry
+                    # independent seq streams (see send_message)
+                    key = (self.peer_name, self.outgoing)
+                    last = m._peer_in_seq.get(key, 0)
                     if msg.seq <= last:
                         continue  # duplicate from a resend window
-                    m._peer_in_seq[self.peer_name] = msg.seq
+                    m._peer_in_seq[key] = msg.seq
                 size = max(1, len(msg.data))
                 await m.dispatch_throttle.get(size)
                 try:
@@ -401,7 +408,9 @@ class Messenger:
         self.my_addr: tuple[str, int] | None = None
         self._conns: dict[tuple[str, int], Connection] = {}
         self._accepted: list[Connection] = []
-        self._peer_in_seq: dict[str | None, int] = {}
+        #: (peer_name, session_outgoing) -> highest seq seen (dedup)
+        self._peer_in_seq: dict[tuple, int] = {}
+        #: peer_name -> last seq sent on our accepted-session side
         self._peer_out_seq: dict[str, int] = {}
         self._rng = random.Random(seed)
         self.injected_failures = 0

@@ -65,6 +65,9 @@ class OSDMap:
     #: profile name -> k/v profile, stored in the map like the reference
     #: (OSDMap::erasure_code_profiles; the mon validates + commits them)
     erasure_code_profiles: dict[str, dict] = field(default_factory=dict)
+    #: osd -> (host, port) public address (OSDMap::osd_addrs) — how clients
+    #: and peers reach a daemon; registered at boot via the mon
+    osd_addrs: dict[int, tuple[str, int]] = field(default_factory=dict)
 
     def __post_init__(self):
         n = self.max_osd
@@ -545,6 +548,8 @@ class Incremental:
     new_pg_temp: dict = _field(default_factory=dict)
     #: pg -> primary; -1 clears
     new_primary_temp: dict = _field(default_factory=dict)
+    #: osd -> (host, port) announced at boot
+    new_osd_addrs: dict = _field(default_factory=dict)
 
     def encode(self) -> bytes:
         def body(b):
@@ -579,6 +584,8 @@ class Incremental:
                       lambda e, v: e.list(v, lambda ee, o: ee.s32(o)))
             b.mapping(self.new_primary_temp, _enc_pg,
                       lambda e, v: e.s32(v))
+            b.mapping(self.new_osd_addrs, lambda e, k: e.u32(k),
+                      lambda e, v: e.string(v[0]).u32(v[1]))
 
         return _Encoder().struct(1, 1, body).bytes()
 
@@ -615,6 +622,9 @@ class Incremental:
                 _dec_pg, lambda d: d.list(lambda dd: dd.s32())
             )
             inc.new_primary_temp = b.mapping(_dec_pg, lambda d: d.s32())
+            inc.new_osd_addrs = b.mapping(
+                lambda d: d.u32(), lambda d: (d.string(), d.u32())
+            )
             return inc
 
         return _Decoder(raw).struct(1, body)
@@ -685,6 +695,8 @@ def apply_incremental(self, inc: Incremental) -> None:
             self.primary_temp[pg] = primary
         else:
             self.primary_temp.pop(pg, None)
+    for osd, addr in inc.new_osd_addrs.items():
+        self.osd_addrs[osd] = tuple(addr)
     self.epoch = inc.epoch
 
 
@@ -721,6 +733,8 @@ def encode_osdmap(self) -> bytes:
         b.mapping(self.pg_temp, _enc_pg,
                   lambda e, v: e.list(v, lambda ee, o: ee.s32(o)))
         b.mapping(self.primary_temp, _enc_pg, lambda e, v: e.s32(v))
+        b.mapping(self.osd_addrs, lambda e, k: e.u32(k),
+                  lambda e, v: e.string(v[0]).u32(v[1]))
 
     return _Encoder().struct(1, 1, body).bytes()
 
@@ -761,6 +775,9 @@ def decode_osdmap(raw: bytes) -> "OSDMap":
             _dec_pg, lambda d: d.list(lambda dd: dd.s32())
         )
         m.primary_temp = b.mapping(_dec_pg, lambda d: d.s32())
+        m.osd_addrs = b.mapping(
+            lambda d: d.u32(), lambda d: (d.string(), d.u32())
+        )
         return m
 
     return _Decoder(raw).struct(1, body)

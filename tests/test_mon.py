@@ -1,0 +1,238 @@
+"""Monitor quorum: election, Paxos commits, map subscription, failure
+reports, leader failover, and crash-restart catch-up."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.kv import MemDB
+from ceph_tpu.mon import MonClient, MonMap, Monitor
+from ceph_tpu.osd.osdmap import OSDMap
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60))
+
+
+def fast_config() -> Config:
+    cfg = Config()
+    cfg.set("mon_lease", 0.1)
+    cfg.set("mon_election_timeout", 0.4)
+    return cfg
+
+
+def initial_map() -> OSDMap:
+    from tests.conftest import make_mini_cluster
+
+    return make_mini_cluster(n_hosts=4).osdmap
+
+
+async def start_cluster(n=3, dbs=None, cfg=None):
+    cfg = cfg or fast_config()
+    monmap = MonMap(addrs=[("127.0.0.1", 0)] * n)
+    base = initial_map()
+    mons = [
+        Monitor(r, monmap, base, db=(dbs[r] if dbs else MemDB()),
+                config=cfg)
+        for r in range(n)
+    ]
+    for m in mons:
+        await m.bind()
+    for m in mons:
+        m.go()
+    await wait_for_leader(mons)
+    return mons, monmap, cfg
+
+
+async def wait_for_leader(mons, timeout=20.0):
+    loop = asyncio.get_event_loop()
+    end = loop.time() + timeout
+    while loop.time() < end:
+        live = [m for m in mons if not m._stopped]
+        leaders = [m for m in live if m.is_leader]
+        if len(leaders) == 1 and all(
+            m.state in ("leader", "peon") for m in live
+        ):
+            return leaders[0]
+        await asyncio.sleep(0.02)
+    raise TimeoutError("no stable leader")
+
+
+async def wait_until(pred, timeout=20.0):
+    loop = asyncio.get_event_loop()
+    end = loop.time() + timeout
+    while not pred():
+        if loop.time() > end:
+            raise TimeoutError
+        await asyncio.sleep(0.02)
+
+
+def test_three_mon_quorum_commits_and_converges():
+    async def main():
+        mons, monmap, cfg = await start_cluster(3)
+        leader = next(m for m in mons if m.is_leader)
+        assert leader.rank == 0  # lowest rank wins the campaign
+
+        client = MonClient("client.admin", monmap, config=cfg)
+        st = await client.command("status")
+        assert sorted(st["quorum"]) == [0, 1, 2]
+
+        await client.command(
+            "osd erasure-code-profile set",
+            {"name": "p42", "profile": {"plugin": "tpu", "k": "2",
+                                        "m": "2"}},
+        )
+        await client.command(
+            "osd pool create",
+            {"pool_id": 42, "crush_rule": 0,
+             "erasure_code_profile": "p42", "pg_num": 8},
+        )
+        await client.command(
+            "osd pool create", {"pool_id": 43, "crush_rule": 1, "size": 3}
+        )
+
+        # every mon converges to the same committed map bytes
+        await wait_until(
+            lambda: all(42 in m.osdmap.pools and 43 in m.osdmap.pools
+                        for m in mons)
+        )
+        raws = [m.osdmap.encode() for m in mons]
+        assert raws[0] == raws[1] == raws[2]
+        assert mons[0].osdmap.pools[42].size == 4  # k+m
+        assert mons[0].osdmap.pools[42].erasure_code_profile == "p42"
+
+        # a bogus EC profile is refused by codec validation, not committed
+        with pytest.raises(RuntimeError):
+            await client.command(
+                "osd erasure-code-profile set",
+                {"name": "bad", "profile": {"plugin": "tpu", "k": "0",
+                                            "m": "9"}},
+            )
+
+        await client.close()
+        for m in mons:
+            await m.stop()
+
+    run(main())
+
+
+def test_subscription_streams_incrementals():
+    async def main():
+        mons, monmap, cfg = await start_cluster(3)
+        client = MonClient("client.sub", monmap, config=cfg)
+        epochs = []
+        client.on_map_change(lambda m: epochs.append(m.epoch))
+        first = await client.wait_for_map()
+        e0 = first.epoch
+
+        admin = MonClient("client.admin2", monmap, config=cfg)
+        await admin.command("osd down", {"osd": 3})
+        await admin.command("osd out", {"osd": 3})
+        await wait_until(
+            lambda: client.osdmap is not None
+            and client.osdmap.epoch >= e0 + 2
+        )
+        assert client.osdmap.is_down(3)
+        assert int(client.osdmap.osd_weight[3]) == 0
+        assert epochs == sorted(epochs)  # strictly ordered application
+
+        await admin.close()
+        await client.close()
+        for m in mons:
+            await m.stop()
+
+    run(main())
+
+
+def test_failure_reports_respect_min_reporters():
+    async def main():
+        cfg = fast_config()
+        cfg.set("mon_osd_min_down_reporters", 2)
+        mons, monmap, cfg = await start_cluster(3, cfg=cfg)
+        e0 = mons[0].osdmap.epoch
+
+        r1 = MonClient("osd.1", monmap, config=cfg)
+        r2 = MonClient("osd.2", monmap, config=cfg)
+        # find the leader so reports land where they count
+        st = await r1.command("status")
+        leader = st["leader"]
+        r1.target_rank = leader
+        r2.target_rank = leader
+
+        r1.report_failure(5)
+        r1.report_failure(5)  # same reporter twice: still one report
+        await asyncio.sleep(0.3)
+        assert not mons[leader].osdmap.is_down(5)
+
+        r2.report_failure(5)  # second distinct reporter crosses the bar
+        await wait_until(lambda: mons[leader].osdmap.is_down(5))
+        assert mons[leader].osdmap.epoch == e0 + 1
+
+        await r1.close()
+        await r2.close()
+        for m in mons:
+            await m.stop()
+
+    run(main())
+
+
+def test_osd_boot_registers_address_and_grows_map():
+    async def main():
+        mons, monmap, cfg = await start_cluster(3)
+        n0 = mons[0].osdmap.max_osd
+        booter = MonClient("osd.99", monmap, config=cfg)
+        st = await booter.command("status")
+        booter.target_rank = st["leader"]
+        booter.send_boot(n0 + 1, ("127.0.0.1", 7301))
+        await wait_until(
+            lambda: all(m.osdmap.max_osd == n0 + 2 for m in mons)
+        )
+        assert mons[2].osdmap.osd_addrs[n0 + 1] == ("127.0.0.1", 7301)
+        await booter.close()
+        for m in mons:
+            await m.stop()
+
+    run(main())
+
+
+def test_leader_failover_and_restart_catchup():
+    async def main():
+        dbs = [MemDB(), MemDB(), MemDB()]
+        mons, monmap, cfg = await start_cluster(3, dbs=dbs)
+        client = MonClient("client.admin", monmap, config=cfg)
+        await client.command(
+            "osd pool create", {"pool_id": 7, "crush_rule": 1, "size": 2}
+        )
+        old_leader = next(m for m in mons if m.is_leader)
+        await old_leader.stop()
+
+        # the survivors elect a new leader and keep committing
+        survivors = [m for m in mons if m is not old_leader]
+        await wait_for_leader(survivors)
+        client.target_rank = survivors[0].rank
+        await client.command("osd down", {"osd": 1})
+        await wait_until(
+            lambda: all(m.osdmap.is_down(1) for m in survivors)
+        )
+
+        # the crashed mon restarts on its persisted DB and catches up
+        reborn = Monitor(old_leader.rank, monmap, initial_map(),
+                         db=dbs[old_leader.rank], config=cfg)
+        assert reborn.last_committed >= 1  # state survived the crash
+        await reborn.bind()
+        reborn.go()
+        everyone = survivors + [reborn]
+        await wait_for_leader(everyone)
+        await wait_until(
+            lambda: reborn.last_committed
+            == max(m.last_committed for m in everyone)
+        )
+        assert reborn.osdmap.is_down(1)
+        assert 7 in reborn.osdmap.pools
+
+        await client.close()
+        for m in everyone:
+            await m.stop()
+
+    run(main())

@@ -120,6 +120,7 @@ def test_incremental_encode_decode_round_trip():
         old_pg_upmap_items=[(5, 4)],
         new_pg_temp={(5, 5): [2, 1], (5, 6): []},
         new_primary_temp={(5, 5): 1, (5, 6): -1},
+        new_osd_addrs={3: ("127.0.0.1", 6800)},
     )
     got = Incremental.decode(inc.encode())
     assert got == inc
