@@ -656,14 +656,27 @@ class Monitor(Dispatcher):
             reply = {"tid": p.get("tid"), "ok": False, "error": str(e)}
         self._send(conn, "mon_command_reply", reply)
 
+    def _forward_to_leader(self, msg_type: str, p: dict, conn) -> bool:
+        """Peons forward one-way daemon reports to the leader (the
+        reference's Monitor::forward_request_leader), tagging the original
+        reporter so distinct-reporter counting survives the hop."""
+        if self.is_leader:
+            return False
+        if self.leader_rank is not None and self.leader_rank != self.rank:
+            fwd = dict(p)
+            fwd.setdefault("reporter", conn.peer_name)
+            self._send(self.leader_rank, msg_type, fwd)
+        return True
+
     async def _h_osd_failure(self, conn, p) -> None:
         """OSDMonitor::prepare_failure: count distinct reporters."""
-        if not self.is_leader:
+        if self._forward_to_leader("osd_failure", p, conn):
             return
         target = p["target"]
         if self.osdmap.is_down(target):
             return
-        self._failure_reports.setdefault(target, set()).add(conn.peer_name)
+        reporter = p.get("reporter", conn.peer_name)
+        self._failure_reports.setdefault(target, set()).add(reporter)
         need = self.config.get("mon_osd_min_down_reporters")
         if len(self._failure_reports[target]) >= need:
             del self._failure_reports[target]
@@ -673,7 +686,7 @@ class Monitor(Dispatcher):
             )
 
     async def _h_osd_boot(self, conn, p) -> None:
-        if not self.is_leader:
+        if self._forward_to_leader("osd_boot", p, conn):
             return
         osd = p["osd"]
         inc = Incremental(
@@ -688,7 +701,7 @@ class Monitor(Dispatcher):
 
     async def _h_pg_temp(self, conn, p) -> None:
         """Peering primaries request temp mappings (MOSDPGTemp)."""
-        if not self.is_leader:
+        if self._forward_to_leader("pg_temp", p, conn):
             return
         pg = tuple(p["pgid"])
         acting = list(p["acting"])

@@ -170,6 +170,7 @@ class Connection:
         self.messenger = messenger
         self.peer_addr = peer_addr
         self.peer_name: str | None = None
+        self.peer_nonce: int = 0
         self.policy = policy
         self.outgoing = outgoing
         self.session_key: bytes | None = None
@@ -194,11 +195,13 @@ class Connection:
             if not self.outgoing and self.peer_name is not None:
                 # accepted (server-side) connections are re-created per
                 # accept; persisting the counter keeps seqs monotonic per
-                # peer across instances so the far side's dedup holds.
-                # Outgoing connections persist as objects and keep their
-                # own counter — and two peers that dial EACH OTHER hold two
-                # independent sessions, so the counters must never mix.
-                self.messenger._peer_out_seq[self.peer_name] = self.out_seq
+                # peer instance across accepts so the far side's dedup
+                # holds. Outgoing connections persist as objects and keep
+                # their own counter — and two peers that dial EACH OTHER
+                # hold two independent sessions, so the counters never mix.
+                self.messenger._peer_out_seq[
+                    (self.peer_name, self.peer_nonce)
+                ] = self.out_seq
         self._send_q.put_nowait(("msg", msg))
 
     def send_keepalive(self) -> None:
@@ -292,12 +295,14 @@ class Connection:
         await stream.writer.drain()
         if await stream.reader.readexactly(len(BANNER)) != BANNER:
             raise FrameError("bad banner")
-        hello = Encoder().string(m.name).bytes()
+        hello = Encoder().string(m.name).u64(m.instance_nonce).bytes()
         await stream.send(Frame(Tag.HELLO, hello), None)
         reply = await stream.recv(None)
         if reply.tag != Tag.HELLO:
             raise FrameError(f"expected HELLO, got {reply.tag}")
-        self.peer_name = Decoder(reply.payload).string()
+        d = Decoder(reply.payload)
+        self.peer_name = d.string()
+        self.peer_nonce = d.u64()
         if m.keyring is None:
             return
         secret = m.keyring.get(m.name)
@@ -353,10 +358,11 @@ class Connection:
                             ),
                         )
                     )
-                    # dedup state is per (peer, session direction): the
-                    # session we dialed and the one the peer dialed carry
-                    # independent seq streams (see send_message)
-                    key = (self.peer_name, self.outgoing)
+                    # dedup state is per (peer instance, session
+                    # direction): the session we dialed and the one the
+                    # peer dialed carry independent seq streams, and a
+                    # restarted peer (new nonce) starts fresh
+                    key = (self.peer_name, self.peer_nonce, self.outgoing)
                     last = m._peer_in_seq.get(key, 0)
                     if msg.seq <= last:
                         continue  # duplicate from a resend window
@@ -408,11 +414,16 @@ class Messenger:
         self.my_addr: tuple[str, int] | None = None
         self._conns: dict[tuple[str, int], Connection] = {}
         self._accepted: list[Connection] = []
-        #: (peer_name, session_outgoing) -> highest seq seen (dedup)
+        #: (peer_name, peer_nonce, session_outgoing) -> highest seq (dedup)
         self._peer_in_seq: dict[tuple, int] = {}
-        #: peer_name -> last seq sent on our accepted-session side
-        self._peer_out_seq: dict[str, int] = {}
+        #: (peer_name, peer_nonce) -> last seq sent on our accepted side
+        self._peer_out_seq: dict[tuple, int] = {}
         self._rng = random.Random(seed)
+        #: instance identity (entity_addr_t::nonce): a restarted daemon
+        #: reusing its name/address presents a fresh nonce, so peers reset
+        #: per-session seq state instead of treating the new process's
+        #: low seqs as duplicates of the dead one's
+        self.instance_nonce = int.from_bytes(os.urandom(8), "little")
         self.injected_failures = 0
 
     # -- lifecycle ------------------------------------------------------------
@@ -467,11 +478,22 @@ class Messenger:
             hello = await stream.recv(None)
             if hello.tag != Tag.HELLO:
                 raise FrameError("expected HELLO")
-            conn.peer_name = Decoder(hello.payload).string()
+            hd = Decoder(hello.payload)
+            conn.peer_name = hd.string()
+            conn.peer_nonce = hd.u64()
             conn.peer_addr = writer.get_extra_info("peername")[:2]
-            conn.out_seq = self._peer_out_seq.get(conn.peer_name, 0)
+            conn.out_seq = self._peer_out_seq.get(
+                (conn.peer_name, conn.peer_nonce), 0
+            )
             await stream.send(
-                Frame(Tag.HELLO, Encoder().string(self.name).bytes()), None
+                Frame(
+                    Tag.HELLO,
+                    Encoder()
+                    .string(self.name)
+                    .u64(self.instance_nonce)
+                    .bytes(),
+                ),
+                None,
             )
             if self.keyring is not None:
                 if not await self._server_auth(stream, conn):

@@ -1,0 +1,885 @@
+"""OSDService: the storage daemon (L6).
+
+One process-per-OSD data plane speaking the messenger, mirroring the
+reference's structure (src/osd/OSD.cc boot at ceph_osd.cc:106, fast
+dispatch at OSD.cc:6877) at mini scale:
+
+  boot      bind messenger -> MonClient subscribe -> send osd_boot with our
+            address -> serve once the committed map shows us up
+  ops       clients send "osd_op" to the acting primary; the primary drives
+            the backend (PrimaryLogPG::do_op -> PGBackend analogues):
+              * replicated: apply locally + fan "rep_write" sub-ops to the
+                other acting members, ack to the client when all commit
+                (ReplicatedBackend sub-write fan-out)
+              * EC: encode on the TPU codec, "ec_sub_write" one shard to
+                each acting position, ack when all commit
+                (ECBackend::start_rmw -> ECSubWrite, ECBackend.cc:1830);
+                reads gather minimum_to_decode shards via "ec_sub_read"
+                and decode only when degraded (objects_read_async, 2154)
+  fencing   an op whose placement disagrees with our map is bounced with
+            the current epoch ("wrong_primary"); the Objecter refreshes its
+            map and resends — the reference drops stale-epoch ops the same
+            way and relies on client resend (epoch-tagged resend contract)
+  peering   on every map epoch whose acting set changed, the primary runs
+            GetInfo -> GetLog -> GetMissing -> recover (PeeringState.h
+            statechart collapsed to one async pass): collect pg_info from
+            acting members, adopt the most advanced log (pull objects it
+            names that we lack), then push log + objects/shards every
+            laggard is missing; EC shards a member lacks are rebuilt by
+            decoding from surviving shards. Every sub-write carries its log
+            entry, so replicas' logs advance with their data, exactly like
+            ECSubWrite carrying log_entries in the reference
+  logs      per-PG log in the pg-meta object's omap ("log/<version>" ->
+            entry, PGLog.cc role): the authoritative object inventory that
+            peering compares and recovery replays
+  failure   periodic pings to peers holding PGs with us; a peer silent past
+            osd_heartbeat_grace is reported to the mon (OSD.cc:4547
+            handle_osd_ping / heartbeat_check), which commits the down mark
+
+Object naming: a replicated object is stored under its name in collection
+"pg_<pool>_<ps>"; EC shard i of an object is "<name>.s<i>" in the same
+collection — shard identity in the key, as ECBackend's shard_id_t does.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.kv import KeyValueDB
+from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.osd.ecutil import HashInfo
+from ceph_tpu.osd.objectstore import KStore, StoreError, Transaction
+from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE
+
+_NONE = CRUSH_ITEM_NONE
+
+
+def pg_coll(pool: int, ps: int) -> str:
+    return f"pg_{pool}_{ps}"
+
+
+def shard_name(name: str, shard: int | None) -> str:
+    return name if shard is None else f"{name}.s{shard}"
+
+
+class PG:
+    """Per-PG volatile state; durable state lives in the store."""
+
+    META = ".pgmeta"
+
+    def __init__(self, service: "OSDService", pool: int, ps: int):
+        self.service = service
+        self.pool = pool
+        self.ps = ps
+        self.coll = pg_coll(pool, ps)
+        self.lock = asyncio.Lock()  # serializes writes + peering
+        store = service.store
+        if not store.collection_exists(self.coll):
+            store.queue_transaction(
+                Transaction().create_collection(self.coll).touch(
+                    self.coll, self.META
+                )
+            )
+
+    # -- the persisted log ----------------------------------------------------
+
+    @property
+    def last_update(self) -> int:
+        raw = self.service.store.omap_get(self.coll, self.META).get(b"info")
+        return 0 if raw is None else json.loads(raw)["last_update"]
+
+    def log_entries(self, from_version: int = 0) -> list[dict]:
+        out = []
+        for k, v in sorted(
+            self.service.store.omap_get(self.coll, self.META).items()
+        ):
+            if k.startswith(b"log/"):
+                e = json.loads(v)
+                if e["version"] > from_version:
+                    out.append(e)
+        return out
+
+    def append_log(self, txn: Transaction, entry: dict) -> None:
+        txn.omap_setkeys(
+            self.coll,
+            self.META,
+            {
+                b"log/%016x" % entry["version"]: json.dumps(entry).encode(),
+                b"info": json.dumps(
+                    {"last_update": entry["version"]}
+                ).encode(),
+            },
+        )
+
+    def latest_objects(self) -> dict[str, dict]:
+        """name -> newest log entry (the recovery inventory)."""
+        out: dict[str, dict] = {}
+        for e in self.log_entries():
+            out[e["name"]] = e
+        return out
+
+
+class OSDService(Dispatcher):
+    def __init__(
+        self,
+        osd_id: int,
+        monmap,
+        db: KeyValueDB | None = None,
+        config: Config | None = None,
+        keyring: dict[str, bytes] | None = None,
+    ):
+        self.id = osd_id
+        self.name = f"osd.{osd_id}"
+        self.config = config if config is not None else Config()
+        self.store = KStore(db)
+        self.messenger = Messenger(
+            self.name, config=self.config, keyring=keyring
+        )
+        self.messenger.dispatcher = self
+        # MonClient chains itself in front of us on the shared messenger
+        self.mon = MonClient(
+            self.name, monmap, config=self.config,
+            messenger=self.messenger,
+        )
+        self.pgs: dict[tuple[int, int], PG] = {}
+        self._codecs: dict[int, object] = {}
+        self._tids = iter(range(1, 1 << 62))
+        self._waiters: dict[int, asyncio.Future] = {}
+        self._hb_last: dict[int, float] = {}
+        self._reported: set[int] = set()
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = False
+        self.mon.on_map_change(self._note_map)
+        self._map_dirty = asyncio.Event()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def osdmap(self):
+        return self.mon.osdmap
+
+    async def start(self) -> None:
+        await self.messenger.bind()
+        self.mon.subscribe()
+        await self.mon.wait_for_map()
+        self.mon.send_boot(self.id, tuple(self.messenger.my_addr))
+        # serve once the quorum-committed map says we're up at our address
+        loop = asyncio.get_event_loop()
+        end = loop.time() + 10
+        while loop.time() < end:
+            m = self.osdmap
+            if (
+                self.id < m.max_osd
+                and m.osd_up[self.id]
+                and m.osd_addrs.get(self.id)
+                == tuple(self.messenger.my_addr)
+            ):
+                break
+            await asyncio.sleep(0.02)
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+        self._tasks.append(asyncio.create_task(self._peering_loop()))
+        self._note_map(self.osdmap)
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.messenger.shutdown()
+
+    # -- placement helpers ----------------------------------------------------
+
+    def codec(self, pool_id: int):
+        if pool_id not in self._codecs:
+            pool = self.osdmap.pools[pool_id]
+            if not pool.is_erasure():
+                self._codecs[pool_id] = None
+            else:
+                from ceph_tpu.ec.registry import factory
+
+                profile = dict(
+                    self.osdmap.erasure_code_profiles[
+                        pool.erasure_code_profile
+                    ]
+                )
+                plugin = profile.pop("plugin", "tpu")
+                self._codecs[pool_id] = factory(plugin, profile)
+        return self._codecs[pool_id]
+
+    def acting_of(self, pool_id: int, ps: int) -> tuple[list[int], int]:
+        _up, _upp, acting, primary = self.osdmap.pg_to_up_acting_osds(
+            pool_id, ps
+        )
+        return acting, primary
+
+    def object_pg(self, pool_id: int, name: str) -> int:
+        from ceph_tpu.common.hash import ceph_str_hash_rjenkins
+
+        pool = self.osdmap.pools[pool_id]
+        return pool.raw_pg_to_pg(ceph_str_hash_rjenkins(name))
+
+    def _osd_conn(self, osd: int):
+        addr = self.osdmap.osd_addrs.get(osd)
+        if addr is None:
+            raise RuntimeError(f"no address for osd.{osd}")
+        return self.messenger.connect(tuple(addr), Policy.lossless_client())
+
+    async def _peer_call(
+        self, osd: int, msg_type: str, payload: dict, timeout: float = 10.0
+    ) -> dict:
+        """Request/response to a peer OSD (sub-op + ack)."""
+        tid = next(self._tids)
+        payload = dict(payload)
+        payload["tid"] = tid
+        payload["reply_to"] = self.id
+        fut = asyncio.get_event_loop().create_future()
+        self._waiters[tid] = fut
+        try:
+            self._osd_conn(osd).send_message(
+                Message(type=msg_type, tid=tid,
+                        epoch=self.osdmap.epoch,
+                        data=json.dumps(payload).encode())
+            )
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._waiters.pop(tid, None)
+
+    def _reply_peer(self, conn, tid: int, payload: dict) -> None:
+        payload = dict(payload)
+        payload["tid"] = tid
+        conn.send_message(
+            Message(type="sub_reply", tid=tid,
+                    epoch=self.osdmap.epoch,
+                    data=json.dumps(payload).encode())
+        )
+
+    # -- dispatch -------------------------------------------------------------
+
+    async def ms_dispatch(self, conn, msg: Message) -> None:
+        p = json.loads(msg.data) if msg.data else {}
+        if msg.type == "sub_reply":
+            fut = self._waiters.get(p.get("tid"))
+            if fut is not None and not fut.done():
+                fut.set_result(p)
+            return
+        handler = getattr(self, f"_h_{msg.type}", None)
+        if handler is not None:
+            await handler(conn, p)
+
+    # -- heartbeats + failure detection ---------------------------------------
+
+    def _hb_peers(self) -> set[int]:
+        """OSDs sharing at least one PG with us (the heartbeat peer set)."""
+        peers: set[int] = set()
+        for (pool, ps) in self.pgs:
+            acting, _ = self.acting_of(pool, ps)
+            peers.update(o for o in acting if o != _NONE and o != self.id)
+        return peers
+
+    async def _heartbeat_loop(self) -> None:
+        interval = self.config.get("osd_heartbeat_interval")
+        grace = self.config.get("osd_heartbeat_grace")
+        loop = asyncio.get_event_loop()
+        while not self._stopped:
+            for peer in self._hb_peers():
+                if self.osdmap.is_down(peer):
+                    self._hb_last.pop(peer, None)
+                    self._reported.discard(peer)
+                    continue
+                self._hb_last.setdefault(peer, loop.time())
+                try:
+                    await self._peer_call(
+                        peer, "osd_ping", {}, timeout=interval
+                    )
+                    self._hb_last[peer] = loop.time()
+                    self._reported.discard(peer)
+                except (asyncio.TimeoutError, RuntimeError):
+                    silent = loop.time() - self._hb_last.get(
+                        peer, loop.time()
+                    )
+                    if silent > grace and peer not in self._reported:
+                        self.mon.report_failure(peer)
+                        self._reported.add(peer)
+            await asyncio.sleep(interval)
+
+    async def _h_osd_ping(self, conn, p) -> None:
+        self._reply_peer(conn, p["tid"], {"ok": True})
+
+    # -- map handling + peering -----------------------------------------------
+
+    def _note_map(self, _osdmap) -> None:
+        self._map_dirty.set()
+
+    async def _peering_loop(self) -> None:
+        """Re-evaluate PG responsibility on every map change."""
+        while not self._stopped:
+            await self._map_dirty.wait()
+            self._map_dirty.clear()
+            try:
+                await self._handle_map_change()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # next epoch retries
+
+    async def _handle_map_change(self) -> None:
+        m = self.osdmap
+        mine: set[tuple[int, int]] = set()
+        for pool_id, pool in m.pools.items():
+            for ps in range(pool.pg_num):
+                acting, primary = self.acting_of(pool_id, ps)
+                if self.id in [o for o in acting if o != _NONE]:
+                    mine.add((pool_id, ps))
+        for key in mine:
+            if key not in self.pgs:
+                self.pgs[key] = PG(self, *key)
+        # primaries drive recovery for their PGs
+        for (pool_id, ps) in sorted(mine):
+            acting, primary = self.acting_of(pool_id, ps)
+            if primary == self.id:
+                pg = self.pgs[(pool_id, ps)]
+                async with pg.lock:
+                    await self._peer_and_recover(pg, acting)
+
+    async def _peer_and_recover(self, pg: PG, acting: list[int]) -> None:
+        """GetInfo -> GetLog -> GetMissing -> push, one pass."""
+        members = [o for o in acting if o != _NONE and o != self.id]
+        infos: dict[int, int] = {self.id: pg.last_update}
+        for osd in members:
+            if self.osdmap.is_down(osd):
+                continue
+            try:
+                rep = await self._peer_call(
+                    osd, "pg_info", {"pgid": [pg.pool, pg.ps]},
+                    timeout=2.0,
+                )
+                infos[osd] = rep["last_update"]
+            except (asyncio.TimeoutError, RuntimeError):
+                continue
+        best_osd = max(infos, key=lambda o: (infos[o], o == self.id))
+        if infos[best_osd] > pg.last_update:
+            await self._pull_log_and_objects(pg, best_osd, acting)
+        await self._push_missing(pg, acting, infos)
+
+    async def _pull_log_and_objects(
+        self, pg: PG, source: int, acting: list[int]
+    ) -> None:
+        """Adopt a more advanced member's log (GetLog + pull)."""
+        rep = await self._peer_call(
+            source, "pg_log", {"pgid": [pg.pool, pg.ps],
+                               "from": pg.last_update},
+        )
+        ec = self.codec(pg.pool)
+        my_shard = self._my_shard(pg, acting)
+        for e in rep["entries"]:
+            txn = Transaction()
+            if e["kind"] == "delete":
+                txn.remove(pg.coll, shard_name(e["name"], my_shard))
+            else:
+                # pull our copy/shard of the object this entry names
+                want = shard_name(e["name"], my_shard)
+                got = await self._pull_object(
+                    pg, e["name"], my_shard, acting, e
+                )
+                if got is None:
+                    continue  # unreachable for now; next epoch retries
+                data, attrs = got
+                txn.write(pg.coll, want, data, attrs=attrs)
+            pg.append_log(txn, e)
+            self.store.queue_transaction(txn)
+        _ = ec  # codec warmed for pull path
+
+    def _my_shard(self, pg: PG, acting: list[int]) -> int | None:
+        if self.codec(pg.pool) is None:
+            return None
+        try:
+            return acting.index(self.id)
+        except ValueError:
+            return None
+
+    async def _pull_object(
+        self, pg: PG, name: str, shard: int | None, acting: list[int], entry
+    ):
+        """Fetch our copy/shard: direct from any holder, else (EC) rebuild
+        by decoding the minimum shard set (RecoveryOp READING)."""
+        members = [o for o in acting if o != _NONE and o != self.id]
+        # direct copy: replicated from anyone, EC from a holder of our shard
+        for osd in members:
+            if self.osdmap.is_down(osd):
+                continue
+            try:
+                rep = await self._peer_call(
+                    osd, "obj_read",
+                    {"coll": pg.coll, "name": shard_name(name, shard),
+                     "ver": entry["obj_ver"]},
+                    timeout=2.0,
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                continue
+            if rep.get("ok"):
+                return bytes.fromhex(rep["data"]), _attrs_from(rep)
+        ec = self.codec(pg.pool)
+        if ec is None or shard is None:
+            return None
+        # rebuild our shard from surviving shards
+        chunks: dict[int, bytes] = {}
+        attrs = None
+        for pos, osd in enumerate(acting):
+            if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
+                continue
+            try:
+                rep = await self._peer_call(
+                    osd, "obj_read",
+                    {"coll": pg.coll, "name": shard_name(name, pos),
+                     "ver": entry["obj_ver"]},
+                    timeout=2.0,
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                continue
+            if rep.get("ok"):
+                chunks[pos] = bytes.fromhex(rep["data"])
+                attrs = attrs or _attrs_from(rep)
+            if len(chunks) >= ec.get_data_chunk_count():
+                break
+        if len(chunks) < ec.get_data_chunk_count():
+            return None
+        decoded = ec.decode({shard}, chunks)
+        return decoded[shard], attrs
+
+    async def _push_missing(
+        self, pg: PG, acting: list[int], infos: dict[int, int]
+    ) -> None:
+        """Push log entries + object data to every laggard member."""
+        inventory = pg.latest_objects()
+        ec = self.codec(pg.pool)
+        for pos, osd in enumerate(acting):
+            if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
+                continue
+            since = infos.get(osd)
+            if since is None or since >= pg.last_update:
+                continue
+            shard = pos if ec is not None else None
+            for e in pg.log_entries(since):
+                latest = inventory.get(e["name"])
+                if latest is None or latest["version"] != e["version"]:
+                    # superseded entry: the newest one will carry the data
+                    payload = {"entry": e, "data": None}
+                elif e["kind"] == "delete":
+                    payload = {"entry": e, "data": None}
+                else:
+                    got = await self._object_for_push(
+                        pg, e, shard, acting
+                    )
+                    if got is None:
+                        continue
+                    data, attrs = got
+                    payload = {
+                        "entry": e,
+                        "data": data.hex(),
+                        "attrs": _attrs_to(attrs),
+                    }
+                try:
+                    await self._peer_call(
+                        osd, "obj_push",
+                        {"pgid": [pg.pool, pg.ps],
+                         "shard": shard, **payload},
+                        timeout=5.0,
+                    )
+                except (asyncio.TimeoutError, RuntimeError):
+                    break  # next epoch retries this member
+
+    async def _object_for_push(
+        self, pg: PG, entry: dict, shard: int | None, acting: list[int]
+    ):
+        """Data for the target's copy/shard, decoding if we don't hold it."""
+        try:
+            data = self.store.read(
+                pg.coll, shard_name(entry["name"], self._my_shard(pg, acting))
+            )
+            attrs = self.store.getattrs(
+                pg.coll,
+                shard_name(entry["name"], self._my_shard(pg, acting)),
+            )
+        except StoreError:
+            return None
+        ec = self.codec(pg.pool)
+        if ec is None:
+            if attrs.get("ver") != entry["obj_ver"]:
+                return None
+            return data, attrs
+        if shard == self._my_shard(pg, acting):
+            return data, attrs
+        # rebuild the target's shard from the cluster (incl. our own shard)
+        chunks = {self._my_shard(pg, acting): data}
+        for pos, osd in enumerate(acting):
+            if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
+                continue
+            if len(chunks) >= ec.get_data_chunk_count():
+                break
+            try:
+                rep = await self._peer_call(
+                    osd, "obj_read",
+                    {"coll": pg.coll,
+                     "name": shard_name(entry["name"], pos),
+                     "ver": entry["obj_ver"]},
+                    timeout=2.0,
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                continue
+            if rep.get("ok"):
+                chunks[pos] = bytes.fromhex(rep["data"])
+        if len(chunks) < ec.get_data_chunk_count():
+            return None
+        decoded = ec.decode({shard}, chunks)
+        return decoded[shard], attrs
+
+    # -- peer sub-op servers --------------------------------------------------
+
+    async def _h_pg_info(self, conn, p) -> None:
+        pg = self._pg_of(p["pgid"])
+        self._reply_peer(
+            conn, p["tid"], {"last_update": pg.last_update}
+        )
+
+    async def _h_pg_log(self, conn, p) -> None:
+        pg = self._pg_of(p["pgid"])
+        self._reply_peer(
+            conn, p["tid"],
+            {"entries": pg.log_entries(p.get("from", 0))},
+        )
+
+    async def _h_obj_read(self, conn, p) -> None:
+        """handle_sub_read: local read (+ version check when asked)."""
+        try:
+            data = self.store.read(p["coll"], p["name"])
+            attrs = self.store.getattrs(p["coll"], p["name"])
+        except StoreError:
+            self._reply_peer(conn, p["tid"], {"ok": False})
+            return
+        if p.get("ver") is not None and attrs.get("ver") != p["ver"]:
+            self._reply_peer(conn, p["tid"], {"ok": False, "stale": True})
+            return
+        self._reply_peer(
+            conn, p["tid"],
+            {"ok": True, "data": data.hex(), "attrs": _attrs_to(attrs)},
+        )
+
+    async def _h_obj_push(self, conn, p) -> None:
+        """Recovery push: store the object/shard + its log entry."""
+        pg = self._pg_of(p["pgid"])
+        e = p["entry"]
+        txn = Transaction()
+        if e["version"] > pg.last_update:
+            pg.append_log(txn, e)
+        if p.get("data") is not None:
+            txn.write(
+                pg.coll,
+                shard_name(e["name"], p.get("shard")),
+                bytes.fromhex(p["data"]),
+                attrs=_attrs_from(p),
+            )
+        elif e["kind"] == "delete":
+            txn.remove(pg.coll, shard_name(e["name"], p.get("shard")))
+        self.store.queue_transaction(txn)
+        self._reply_peer(conn, p["tid"], {"ok": True})
+
+    async def _h_rep_write(self, conn, p) -> None:
+        """ReplicatedBackend sub-write: apply locally, ack; idempotent on
+        resend (the entry version gate)."""
+        pg = self._pg_of(p["pgid"])
+        e = p["entry"]
+        async with pg.lock:
+            if e["version"] > pg.last_update:
+                txn = Transaction()
+                if e["kind"] == "delete":
+                    txn.remove(pg.coll, e["name"])
+                else:
+                    txn.write(
+                        pg.coll, e["name"], bytes.fromhex(p["data"]),
+                        attrs=_attrs_from(p),
+                    )
+                pg.append_log(txn, e)
+                self.store.queue_transaction(txn)
+        self._reply_peer(conn, p["tid"], {"ok": True})
+
+    async def _h_ec_sub_write(self, conn, p) -> None:
+        """ECBackend::handle_sub_write for our shard."""
+        pg = self._pg_of(p["pgid"])
+        e = p["entry"]
+        async with pg.lock:
+            if e["version"] > pg.last_update:
+                txn = Transaction()
+                if e["kind"] == "delete":
+                    txn.remove(
+                        pg.coll, shard_name(e["name"], p["shard"])
+                    )
+                else:
+                    txn.write(
+                        pg.coll,
+                        shard_name(e["name"], p["shard"]),
+                        bytes.fromhex(p["data"]),
+                        attrs=_attrs_from(p),
+                    )
+                pg.append_log(txn, e)
+                self.store.queue_transaction(txn)
+        self._reply_peer(conn, p["tid"], {"ok": True})
+
+    def _pg_of(self, pgid) -> PG:
+        key = (pgid[0], pgid[1])
+        if key not in self.pgs:
+            self.pgs[key] = PG(self, *key)
+        return self.pgs[key]
+
+    # -- client ops (the primary path) ----------------------------------------
+
+    async def _h_osd_op(self, conn, p) -> None:
+        pool_id = p["pool"]
+        name = p["name"]
+        try:
+            if pool_id not in self.osdmap.pools:
+                raise RuntimeError(f"no pool {pool_id}")
+            ps = self.object_pg(pool_id, name)
+            acting, primary = self.acting_of(pool_id, ps)
+            if primary != self.id:
+                conn.send_message(
+                    Message(
+                        type="osd_op_reply", tid=p["tid"],
+                        epoch=self.osdmap.epoch,
+                        data=json.dumps(
+                            {"tid": p["tid"], "ok": False,
+                             "wrong_primary": True,
+                             "epoch": self.osdmap.epoch}
+                        ).encode(),
+                    )
+                )
+                return
+            pg = self._pg_of((pool_id, ps))
+            if p["op"] == "write":
+                async with pg.lock:
+                    await self._primary_write(
+                        pg, acting, name, bytes.fromhex(p["data"])
+                    )
+                result = {}
+            elif p["op"] == "delete":
+                async with pg.lock:
+                    await self._primary_delete(pg, acting, name)
+                result = {}
+            elif p["op"] == "read":
+                result = {
+                    "data": (
+                        await self._primary_read(pg, acting, name)
+                    ).hex()
+                }
+            elif p["op"] == "stat":
+                result = self._primary_stat(pg, name)
+            else:
+                raise RuntimeError(f"unknown op {p['op']!r}")
+            reply = {"tid": p["tid"], "ok": True, **result}
+        except Exception as e:
+            reply = {"tid": p["tid"], "ok": False, "error": str(e)}
+        conn.send_message(
+            Message(type="osd_op_reply", tid=p["tid"],
+                    epoch=self.osdmap.epoch,
+                    data=json.dumps(reply).encode())
+        )
+
+    def _obj_version(self, pg: PG, name: str) -> int:
+        e = pg.latest_objects().get(name)
+        return 0 if e is None else e["obj_ver"]
+
+    async def _primary_write(
+        self, pg: PG, acting: list[int], name: str, data: bytes
+    ) -> None:
+        entry = {
+            "version": pg.last_update + 1,
+            "name": name,
+            "obj_ver": self._obj_version(pg, name) + 1,
+            "kind": "modify",
+        }
+        ec = self.codec(pg.pool)
+        if ec is None:
+            attrs = {"ver": entry["obj_ver"]}
+            txn = Transaction().write(pg.coll, name, data, attrs=attrs)
+            pg.append_log(txn, entry)
+            self.store.queue_transaction(txn)
+            waits = [
+                self._peer_call(
+                    osd, "rep_write",
+                    {"pgid": [pg.pool, pg.ps], "entry": entry,
+                     "data": data.hex(), "attrs": _attrs_to(attrs)},
+                )
+                for osd in acting
+                if osd not in (self.id, _NONE)
+                and not self.osdmap.is_down(osd)
+            ]
+            if waits:
+                await asyncio.gather(*waits)
+            return
+        encoded = ec.encode(range(ec.get_chunk_count()), data)
+        hinfo = HashInfo.from_shards(encoded, ec.get_chunk_count())
+        attrs = {"ver": entry["obj_ver"], "hinfo": hinfo,
+                 "size": len(data)}
+        waits = []
+        for pos, osd in enumerate(acting):
+            if osd == _NONE or self.osdmap.is_down(osd):
+                continue  # degraded write: that shard stays missing
+            if osd == self.id:
+                txn = Transaction().write(
+                    pg.coll, shard_name(name, pos), encoded[pos],
+                    attrs=attrs,
+                )
+                pg.append_log(txn, entry)
+                self.store.queue_transaction(txn)
+                continue
+            waits.append(
+                self._peer_call(
+                    osd, "ec_sub_write",
+                    {"pgid": [pg.pool, pg.ps], "shard": pos,
+                     "entry": entry, "data": encoded[pos].hex(),
+                     "attrs": _attrs_to(attrs)},
+                )
+            )
+        if waits:
+            await asyncio.gather(*waits)
+
+    async def _primary_delete(
+        self, pg: PG, acting: list[int], name: str
+    ) -> None:
+        entry = {
+            "version": pg.last_update + 1,
+            "name": name,
+            "obj_ver": self._obj_version(pg, name) + 1,
+            "kind": "delete",
+        }
+        ec = self.codec(pg.pool)
+        waits = []
+        for pos, osd in enumerate(acting):
+            if osd == _NONE or self.osdmap.is_down(osd):
+                continue
+            shard = pos if ec is not None else None
+            if osd == self.id:
+                txn = Transaction().remove(
+                    pg.coll, shard_name(name, shard)
+                )
+                pg.append_log(txn, entry)
+                self.store.queue_transaction(txn)
+                continue
+            mtype = "ec_sub_write" if ec is not None else "rep_write"
+            waits.append(
+                self._peer_call(
+                    osd, mtype,
+                    {"pgid": [pg.pool, pg.ps], "shard": shard,
+                     "entry": entry, "data": None},
+                )
+            )
+        if waits:
+            await asyncio.gather(*waits)
+
+    async def _primary_read(
+        self, pg: PG, acting: list[int], name: str
+    ) -> bytes:
+        entry = pg.latest_objects().get(name)
+        if entry is None or entry["kind"] == "delete":
+            raise RuntimeError(f"no such object {name!r}")
+        ec = self.codec(pg.pool)
+        if ec is None:
+            data = self.store.read(pg.coll, name)
+            attrs = self.store.getattrs(pg.coll, name)
+            if attrs.get("ver") != entry["obj_ver"]:
+                raise RuntimeError(f"local replica of {name!r} is stale")
+            return data
+
+        # EC: probe current-version shard availability at acting homes
+        available: dict[int, int] = {}
+        chunks: dict[int, bytes] = {}
+        size = None
+        for pos, osd in enumerate(acting):
+            if osd == _NONE or self.osdmap.is_down(osd):
+                continue
+            if osd == self.id:
+                try:
+                    data = self.store.read(pg.coll, shard_name(name, pos))
+                    attrs = self.store.getattrs(
+                        pg.coll, shard_name(name, pos)
+                    )
+                except StoreError:
+                    continue
+                if attrs.get("ver") == entry["obj_ver"]:
+                    available[pos] = osd
+                    chunks[pos] = data
+                    size = attrs.get("size", size)
+            else:
+                available[pos] = osd
+        want = {ec.chunk_index(i)
+                for i in range(ec.get_data_chunk_count())}
+        while True:
+            minimum = ec.minimum_to_decode(want, set(available))
+            fetch = [s for s in minimum if s not in chunks]
+            failed = None
+            for s in fetch:
+                try:
+                    rep = await self._peer_call(
+                        available[s], "obj_read",
+                        {"coll": pg.coll, "name": shard_name(name, s),
+                         "ver": entry["obj_ver"]},
+                        timeout=2.0,
+                    )
+                except (asyncio.TimeoutError, RuntimeError):
+                    rep = {"ok": False}
+                if not rep.get("ok"):
+                    failed = s
+                    break
+                chunks[s] = bytes.fromhex(rep["data"])
+                if size is None:
+                    size = _attrs_from(rep).get("size")
+            if failed is None:
+                break
+            del available[failed]
+            chunks.pop(failed, None)
+        decoded = ec.decode(want, {s: chunks[s] for s in minimum})
+        out = b"".join(
+            decoded[ec.chunk_index(i)]
+            for i in range(ec.get_data_chunk_count())
+        )
+        return out[:size] if size is not None else out
+
+    def _primary_stat(self, pg: PG, name: str) -> dict:
+        entry = pg.latest_objects().get(name)
+        if entry is None or entry["kind"] == "delete":
+            raise RuntimeError(f"no such object {name!r}")
+        return {"obj_ver": entry["obj_ver"], "pg_version": entry["version"]}
+
+
+def _attrs_to(attrs: dict | None) -> dict:
+    if attrs is None:
+        return {}
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, HashInfo):
+            out[k] = {"__hinfo__": [v.total_chunk_size,
+                                    v.cumulative_shard_hashes]}
+        elif isinstance(v, bytes):
+            out[k] = {"__bytes__": v.hex()}
+        else:
+            out[k] = v
+    return out
+
+
+def _attrs_from(p: dict) -> dict:
+    raw = p.get("attrs") or {}
+    out = {}
+    for k, v in raw.items():
+        if isinstance(v, dict) and "__hinfo__" in v:
+            out[k] = HashInfo(v["__hinfo__"][0], list(v["__hinfo__"][1]))
+        elif isinstance(v, dict) and "__bytes__" in v:
+            out[k] = bytes.fromhex(v["__bytes__"])
+        else:
+            out[k] = v
+    return out

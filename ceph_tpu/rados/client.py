@@ -1,0 +1,190 @@
+"""Objecter + Rados: the client op engine over the live cluster.
+
+The reference's Objecter (src/osdc/Objecter.cc) computes each op's target
+from its cached OSDMap (`_calc_target`, 2786: pool -> ps -> CRUSH -> primary),
+sends to the primary, and recomputes + resends whenever the map epoch moves
+or the target bounces it — ops survive OSD failures by re-targeting, never
+by give-up. Same loop here: a "wrong_primary" reply or a timeout refreshes
+the map from the mon and resends (epoch-tagged resend contract, SURVEY
+§2.4). `Rados`/`IoCtx` mirror the librados surface at mini scale
+(src/librados): connect once, then per-pool handles with
+write/read/delete/stat.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.hash import ceph_str_hash_rjenkins
+from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE
+
+
+class RadosError(Exception):
+    pass
+
+
+class Objecter(Dispatcher):
+    def __init__(
+        self,
+        name: str,
+        monmap,
+        config: Config | None = None,
+        keyring: dict[str, bytes] | None = None,
+    ):
+        self.name = name
+        self.config = config if config is not None else Config()
+        self.messenger = Messenger(
+            name, config=self.config, keyring=keyring
+        )
+        self.messenger.dispatcher = self
+        self.mon = MonClient(
+            name, monmap, config=self.config, messenger=self.messenger
+        )
+        self._tids = itertools.count(1)
+        self._waiters: dict[int, asyncio.Future] = {}
+
+    async def start(self) -> None:
+        self.mon.subscribe()
+        await self.mon.wait_for_map()
+
+    async def close(self) -> None:
+        await self.messenger.shutdown()
+
+    @property
+    def osdmap(self):
+        return self.mon.osdmap
+
+    async def ms_dispatch(self, conn, msg: Message) -> None:
+        if msg.type == "osd_op_reply":
+            p = json.loads(msg.data)
+            fut = self._waiters.get(p.get("tid"))
+            if fut is not None and not fut.done():
+                fut.set_result(p)
+
+    # -- targeting ------------------------------------------------------------
+
+    def _calc_target(self, pool_id: int, name: str) -> int:
+        """pool -> ps -> up/acting -> primary (Objecter::_calc_target)."""
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            raise RadosError(f"no pool {pool_id}")
+        ps = pool.raw_pg_to_pg(ceph_str_hash_rjenkins(name))
+        _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(
+            pool_id, ps
+        )
+        if primary in (-1, CRUSH_ITEM_NONE):
+            raise RadosError(f"pg {pool_id}.{ps} has no primary")
+        return primary
+
+    async def _refresh_map(self) -> None:
+        epoch = self.osdmap.epoch if self.osdmap else 0
+        self.mon.subscribe(from_epoch=epoch)
+        await asyncio.sleep(0.05)
+
+    # -- op submission --------------------------------------------------------
+
+    async def op_submit(
+        self,
+        pool_id: int,
+        name: str,
+        op: str,
+        data: bytes | None = None,
+        timeout: float = 30.0,
+    ) -> dict:
+        deadline = asyncio.get_event_loop().time() + timeout
+        last_error = "timed out"
+        while asyncio.get_event_loop().time() < deadline:
+            try:
+                primary = self._calc_target(pool_id, name)
+                addr = self.osdmap.osd_addrs.get(primary)
+                if addr is None:
+                    raise RadosError(f"no address for osd.{primary}")
+            except RadosError as e:
+                last_error = str(e)
+                await self._refresh_map()
+                continue
+            tid = next(self._tids)
+            payload = {"tid": tid, "pool": pool_id, "name": name, "op": op}
+            if data is not None:
+                payload["data"] = data.hex()
+            fut = asyncio.get_event_loop().create_future()
+            self._waiters[tid] = fut
+            try:
+                self.messenger.connect(
+                    tuple(addr), Policy.lossless_client()
+                ).send_message(
+                    Message(type="osd_op", tid=tid,
+                            epoch=self.osdmap.epoch,
+                            data=json.dumps(payload).encode())
+                )
+                reply = await asyncio.wait_for(fut, timeout=3.0)
+            except asyncio.TimeoutError:
+                # primary silent (died?): refresh the map and re-target
+                await self._refresh_map()
+                continue
+            finally:
+                self._waiters.pop(tid, None)
+            if reply.get("ok"):
+                return reply
+            if reply.get("wrong_primary"):
+                # our map was stale; catch up past the OSD's epoch
+                await self._refresh_map()
+                continue
+            last_error = reply.get("error", "op failed")
+            # transient primary-side errors (mid-recovery reads) retry
+            await self._refresh_map()
+        raise RadosError(
+            f"{op} {pool_id}/{name!r} failed: {last_error}"
+        )
+
+
+class IoCtx:
+    """Per-pool handle (librados ioctx)."""
+
+    def __init__(self, objecter: Objecter, pool_id: int):
+        self.objecter = objecter
+        self.pool_id = pool_id
+
+    async def write_full(self, name: str, data: bytes) -> None:
+        await self.objecter.op_submit(self.pool_id, name, "write", data)
+
+    async def read(self, name: str) -> bytes:
+        rep = await self.objecter.op_submit(self.pool_id, name, "read")
+        return bytes.fromhex(rep["data"])
+
+    async def remove(self, name: str) -> None:
+        await self.objecter.op_submit(self.pool_id, name, "delete")
+
+    async def stat(self, name: str) -> dict:
+        return await self.objecter.op_submit(self.pool_id, name, "stat")
+
+
+class Rados:
+    """Cluster handle (librados::Rados): connect, open pools, admin."""
+
+    def __init__(
+        self,
+        name: str,
+        monmap,
+        config: Config | None = None,
+        keyring: dict[str, bytes] | None = None,
+    ):
+        self.objecter = Objecter(name, monmap, config=config,
+                                 keyring=keyring)
+
+    async def connect(self) -> None:
+        await self.objecter.start()
+
+    async def shutdown(self) -> None:
+        await self.objecter.close()
+
+    def io_ctx(self, pool_id: int) -> IoCtx:
+        return IoCtx(self.objecter, pool_id)
+
+    async def mon_command(self, cmd: str, args: dict | None = None) -> dict:
+        return await self.objecter.mon.command(cmd, args)
