@@ -116,6 +116,8 @@ class Monitor(Dispatcher):
         self._subs: dict[str, object] = {}
         #: failed osd -> set of reporter names (OSDMonitor failure_info)
         self._failure_reports: dict[int, set[str]] = {}
+        #: reports received while leaderless, flushed post-election
+        self._stashed_reports: list[tuple[str, dict]] = []
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
 
@@ -154,7 +156,7 @@ class Monitor(Dispatcher):
         for v in range(1, self.last_committed + 1):
             raw = self.db.get(_VALS, _vkey(v))
             if raw is not None:
-                self._apply_value(raw)
+                self._apply_value(v, raw)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -217,9 +219,25 @@ class Monitor(Dispatcher):
 
     # -- election -------------------------------------------------------------
 
+    def _abort_proposals(self) -> None:
+        """Fail the in-flight and queued proposals on leadership loss:
+        their awaiting handlers reply an error and the reporter retries
+        against the new reign (a hung future would wedge its connection's
+        dispatch loop forever)."""
+        err = RuntimeError("leadership lost mid-proposal")
+        fl, self._in_flight = self._in_flight, None
+        if fl is not None and fl["fut"] is not None and not fl["fut"].done():
+            fl["fut"].set_exception(err)
+        q, self._propose_q = self._propose_q, []
+        for _service, _value, fut in q:
+            if not fut.done():
+                fut.set_exception(err)
+
     def start_election(self) -> None:
         if self._stopped:
             return
+        if self.state == "leader":
+            self._abort_proposals()
         self.state = "electing"
         self.leader_rank = None
         self.election_epoch += 1
@@ -268,6 +286,7 @@ class Monitor(Dispatcher):
             if self._lease_task is not None:
                 self._lease_task.cancel()
             self._lease_task = asyncio.create_task(self._lease_loop())
+            self._flush_stashed_reports()
             self._tasks.append(
                 asyncio.create_task(self._post_election_sync())
             )
@@ -309,11 +328,7 @@ class Monitor(Dispatcher):
         ]
         if live:
             best = max(live, key=lambda p: p["pn"])
-            self._tasks.append(
-                asyncio.create_task(
-                    self._drive_proposal(bytes.fromhex(best["value"]), None)
-                )
-            )
+            self._drive_proposal(bytes.fromhex(best["value"]), None)
         self._kick_propose_queue()
 
     async def _lease_loop(self) -> None:
@@ -358,11 +373,12 @@ class Monitor(Dispatcher):
             and self._propose_q
         ):
             _service, value, fut = self._propose_q.pop(0)
-            self._tasks.append(
-                asyncio.create_task(self._drive_proposal(value, fut))
-            )
+            self._drive_proposal(value, fut)
 
-    async def _drive_proposal(self, value: bytes, fut) -> None:
+    def _drive_proposal(self, value: bytes, fut) -> None:
+        """Synchronous on purpose: _in_flight must be claimed in the same
+        event-loop step as the queue pop, or two queued proposals would
+        both see it empty and race the same version."""
         version = self.last_committed + 1
         pn = self._pn()
         self._in_flight = {
@@ -413,15 +429,23 @@ class Monitor(Dispatcher):
         self.db.submit_transaction(txn)
         self.last_committed = version
         self._pending = None
-        self._apply_value(value)
+        self._apply_value(version, value)
         self._publish_maps()
 
-    def _apply_value(self, value: bytes) -> None:
+    def _apply_value(self, version: int, value: bytes) -> None:
+        """Deterministic application: the effective map epoch of the inc
+        committed as paxos version v is ALWAYS base+v, regardless of the
+        epoch the proposing handler guessed — two handlers racing to build
+        `epoch+1` incs would otherwise commit a value that every mon
+        silently skips, corrupting the version<->epoch mapping subscribers
+        rely on. Re-stamping is safe because every mon applies the same
+        commit sequence and computes the same result."""
         d = Decoder(value)
         service = d.string()
         payload = d.blob()
         if service == "osdmap":
             inc = Incremental.decode(payload)
+            inc.epoch = self._osdmap_base_epoch + version
             if inc.epoch == self.osdmap.epoch + 1:
                 self.osdmap.apply_incremental(inc)
 
@@ -429,7 +453,9 @@ class Monitor(Dispatcher):
 
     def _inc_for_epoch(self, epoch: int) -> bytes | None:
         """Committed incremental bytes producing map `epoch`, if retained."""
-        # paxos version v produced map epoch base + v (1:1, osdmap-only mon)
+        # paxos version v produced map epoch base + v (1:1, osdmap-only
+        # mon); serve it re-stamped with its effective epoch, matching what
+        # _apply_value applied (the stored bytes may carry a stale guess)
         v = epoch - self._osdmap_base_epoch
         raw = self.db.get(_VALS, _vkey(v)) if v >= 1 else None
         if raw is None:
@@ -437,7 +463,9 @@ class Monitor(Dispatcher):
         d = Decoder(raw)
         if d.string() != "osdmap":
             return None
-        return d.blob()
+        inc = Incremental.decode(d.blob())
+        inc.epoch = epoch
+        return inc.encode()
 
     def _map_payload(self, from_epoch: int) -> dict:
         """Incrementals (from_epoch, current] or a full map."""
@@ -454,17 +482,42 @@ class Monitor(Dispatcher):
 
     def _publish_maps(self) -> None:
         for peer, (conn, from_epoch) in list(self._subs.items()):
-            if from_epoch < self.osdmap.epoch:
-                self._send(conn, "osd_map", self._map_payload(from_epoch))
-                self._subs[peer] = (conn, self.osdmap.epoch)
+            if from_epoch >= self.osdmap.epoch:
+                continue
+            if not conn.is_connected:
+                # dead accepted session: keep the entry (and its epoch
+                # watermark) so the peer's reconnect re-attaches via
+                # ms_handle_accept and receives the backlog
+                continue
+            self._send(conn, "osd_map", self._map_payload(from_epoch))
+            self._subs[peer] = (conn, self.osdmap.epoch)
 
     # -- dispatch -------------------------------------------------------------
 
     async def ms_dispatch(self, conn, msg: Message) -> None:
         p = json.loads(msg.data) if msg.data else {}
         handler = getattr(self, f"_h_{msg.type}", None)
-        if handler is not None:
+        if handler is None:
+            return
+        try:
             await handler(conn, p)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # a handler failure (e.g. an aborted proposal) must not tear
+            # down the transport read loop it runs in
+            pass
+
+    async def ms_handle_accept(self, conn) -> None:
+        # a reconnecting subscriber re-attaches at its old watermark and
+        # immediately receives every epoch it missed while disconnected
+        sub = self._subs.get(conn.peer_name)
+        if sub is not None:
+            _old_conn, from_epoch = sub
+            self._subs[conn.peer_name] = (conn, from_epoch)
+            if from_epoch < self.osdmap.epoch:
+                self._send(conn, "osd_map", self._map_payload(from_epoch))
+                self._subs[conn.peer_name] = (conn, self.osdmap.epoch)
 
     async def ms_handle_reset(self, conn) -> None:
         # losing the leader's session forces a new election
@@ -531,10 +584,13 @@ class Monitor(Dispatcher):
     async def _h_el_victory(self, conn, p) -> None:
         if p["epoch"] < self.election_epoch:
             return
+        if self.state == "leader":
+            self._abort_proposals()
         self.election_epoch = p["epoch"]
         self.state = "peon"
         self.leader_rank = p["leader"]
         self.quorum = set(p["quorum"])
+        self._flush_stashed_reports()
         self._last_lease = asyncio.get_event_loop().time()
         if self._election_task is not None:
             self._election_task.cancel()
@@ -659,14 +715,30 @@ class Monitor(Dispatcher):
     def _forward_to_leader(self, msg_type: str, p: dict, conn) -> bool:
         """Peons forward one-way daemon reports to the leader (the
         reference's Monitor::forward_request_leader), tagging the original
-        reporter so distinct-reporter counting survives the hop."""
+        reporter so distinct-reporter counting survives the hop. Reports
+        arriving while no leader is known are stashed and flushed when the
+        election settles — dropping them would strand a booting OSD."""
         if self.is_leader:
             return False
+        fwd = dict(p)
+        fwd.setdefault("reporter", conn.peer_name if conn else self.name)
         if self.leader_rank is not None and self.leader_rank != self.rank:
-            fwd = dict(p)
-            fwd.setdefault("reporter", conn.peer_name)
             self._send(self.leader_rank, msg_type, fwd)
+        else:
+            self._stashed_reports.append((msg_type, fwd))
         return True
+
+    def _flush_stashed_reports(self) -> None:
+        stash, self._stashed_reports = self._stashed_reports, []
+        for msg_type, p in stash:
+            if self.is_leader:
+                handler = getattr(self, f"_h_{msg_type}", None)
+                if handler is not None:
+                    self._tasks.append(
+                        asyncio.create_task(handler(None, p))
+                    )
+            elif self.leader_rank is not None:
+                self._send(self.leader_rank, msg_type, p)
 
     async def _h_osd_failure(self, conn, p) -> None:
         """OSDMonitor::prepare_failure: count distinct reporters."""

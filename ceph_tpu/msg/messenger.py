@@ -375,7 +375,9 @@ class Connection:
                     await m.dispatch_throttle.put(size)
             elif frame.tag == Tag.ACK:
                 acked = Decoder(frame.payload).u64()
-                self._unacked = [
+                # in place: accepted connections share this list with the
+                # messenger's per-peer-instance window (_peer_unacked)
+                self._unacked[:] = [
                     mm for mm in self._unacked if mm.seq > acked
                 ]
             elif frame.tag == Tag.KEEPALIVE:
@@ -418,6 +420,9 @@ class Messenger:
         self._peer_in_seq: dict[tuple, int] = {}
         #: (peer_name, peer_nonce) -> last seq sent on our accepted side
         self._peer_out_seq: dict[tuple, int] = {}
+        #: (peer_name, peer_nonce) -> un-acked server->client messages,
+        #: shared across accepted-connection instances (replayed on accept)
+        self._peer_unacked: dict[tuple, list] = {}
         self._rng = random.Random(seed)
         #: instance identity (entity_addr_t::nonce): a restarted daemon
         #: reusing its name/address presents a fresh nonce, so peers reset
@@ -499,11 +504,29 @@ class Messenger:
                 if not await self._server_auth(stream, conn):
                     writer.close()
                     return
+            # adopt the peer instance's surviving un-acked window: the
+            # previous accepted Connection died with the old socket, but
+            # lossless server->client messages awaiting ACKs must replay
+            # on this new session or they are silently lost
+            ukey = (conn.peer_name, conn.peer_nonce)
+            conn._unacked = self._peer_unacked.setdefault(ukey, [])
             conn._stream = stream
             conn._ready.set()
             self._accepted.append(conn)
             await _call(self.dispatcher.ms_handle_accept, conn)
-            writer_task = asyncio.create_task(conn._write_loop(stream))
+
+            async def replay_then_write():
+                # ordered replay before any newly queued frame; ACKs are
+                # processed concurrently by the read loop below
+                for m in list(conn._unacked):
+                    if m not in conn._unacked:
+                        continue  # acked while replaying
+                    await stream.send(
+                        Frame(Tag.MESSAGE, m.encode()), conn.session_key
+                    )
+                await conn._write_loop(stream)
+
+            writer_task = asyncio.create_task(replay_then_write())
             conn._tasks.append(writer_task)
             try:
                 await conn._read_loop(stream)

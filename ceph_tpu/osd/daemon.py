@@ -83,15 +83,22 @@ class PG:
                     self.coll, self.META
                 )
             )
+        # in-memory mirror of the persisted log (loaded once, then kept in
+        # step by append_log): per-op paths read these instead of scanning
+        # + json-decoding the whole omap on every write
+        self._last_update = 0
+        self._inventory: dict[str, dict] = {}
+        for e in self._scan_log():
+            self._last_update = max(self._last_update, e["version"])
+            self._inventory[e["name"]] = e
 
     # -- the persisted log ----------------------------------------------------
 
     @property
     def last_update(self) -> int:
-        raw = self.service.store.omap_get(self.coll, self.META).get(b"info")
-        return 0 if raw is None else json.loads(raw)["last_update"]
+        return self._last_update
 
-    def log_entries(self, from_version: int = 0) -> list[dict]:
+    def _scan_log(self, from_version: int = 0) -> list[dict]:
         out = []
         for k, v in sorted(
             self.service.store.omap_get(self.coll, self.META).items()
@@ -102,7 +109,13 @@ class PG:
                     out.append(e)
         return out
 
+    def log_entries(self, from_version: int = 0) -> list[dict]:
+        return self._scan_log(from_version)
+
     def append_log(self, txn: Transaction, entry: dict) -> None:
+        """Record `entry` in the transaction AND the in-memory mirror; the
+        caller must queue_transaction(txn) before yielding control (all
+        call sites do, under the PG lock)."""
         txn.omap_setkeys(
             self.coll,
             self.META,
@@ -113,13 +126,14 @@ class PG:
                 ).encode(),
             },
         )
+        self._last_update = max(self._last_update, entry["version"])
+        cur = self._inventory.get(entry["name"])
+        if cur is None or entry["version"] > cur["version"]:
+            self._inventory[entry["name"]] = entry
 
     def latest_objects(self) -> dict[str, dict]:
         """name -> newest log entry (the recovery inventory)."""
-        out: dict[str, dict] = {}
-        for e in self.log_entries():
-            out[e["name"]] = e
-        return out
+        return self._inventory
 
 
 class OSDService(Dispatcher):
@@ -165,10 +179,12 @@ class OSDService(Dispatcher):
         await self.messenger.bind()
         self.mon.subscribe()
         await self.mon.wait_for_map()
-        self.mon.send_boot(self.id, tuple(self.messenger.my_addr))
-        # serve once the quorum-committed map says we're up at our address
+        # serve once the quorum-committed map says we're up at our address;
+        # the boot report is re-sent until then (it can race an election
+        # or ride a session that dies — one-way messages need the retry)
         loop = asyncio.get_event_loop()
-        end = loop.time() + 10
+        end = loop.time() + 30
+        next_boot = 0.0
         while loop.time() < end:
             m = self.osdmap
             if (
@@ -178,6 +194,11 @@ class OSDService(Dispatcher):
                 == tuple(self.messenger.my_addr)
             ):
                 break
+            if loop.time() >= next_boot:
+                self.mon.send_boot(
+                    self.id, tuple(self.messenger.my_addr)
+                )
+                next_boot = loop.time() + 1.0
             await asyncio.sleep(0.02)
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._peering_loop()))

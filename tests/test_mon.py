@@ -236,3 +236,33 @@ def test_leader_failover_and_restart_catchup():
             await m.stop()
 
     run(main())
+
+
+def test_racing_proposals_both_take_effect():
+    """Two handlers building `epoch+1` incrementals concurrently must both
+    apply: application re-stamps each committed value with its effective
+    epoch (base + paxos version) instead of silently skipping the loser."""
+
+    async def main():
+        mons, monmap, cfg = await start_cluster(3)
+        leader = next(m for m in mons if m.is_leader)
+        e0 = leader.osdmap.epoch
+        from ceph_tpu.osd.osdmap import Incremental
+
+        # both deliberately stamped with the same guessed epoch
+        a = Incremental(epoch=e0 + 1, new_weight={2: 0})
+        b = Incremental(epoch=e0 + 1, new_weight={5: 0x8000})
+        await asyncio.gather(
+            leader.propose("osdmap", a.encode()),
+            leader.propose("osdmap", b.encode()),
+        )
+        await wait_until(
+            lambda: all(m.osdmap.epoch == e0 + 2 for m in mons)
+        )
+        for m in mons:
+            assert int(m.osdmap.osd_weight[2]) == 0
+            assert int(m.osdmap.osd_weight[5]) == 0x8000
+        for m in mons:
+            await m.stop()
+
+    run(main())
