@@ -91,6 +91,12 @@ class PG:
         for e in self._scan_log():
             self._last_update = max(self._last_update, e["version"])
             self._inventory[e["name"]] = e
+        #: a primary serves client IO only once peering for the current
+        #: interval finished (PeeringState: Peering -> Active); until then
+        #: ops bounce with a retryable error, so a revived primary can
+        #: never serve ENOENT for an object it simply hasn't learned yet
+        self.active = False
+        self.last_acting: list[int] | None = None
 
     # -- the persisted log ----------------------------------------------------
 
@@ -361,13 +367,35 @@ class OSDService(Dispatcher):
         for key in mine:
             if key not in self.pgs:
                 self.pgs[key] = PG(self, *key)
-        # primaries drive recovery for their PGs
+        # primaries drive recovery for their PGs; the interval's acting set
+        # is the peering trigger (PastIntervals role): unchanged acting on
+        # an already-active PG needs no new pass
+        retry_needed = False
         for (pool_id, ps) in sorted(mine):
             acting, primary = self.acting_of(pool_id, ps)
-            if primary == self.id:
-                pg = self.pgs[(pool_id, ps)]
+            pg = self.pgs[(pool_id, ps)]
+            if primary != self.id:
+                pg.active = False
+                pg.last_acting = None
+                continue
+            if pg.active and pg.last_acting == acting:
+                continue
+            pg.active = False
+            try:
                 async with pg.lock:
                     await self._peer_and_recover(pg, acting)
+                pg.active = True
+                pg.last_acting = list(acting)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                retry_needed = True  # transient peer trouble: try again
+        if retry_needed and not self._stopped:
+            async def nudge():
+                await asyncio.sleep(0.3)
+                self._map_dirty.set()
+
+            self._tasks.append(asyncio.create_task(nudge()))
 
     async def _peer_and_recover(self, pg: PG, acting: list[int]) -> None:
         """GetInfo -> GetLog -> GetMissing -> push, one pass."""
@@ -682,6 +710,10 @@ class OSDService(Dispatcher):
                 )
                 return
             pg = self._pg_of((pool_id, ps))
+            if not pg.active:
+                raise RuntimeError(
+                    f"pg {pool_id}.{ps} is peering"
+                )  # retryable: no errno, the client resends
             if p["op"] == "write":
                 async with pg.lock:
                     await self._primary_write(
@@ -703,6 +735,10 @@ class OSDService(Dispatcher):
             else:
                 raise RuntimeError(f"unknown op {p['op']!r}")
             reply = {"tid": p["tid"], "ok": True, **result}
+        except StoreError as e:
+            # permanent, client-visible errno (ENOENT): no point retrying
+            reply = {"tid": p["tid"], "ok": False, "error": str(e),
+                     "errno": e.code}
         except Exception as e:
             reply = {"tid": p["tid"], "ok": False, "error": str(e)}
         conn.send_message(
@@ -808,7 +844,7 @@ class OSDService(Dispatcher):
     ) -> bytes:
         entry = pg.latest_objects().get(name)
         if entry is None or entry["kind"] == "delete":
-            raise RuntimeError(f"no such object {name!r}")
+            raise StoreError("ENOENT", f"no such object {name!r}")
         ec = self.codec(pg.pool)
         if ec is None:
             data = self.store.read(pg.coll, name)
@@ -874,7 +910,7 @@ class OSDService(Dispatcher):
     def _primary_stat(self, pg: PG, name: str) -> dict:
         entry = pg.latest_objects().get(name)
         if entry is None or entry["kind"] == "delete":
-            raise RuntimeError(f"no such object {name!r}")
+            raise StoreError("ENOENT", f"no such object {name!r}")
         return {"obj_ver": entry["obj_ver"], "pg_version": entry["version"]}
 
 

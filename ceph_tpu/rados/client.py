@@ -28,6 +28,10 @@ class RadosError(Exception):
     pass
 
 
+class ObjectNotFound(RadosError):
+    """ENOENT from the primary — permanent, never retried."""
+
+
 class Objecter(Dispatcher):
     def __init__(
         self,
@@ -135,6 +139,11 @@ class Objecter(Dispatcher):
                 # our map was stale; catch up past the OSD's epoch
                 await self._refresh_map()
                 continue
+            if reply.get("errno") == "ENOENT":
+                raise ObjectNotFound(
+                    f"{op} {pool_id}/{name!r}: "
+                    + reply.get("error", "no such object")
+                )
             last_error = reply.get("error", "op failed")
             # transient primary-side errors (mid-recovery reads) retry
             await self._refresh_map()
