@@ -80,8 +80,24 @@ def _require_x64() -> None:
 
 
 MAX_DEPTH = 10  # CRUSH_MAX_DEPTH (crush.h:26)
-DEFAULT_CHUNK = 1 << 16
+#: max lanes per launch: the largest pow2 whose u8 one-hot temps still fit
+#: v5e HBM on the 10k-OSD benchmark hierarchy (2^19 OOMs); bigger launches
+#: amortize fixed overhead, measured 483k vs 311k mappings/s over 2^16
+DEFAULT_CHUNK = 1 << 18
 _S64_MIN = -(2**63)
+
+
+def _pick_chunk(n: int) -> int:
+    """Smallest pow2 covering n, clamped to [2^12, DEFAULT_CHUNK] — tail
+    chunks are padded to the chunk size, so small batches (tests, one-off
+    lookups) must not pay the full-launch padding. The CPU backend (oracle
+    tests) caps at 2^16: the big-launch win is TPU HBM/launch economics, and
+    the same shapes just slow the host down."""
+    cap = DEFAULT_CHUNK if jax.default_backend() == "tpu" else 1 << 16
+    c = 1 << 12
+    while c < n and c < cap:
+        c <<= 1
+    return c
 
 
 # -- integer primitives ------------------------------------------------------
@@ -228,11 +244,15 @@ def _onehot_limb_matmul(idx, limbs, width: int):
     oh = (flat[:, None] == jnp.arange(width, dtype=jnp.int32)).astype(
         jnp.uint8
     )
+    # u8 output: the accumulator selects exactly one u8 row, so truncating
+    # the s32 MXU accumulation to u8 is lossless — and the materialized
+    # (lanes*items, limbs) temp (+ its relayout copy) shrinks 4x, which is
+    # the dominant HBM traffic of the whole mapper
     out = jax.lax.dot_general(
         oh,
         limbs,
         (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32,
+        preferred_element_type=jnp.uint8,
     )
     return out.reshape(*idx.shape, limbs.shape[1])
 
@@ -729,9 +749,17 @@ def _choose_firstn_static(
 ):
     """Batched crush_choose_firstn from a static start bucket (mapper.c:460).
 
-    Try 0 runs on the full batch; stragglers are compacted into an N/8 buffer
-    for the retry loop, with a full-batch fallback if too many lanes retry.
-    Returns (out, out2): (N, out_slots) NONE-padded.
+    The replica draws at ftotal=0 depend only on (x, r) — never on earlier
+    replicas' picks — so ALL numrep first tries run as ONE descent launch at
+    numrep-times the batch (host level + leaf level), amortizing the
+    per-launch overhead that dominates each choose. What DOES depend on
+    order (collision against already-placed items, overload tests, the
+    outpos the try assumed) is resolved afterwards per replica with cheap
+    elementwise ops; only lanes whose precomputed try is rejected or stale
+    take the compacted retry loop, now from ftotal=0 with the true state
+    (re-running a deterministic failed try is a no-op, so results stay
+    bit-exact with the scalar semantics). Returns (out, out2):
+    (N, out_slots) NONE-padded.
     """
     n = xs.shape[0]
     none = jnp.int32(CRUSH_ITEM_NONE)
@@ -741,18 +769,79 @@ def _choose_firstn_static(
     slot = jnp.arange(out_slots)[None, :]
     k = max(min(n, 64), n // 8)
 
+    # ---- all replicas' try-0 in one launch ----------------------------------
+    xs_all = jnp.tile(xs, numrep)
+    r_all = jnp.repeat(jnp.arange(numrep, dtype=jnp.int32), n)
+    item_a, item_row_a, reached_a, skip_a = _descend_b(
+        cm, start_bid, xs_all, r_all, want_type, r_all, cm.depth
+    )
+    if recurse_to_leaf:
+        sub_r_a = (
+            (r_all >> (vary_r - 1)) if vary_r else jnp.zeros_like(r_all)
+        )
+        rep0_a = jnp.zeros_like(r_all) if stable else r_all
+        leaf_a, _, leaf_reached_a, _ = _descend_b(
+            cm, item_row_a, xs_all, rep0_a + sub_r_a, 0, r_all, cm.depth
+        )
+        is_dev_a = item_a >= 0
+        leaf_pick_a = jnp.where(is_dev_a, item_a, leaf_a)
+        got_leaf_a = is_dev_a | (
+            leaf_reached_a & ~_is_out_b(weight_vec, leaf_a, xs_all)
+        )
+    else:
+        leaf_pick_a = jnp.zeros_like(item_a)
+        got_leaf_a = jnp.ones_like(reached_a)
+
+    def per_rep(a):
+        return a.reshape(numrep, n)
+
+    item_r = per_rep(item_a)
+    reached_r = per_rep(reached_a)
+    skip_r = per_rep(skip_a)
+    leaf_r = per_rep(leaf_pick_a)
+    got_leaf_r = per_rep(got_leaf_a)
+
+    # ---- per-replica resolve + retry (unrolled; numrep is static) -----------
     def rep_body(rep, carry):
         out, out2, outpos = carry
         rep_i = jnp.full(n, rep, dtype=jnp.int32)
-        ft0 = jnp.zeros(n, dtype=jnp.int32)
-        all_active = jnp.ones(n, dtype=bool)
 
-        item, leaf, good, skip = _firstn_try(
-            cm, weight_vec, start_bid, xs, out, out2, outpos, rep_i, ft0,
-            want_type, recurse_to_leaf, recurse_tries, vary_r, stable,
-            all_active,
+        # rep is a traced loop index: dynamic-slice into the precomputed
+        # tries keeps this body traced ONCE (an unrolled python loop would
+        # clone the retry sub-graphs numrep times and balloon compile time)
+        item = jax.lax.dynamic_index_in_dim(
+            item_r, rep, axis=0, keepdims=False
         )
+        leaf = jax.lax.dynamic_index_in_dim(
+            leaf_r, rep, axis=0, keepdims=False
+        )
+        # the precomputed try assumed outpos == rep (its r and perm
+        # positions); lanes where that no longer holds go to the retry path
+        pre_valid = outpos == rep
+        collide = jnp.any(
+            (slot < outpos[:, None]) & (out == item[:, None]), axis=1
+        )
+        reached0 = jax.lax.dynamic_index_in_dim(
+            reached_r, rep, axis=0, keepdims=False
+        )
+        skip0 = jax.lax.dynamic_index_in_dim(
+            skip_r, rep, axis=0, keepdims=False
+        )
+        good = pre_valid & reached0 & ~skip0 & ~collide
+        if recurse_to_leaf:
+            leaf_collide = jnp.any(
+                (slot < outpos[:, None]) & (out2 == leaf[:, None]), axis=1
+            )
+            got_leaf0 = jax.lax.dynamic_index_in_dim(
+                got_leaf_r, rep, axis=0, keepdims=False
+            )
+            good = good & got_leaf0 & ~leaf_collide
+        if want_type == 0:
+            good = good & ~_is_out_b(weight_vec, item, xs)
         placed = good
+        # a skip from a VALID try is terminal for this replica, exactly as
+        # in the sequential loop; a stale skip retries with true state
+        skip = pre_valid & skip0
 
         need = ~placed & ~skip
         n_need = jnp.sum(need)
@@ -789,7 +878,9 @@ def _choose_firstn_static(
                 )
 
             init = (
-                jnp.int32(1),  # ftotal starts at 1 (try 0 already done)
+                # ftotal 0: stale lanes need a true try-0; genuinely-failed
+                # lanes deterministically fail it again, then proceed to 1
+                jnp.int32(0),
                 jnp.zeros(k, jnp.int32),
                 jnp.zeros(k, jnp.int32),
                 jnp.zeros(k, bool),
@@ -831,7 +922,7 @@ def _choose_firstn_static(
                 return jnp.any(~placed & ~skip & (ftotal < tries))
 
             _, item, leaf, placed, skip = jax.lax.while_loop(
-                cond, body, (jnp.int32(1), item, leaf, placed, skip)
+                cond, body, (jnp.int32(0), item, leaf, placed, skip)
             )
             return item, leaf, placed, skip
 
@@ -851,7 +942,9 @@ def _choose_firstn_static(
         outpos = outpos + can.astype(jnp.int32)
         return out, out2, outpos
 
-    out, out2, _ = jax.lax.fori_loop(0, numrep, rep_body, (out, out2, outpos))
+    out, out2, _ = jax.lax.fori_loop(
+        0, numrep, rep_body, (out, out2, outpos)
+    )
     return out, out2
 
 
@@ -1175,7 +1268,7 @@ def map_rule(
     xs,
     weight,
     result_max: int,
-    chunk: int = DEFAULT_CHUNK,
+    chunk: int | None = None,
     return_lengths: bool = False,
 ):
     """Evaluate one rule for a whole batch of x on device.
@@ -1194,6 +1287,8 @@ def map_rule(
     cmap = compiled.source
     rule = cmap.rules[ruleno]
     xs = np.asarray(xs, dtype=np.int32)
+    if chunk is None:
+        chunk = _pick_chunk(len(xs))
     weight_vec = jnp.asarray(np.asarray(weight, dtype=np.int64))
 
     # phase 1: dispatch every chunk (async under JAX); phase 2: fetch +

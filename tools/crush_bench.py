@@ -120,7 +120,7 @@ def main(argv=None) -> int:
     compiled = jm.compile_map(cmap)
     xs = np.arange(args.pgs)
 
-    jm.map_rule(compiled, 0, xs[: jm.DEFAULT_CHUNK], weight, args.replicas)  # compile
+    jm.map_rule(compiled, 0, xs[: jm.DEFAULT_CHUNK], weight, args.replicas)  # warm the compile cache
     jax_s = float("inf")
     for _ in range(max(args.repeats, 1)):
         t0 = time.perf_counter()
