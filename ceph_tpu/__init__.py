@@ -12,12 +12,21 @@ Subpackages:
              fused packed-lane Pallas kernel (gf_pallas)
   ec       — erasure-code framework: interface, registry, and all five
              reference codec families (jerasure/isa RS, shec, lrc, clay)
-  crush    — CRUSH placement: data model, NumPy oracle, batched JAX mapper
-  osd      — cluster map (OSDMap placement pipeline, balancer) + MemStore
-  rados    — MiniCluster: the end-to-end data path (put/get, degraded
-             reads, recovery, scrub/repair, fault injection) + Striper
+  crush    — CRUSH placement: data model, NumPy oracle, batched JAX mapper,
+             text compiler/decompiler, CrushTester engine
+  msg      — L1 transport: async messenger, crc-framed protocol, HMAC
+             session auth, lossless resend, fault injection
+  mon      — L7 control plane: monitor quorum (election + Paxos commits),
+             OSDMonitor service, MonClient with map subscriptions
+  osd      — cluster map (OSDMap pipeline, balancer, Incremental deltas),
+             object stores (KStore/MemStore over KeyValueDB), and the live
+             OSDService daemon (backends, PG logs, peering, heartbeats)
+  rados    — clients: Objecter/Rados/IoCtx against live clusters;
+             MiniCluster single-process data path; Striper
+  rbd      — librbd-lite block images on striped objects
   common   — L0 runtime: hashes, typed config schema, perf counters,
-             admin commands + op tracker, crc32c, compressors, throttle
+             admin commands + op tracker, crc32c, compressors, throttle,
+             denc-lite encoding, KeyValueDB (MemDB / WAL FileDB)
   parallel — device-mesh sharding helpers (shard_map over stripe batches)
   native   — C++ layer: the dlopen'd erasure-code plugin ABI + CPU codec
              (libec_native.so), built by ceph_tpu/native/build.py
