@@ -1,0 +1,179 @@
+"""cls: in-OSD object classes — server-side methods on objects.
+
+The reference's object-class mechanism (src/objclass/class_api.cc +
+src/cls/*) lets clients invoke named methods that run INSIDE the primary
+OSD against the object (rados `exec`/cls_cxx_*): reads and read-modify-write
+cycles happen server-side, atomically, without shipping the object to the
+client. rbd locking, rgw indexes, and watch bookkeeping all live there.
+
+Mini equivalent: a `ClassHandler` registry of (class, method) -> python
+callable with RD/WR flags (objclass method flags); the OSD daemon executes
+a "call" op by building a `MethodContext` over the object's current content
++ user xattrs, running the method, and — for WR methods that dirtied the
+context — writing the result back through the normal backend path, so the
+mutation replicates/EC-encodes like any client write.
+
+Built-in classes (reference parity targets):
+
+  * `lock` — advisory exclusive/shared locks held in user xattrs
+    (src/cls/lock/cls_lock.cc: lock_op/unlock_op semantics incl. EBUSY on
+    conflicting holders and idempotent re-lock by the same owner+cookie).
+  * `version` — object-version read/check gates
+    (src/cls/version/cls_version.cc), backed by the PG log's obj_ver.
+
+Custom classes register at runtime (`DEFAULT_HANDLER.register`), the
+load-your-own-.so story without dlopen.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+RD = 1  #: method reads object state (CLS_METHOD_RD)
+WR = 2  #: method may mutate object state (CLS_METHOD_WR)
+
+
+class ClsError(Exception):
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(message or code)
+        self.code = code  # "EBUSY" | "ENOENT" | "ECANCELED" | ...
+
+
+@dataclass
+class MethodContext:
+    """What a method sees of its object (cls_cxx_read/write/get/setxattr)."""
+
+    #: None when the object does not exist yet
+    data: bytes | None
+    #: free-form user xattrs (json-serializable values)
+    user_attrs: dict = field(default_factory=dict)
+    #: the PG log's object version (0 when absent)
+    version: int = 0
+    _writable: bool = False
+    dirty: bool = False
+
+    def exists(self) -> bool:
+        return self.data is not None
+
+    def read(self) -> bytes:
+        if self.data is None:
+            raise ClsError("ENOENT", "object does not exist")
+        return self.data
+
+    def write(self, data: bytes) -> None:
+        if not self._writable:
+            raise ClsError("EPERM", "RD method attempted a write")
+        self.data = bytes(data)
+        self.dirty = True
+
+    def getxattr(self, key: str):
+        return self.user_attrs.get(key)
+
+    def setxattr(self, key: str, value) -> None:
+        if not self._writable:
+            raise ClsError("EPERM", "RD method attempted a write")
+        self.user_attrs[key] = value
+        self.dirty = True
+
+    def rmxattr(self, key: str) -> None:
+        if not self._writable:
+            raise ClsError("EPERM", "RD method attempted a write")
+        if self.user_attrs.pop(key, None) is not None:
+            self.dirty = True
+
+
+class ClassHandler:
+    """(class, method) registry (ClassHandler in src/osd/ClassHandler.h)."""
+
+    def __init__(self) -> None:
+        self._methods: dict[tuple[str, str], tuple[int, object]] = {}
+
+    def register(self, cls: str, method: str, flags: int, fn) -> None:
+        self._methods[(cls, method)] = (flags, fn)
+
+    def call(self, cls: str, method: str, ctx: MethodContext, inp: dict):
+        entry = self._methods.get((cls, method))
+        if entry is None:
+            raise ClsError("EOPNOTSUPP", f"no method {cls}.{method}")
+        flags, fn = entry
+        ctx._writable = bool(flags & WR)
+        return fn(ctx, inp or {})
+
+
+# -- cls_lock (src/cls/lock/cls_lock.cc behaviors) ----------------------------
+
+def _lock_key(name: str) -> str:
+    return f"lock.{name}"
+
+
+def _lock_op(ctx: MethodContext, inp: dict):
+    name = inp["name"]
+    ltype = inp.get("type", "exclusive")
+    owner = inp["owner"]
+    cookie = inp.get("cookie", "")
+    state = ctx.getxattr(_lock_key(name)) or {"type": ltype, "holders": []}
+    me = {"owner": owner, "cookie": cookie}
+    if state["holders"]:
+        if me in state["holders"]:
+            return {"ok": True, "renewed": True}  # idempotent re-lock
+        if ltype == "exclusive" or state["type"] == "exclusive":
+            raise ClsError("EBUSY", f"lock {name!r} held")
+    state["type"] = ltype
+    state["holders"].append(me)
+    ctx.setxattr(_lock_key(name), state)
+    return {"ok": True}
+
+
+def _unlock_op(ctx: MethodContext, inp: dict):
+    name = inp["name"]
+    state = ctx.getxattr(_lock_key(name))
+    me = {"owner": inp["owner"], "cookie": inp.get("cookie", "")}
+    if not state or me not in state["holders"]:
+        raise ClsError("ENOENT", f"not the holder of {name!r}")
+    state["holders"].remove(me)
+    if state["holders"]:
+        ctx.setxattr(_lock_key(name), state)
+    else:
+        ctx.rmxattr(_lock_key(name))
+    return {"ok": True}
+
+
+def _lock_info(ctx: MethodContext, inp: dict):
+    state = ctx.getxattr(_lock_key(inp["name"]))
+    return {"holders": [] if not state else state["holders"],
+            "type": None if not state else state["type"]}
+
+
+# -- cls_version (src/cls/version/cls_version.cc) -----------------------------
+
+def _version_read(ctx: MethodContext, inp: dict):
+    return {"ver": ctx.version}
+
+
+def _version_check(ctx: MethodContext, inp: dict):
+    """Fail with ECANCELED unless the object version satisfies the
+    condition — the optimistic-concurrency gate rgw relies on."""
+    want = inp["ver"]
+    cond = inp.get("cond", "eq")
+    ok = {
+        "eq": ctx.version == want,
+        "gt": ctx.version > want,
+        "ge": ctx.version >= want,
+    }.get(cond)
+    if ok is None:
+        raise ClsError("EINVAL", f"bad cond {cond!r}")
+    if not ok:
+        raise ClsError(
+            "ECANCELED", f"version {ctx.version} fails {cond} {want}"
+        )
+    return {"ok": True, "ver": ctx.version}
+
+
+def default_handler() -> ClassHandler:
+    h = ClassHandler()
+    h.register("lock", "lock", RD | WR, _lock_op)
+    h.register("lock", "unlock", RD | WR, _unlock_op)
+    h.register("lock", "get_info", RD, _lock_info)
+    h.register("version", "read", RD, _version_read)
+    h.register("version", "check", RD, _version_check)
+    return h

@@ -50,6 +50,7 @@ from ceph_tpu.common.config import Config
 from ceph_tpu.common.kv import KeyValueDB
 from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy
 from ceph_tpu.mon.client import MonClient
+from ceph_tpu.osd.cls import ClsError, MethodContext, default_handler
 from ceph_tpu.osd.ecutil import HashInfo
 from ceph_tpu.osd.objectstore import KStore, StoreError, Transaction
 from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE
@@ -165,6 +166,7 @@ class OSDService(Dispatcher):
             messenger=self.messenger,
         )
         self.pgs: dict[tuple[int, int], PG] = {}
+        self.cls = default_handler()  # in-OSD object classes (src/cls)
         self._codecs: dict[int, object] = {}
         self._tids = iter(range(1, 1 << 62))
         self._waiters: dict[int, asyncio.Future] = {}
@@ -732,11 +734,15 @@ class OSDService(Dispatcher):
                 }
             elif p["op"] == "stat":
                 result = self._primary_stat(pg, name)
+            elif p["op"] == "call":
+                async with pg.lock:
+                    result = await self._primary_call(pg, acting, name, p)
             else:
                 raise RuntimeError(f"unknown op {p['op']!r}")
             reply = {"tid": p["tid"], "ok": True, **result}
-        except StoreError as e:
-            # permanent, client-visible errno (ENOENT): no point retrying
+        except (StoreError, ClsError) as e:
+            # permanent, client-visible errno (ENOENT/EBUSY/...): the
+            # client surfaces these instead of retrying
             reply = {"tid": p["tid"], "ok": False, "error": str(e),
                      "errno": e.code}
         except Exception as e:
@@ -752,17 +758,27 @@ class OSDService(Dispatcher):
         return 0 if e is None else e["obj_ver"]
 
     async def _primary_write(
-        self, pg: PG, acting: list[int], name: str, data: bytes
+        self, pg: PG, acting: list[int], name: str, data: bytes,
+        user_attrs: dict | None = None,
     ) -> None:
+        """Full-object write fan-out. `user_attrs` (cls xattrs) ride along
+        as a json blob on every replica/shard; a plain client write_full
+        resets them, matching its replace-the-object semantics."""
         entry = {
             "version": pg.last_update + 1,
             "name": name,
             "obj_ver": self._obj_version(pg, name) + 1,
             "kind": "modify",
         }
+        user_blob = (
+            json.dumps(user_attrs, sort_keys=True).encode()
+            if user_attrs else None
+        )
         ec = self.codec(pg.pool)
         if ec is None:
             attrs = {"ver": entry["obj_ver"]}
+            if user_blob is not None:
+                attrs["user"] = user_blob
             txn = Transaction().write(pg.coll, name, data, attrs=attrs)
             pg.append_log(txn, entry)
             self.store.queue_transaction(txn)
@@ -783,6 +799,8 @@ class OSDService(Dispatcher):
         hinfo = HashInfo.from_shards(encoded, ec.get_chunk_count())
         attrs = {"ver": entry["obj_ver"], "hinfo": hinfo,
                  "size": len(data)}
+        if user_blob is not None:
+            attrs["user"] = user_blob
         waits = []
         for pos, osd in enumerate(acting):
             if osd == _NONE or self.osdmap.is_down(osd):
@@ -912,6 +930,43 @@ class OSDService(Dispatcher):
         if entry is None or entry["kind"] == "delete":
             raise StoreError("ENOENT", f"no such object {name!r}")
         return {"obj_ver": entry["obj_ver"], "pg_version": entry["version"]}
+
+    async def _primary_call(
+        self, pg: PG, acting: list[int], name: str, p: dict
+    ) -> dict:
+        """Execute an object-class method server-side (rados exec; the
+        PrimaryLogPG CEPH_OSD_OP_CALL path): build the context from the
+        object's current content + user xattrs, run the method, and write
+        dirty results back through the normal backend fan-out so the
+        mutation replicates / EC-encodes like any client write."""
+        entry = pg.latest_objects().get(name)
+        exists = entry is not None and entry["kind"] != "delete"
+        data = None
+        user_attrs: dict = {}
+        if exists:
+            data = await self._primary_read(pg, acting, name)
+            local = shard_name(
+                name, self._my_shard(pg, acting)
+            )
+            try:
+                blob = self.store.getattrs(pg.coll, local).get("user")
+            except StoreError:
+                blob = None
+            if blob:
+                user_attrs = json.loads(blob)
+        ctx = MethodContext(
+            data=data,
+            user_attrs=user_attrs,
+            version=entry["obj_ver"] if exists else 0,
+        )
+        result = self.cls.call(p["cls"], p["method"], ctx, p.get("input"))
+        if ctx.dirty:
+            await self._primary_write(
+                pg, acting, name,
+                ctx.data if ctx.data is not None else b"",
+                user_attrs=ctx.user_attrs,
+            )
+        return {"result": result}
 
 
 def _attrs_to(attrs: dict | None) -> dict:

@@ -99,6 +99,7 @@ class Objecter(Dispatcher):
         op: str,
         data: bytes | None = None,
         timeout: float = 30.0,
+        extra: dict | None = None,
     ) -> dict:
         deadline = asyncio.get_event_loop().time() + timeout
         last_error = "timed out"
@@ -114,6 +115,8 @@ class Objecter(Dispatcher):
                 continue
             tid = next(self._tids)
             payload = {"tid": tid, "pool": pool_id, "name": name, "op": op}
+            if extra:
+                payload.update(extra)
             if data is not None:
                 payload["data"] = data.hex()
             fut = asyncio.get_event_loop().create_future()
@@ -139,10 +142,16 @@ class Objecter(Dispatcher):
                 # our map was stale; catch up past the OSD's epoch
                 await self._refresh_map()
                 continue
-            if reply.get("errno") == "ENOENT":
+            errno = reply.get("errno")
+            if errno == "ENOENT":
                 raise ObjectNotFound(
                     f"{op} {pool_id}/{name!r}: "
                     + reply.get("error", "no such object")
+                )
+            if errno is not None:
+                # other typed errors (EBUSY, ECANCELED, ...) are final too
+                raise RadosError(
+                    f"{errno}: " + reply.get("error", "op failed")
                 )
             last_error = reply.get("error", "op failed")
             # transient primary-side errors (mid-recovery reads) retry
@@ -171,6 +180,16 @@ class IoCtx:
 
     async def stat(self, name: str) -> dict:
         return await self.objecter.op_submit(self.pool_id, name, "stat")
+
+    async def exec(self, name: str, cls: str, method: str,
+                   inp: dict | None = None) -> dict:
+        """Run an object-class method inside the primary OSD
+        (rados_exec / cls, src/objclass)."""
+        rep = await self.objecter.op_submit(
+            self.pool_id, name, "call",
+            extra={"cls": cls, "method": method, "input": inp or {}},
+        )
+        return rep.get("result", {})
 
 
 class Rados:

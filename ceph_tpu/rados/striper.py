@@ -126,3 +126,63 @@ class Striper:
                     obj_off : obj_off + n
                 ]
         return bytes(out)
+
+
+class RadosStriper:
+    """libradosstriper over a LIVE cluster IoCtx (async twin of Striper).
+
+    The reference's libradosstriper stores the logical size in a
+    `striper.size` xattr on the first object (StriperImpl); plain writes
+    here replace user xattrs, so a tiny `<soid>.striperhdr` object carries
+    it instead — a fresh client can still open striped objects it did not
+    write.
+    """
+
+    def __init__(self, ioctx, layout: StripeLayout | None = None):
+        self.ioctx = ioctx
+        self.layout = layout or StripeLayout()
+
+    async def write(self, soid: str, data: bytes) -> int:
+        extents = file_to_extents(self.layout, 0, len(data))
+        for objectno, runs in sorted(extents.items()):
+            end = max(obj_off + n for obj_off, n, _ in runs)
+            buf = bytearray(end)
+            for obj_off, n, file_off in runs:
+                buf[obj_off: obj_off + n] = data[file_off: file_off + n]
+            await self.ioctx.write_full(
+                object_name(soid, objectno), bytes(buf)
+            )
+        # record the logical size on a header object (first-object xattr
+        # in the reference; a tiny header object here since plain writes
+        # reset user xattrs)
+        await self.ioctx.write_full(
+            f"{soid}.striperhdr", str(len(data)).encode()
+        )
+        return len(extents)
+
+    async def size(self, soid: str) -> int:
+        return int(await self.ioctx.read(f"{soid}.striperhdr"))
+
+    async def read(self, soid: str, offset: int = 0,
+                   length: int | None = None) -> bytes:
+        total = await self.size(soid)
+        if length is None:
+            length = total - offset
+        length = max(0, min(length, total - offset))
+        if length == 0:
+            return b""
+        out = bytearray(length)
+        cache: dict[int, bytes] = {}
+        for objectno, runs in file_to_extents(
+            self.layout, offset, length
+        ).items():
+            if objectno not in cache:
+                cache[objectno] = await self.ioctx.read(
+                    object_name(soid, objectno)
+                )
+            obj = cache[objectno]
+            for obj_off, n, file_off in runs:
+                piece = obj[obj_off: obj_off + n]
+                piece = piece + b"\0" * (n - len(piece))
+                out[file_off - offset: file_off - offset + n] = piece
+        return bytes(out)
