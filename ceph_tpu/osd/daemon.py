@@ -167,6 +167,23 @@ class OSDService(Dispatcher):
         )
         self.pgs: dict[tuple[int, int], PG] = {}
         self.cls = default_handler()  # in-OSD object classes (src/cls)
+        # per-daemon perf counters, dumped via the admin surface the way
+        # `ceph daemon osd.N perf dump` reads the admin socket
+        from ceph_tpu.common.perf_counters import PerfCountersCollection
+
+        self.perf_collection = PerfCountersCollection()
+        self.perf = self.perf_collection.create(self.name)
+        for key, desc in (
+            ("op_w", "client writes served as primary"),
+            ("op_r", "client reads served as primary"),
+            ("op_rw", "client cls calls served as primary"),
+            ("subop_w", "replica/shard sub-writes applied"),
+            ("recovery_pushes", "objects/shards pushed during recovery"),
+            ("recovery_pulls", "objects/shards pulled during peering"),
+            ("scrub_errors", "inconsistencies found by scrub"),
+            ("heartbeat_failures", "peer failures reported to the mon"),
+        ):
+            self.perf.add_u64_counter(key, desc)
         self._codecs: dict[int, object] = {}
         self._tids = iter(range(1, 1 << 62))
         self._waiters: dict[int, asyncio.Future] = {}
@@ -336,6 +353,7 @@ class OSDService(Dispatcher):
                     if silent > grace and peer not in self._reported:
                         self.mon.report_failure(peer)
                         self._reported.add(peer)
+                        self.perf.inc("heartbeat_failures")
             await asyncio.sleep(interval)
 
     async def _h_osd_ping(self, conn, p) -> None:
@@ -445,6 +463,7 @@ class OSDService(Dispatcher):
                 txn.write(pg.coll, want, data, attrs=attrs)
             pg.append_log(txn, e)
             self.store.queue_transaction(txn)
+            self.perf.inc("recovery_pulls")
         _ = ec  # codec warmed for pull path
 
     def _my_shard(self, pg: PG, acting: list[int]) -> int | None:
@@ -543,6 +562,7 @@ class OSDService(Dispatcher):
                          "shard": shard, **payload},
                         timeout=5.0,
                     )
+                    self.perf.inc("recovery_pushes")
                 except (asyncio.TimeoutError, RuntimeError):
                     break  # next epoch retries this member
 
@@ -658,6 +678,7 @@ class OSDService(Dispatcher):
                     )
                 pg.append_log(txn, e)
                 self.store.queue_transaction(txn)
+                self.perf.inc("subop_w")
         self._reply_peer(conn, p["tid"], {"ok": True})
 
     async def _h_ec_sub_write(self, conn, p) -> None:
@@ -680,6 +701,7 @@ class OSDService(Dispatcher):
                     )
                 pg.append_log(txn, e)
                 self.store.queue_transaction(txn)
+                self.perf.inc("subop_w")
         self._reply_peer(conn, p["tid"], {"ok": True})
 
     def _pg_of(self, pgid) -> PG:
@@ -721,6 +743,7 @@ class OSDService(Dispatcher):
                     await self._primary_write(
                         pg, acting, name, bytes.fromhex(p["data"])
                     )
+                self.perf.inc("op_w")
                 result = {}
             elif p["op"] == "delete":
                 async with pg.lock:
@@ -732,11 +755,13 @@ class OSDService(Dispatcher):
                         await self._primary_read(pg, acting, name)
                     ).hex()
                 }
+                self.perf.inc("op_r")
             elif p["op"] == "stat":
                 result = self._primary_stat(pg, name)
             elif p["op"] == "call":
                 async with pg.lock:
                     result = await self._primary_call(pg, acting, name, p)
+                self.perf.inc("op_rw")
             else:
                 raise RuntimeError(f"unknown op {p['op']!r}")
             reply = {"tid": p["tid"], "ok": True, **result}
@@ -967,6 +992,236 @@ class OSDService(Dispatcher):
                 user_attrs=ctx.user_attrs,
             )
         return {"result": result}
+
+
+    # -- admin surface + scrub (admin_socket / `ceph daemon` analogue) --------
+
+    async def _h_osd_admin(self, conn, p) -> None:
+        """Daemon admin commands over the wire — the role the per-daemon
+        unix admin socket plays for `ceph daemon osd.N <cmd>`."""
+        try:
+            cmd = p["cmd"]
+            if cmd == "perf dump":
+                result = self.perf_collection.dump()
+            elif cmd == "status":
+                result = {
+                    "osd": self.id,
+                    "epoch": self.osdmap.epoch if self.osdmap else 0,
+                    "num_pgs": len(self.pgs),
+                    "active_pgs": sum(
+                        1 for pg in self.pgs.values() if pg.active
+                    ),
+                    "collections": len(self.store.list_collections()),
+                }
+            elif cmd == "scrub":
+                result = await self._scrub(
+                    p["pool"], deep=p.get("deep", False)
+                )
+            elif cmd == "repair":
+                result = await self._repair(p["pool"])
+            else:
+                raise RuntimeError(f"unknown admin command {cmd!r}")
+            reply = {"tid": p["tid"], "ok": True, "result": result}
+        except Exception as e:
+            reply = {"tid": p["tid"], "ok": False, "error": str(e)}
+        conn.send_message(
+            Message(type="osd_admin_reply", tid=p["tid"],
+                    data=json.dumps(reply).encode())
+        )
+
+    async def _scrub_fetch(self, pg, sname: str, osd: int):
+        """One copy's (data, attrs) or an error string."""
+        if osd == self.id:
+            try:
+                return (
+                    self.store.read(pg.coll, sname),
+                    self.store.getattrs(pg.coll, sname),
+                )
+            except StoreError:
+                return "missing"
+        try:
+            rep = await self._peer_call(
+                osd, "obj_read", {"coll": pg.coll, "name": sname},
+                timeout=2.0,
+            )
+        except (asyncio.TimeoutError, RuntimeError):
+            return "unreachable"
+        if not rep.get("ok"):
+            return "missing"
+        return bytes.fromhex(rep["data"]), _attrs_from(rep)
+
+    async def _scrub(self, pool_id: int, deep: bool) -> dict:
+        """Primary-driven consistency check over this OSD's primary PGs in
+        `pool_id` (PGBackend::be_scan_list shallow; deep re-reads every
+        copy/shard: EC shards verify crc32c against the stored HashInfo
+        (ECBackend::be_deep_scrub, ECBackend.cc:2461), replicated copies
+        compare data digests and flag the minority, like
+        be_select_auth_object's majority rule)."""
+        from ceph_tpu.common.crc import ceph_crc32c
+
+        errors: list[dict] = []
+        ec = self.codec(pool_id)
+        for (pid, ps), pg in sorted(self.pgs.items()):
+            if pid != pool_id or not pg.active:
+                continue
+            acting, primary = self.acting_of(pid, ps)
+            if primary != self.id:
+                continue
+            for name, entry in sorted(pg.latest_objects().items()):
+                if entry["kind"] == "delete":
+                    continue
+                copies: dict[int, tuple] = {}  # pos -> (data, attrs)
+                for pos, osd in enumerate(acting):
+                    if osd == _NONE or self.osdmap.is_down(osd):
+                        continue
+                    shard = pos if ec is not None else None
+                    got = await self._scrub_fetch(
+                        pg, shard_name(name, shard), osd
+                    )
+                    if isinstance(got, str):
+                        errors.append(
+                            {"pg": [pid, ps], "name": name,
+                             "shard": shard, "osd": osd, "error": got}
+                        )
+                        continue
+                    data, attrs = got
+                    if attrs.get("ver") != entry["obj_ver"]:
+                        errors.append(
+                            {"pg": [pid, ps], "name": name,
+                             "shard": shard, "osd": osd,
+                             "error": "stale"}
+                        )
+                        continue
+                    copies[pos] = (data, attrs)
+                if not deep:
+                    continue
+                if ec is not None:
+                    for pos, (data, attrs) in sorted(copies.items()):
+                        hinfo = attrs.get("hinfo")
+                        err = None
+                        if hinfo is None:
+                            err = "hinfo_missing"
+                        elif ceph_crc32c(
+                            0xFFFFFFFF, data
+                        ) != hinfo.get_chunk_hash(pos):
+                            err = "digest_mismatch"
+                        if err:
+                            errors.append(
+                                {"pg": [pid, ps], "name": name,
+                                 "shard": pos, "osd": acting[pos],
+                                 "error": err}
+                            )
+                elif len(copies) > 1:
+                    digests = {
+                        pos: ceph_crc32c(0xFFFFFFFF, d)
+                        for pos, (d, _a) in copies.items()
+                    }
+                    counts: dict[int, int] = {}
+                    for dg in digests.values():
+                        counts[dg] = counts.get(dg, 0) + 1
+                    best = max(counts.values())
+                    majority = {
+                        dg for dg, c in counts.items() if c == best
+                    }
+                    auth = next(
+                        dg for pos, dg in sorted(digests.items())
+                        if dg in majority
+                    )
+                    for pos, dg in sorted(digests.items()):
+                        if dg != auth:
+                            errors.append(
+                                {"pg": [pid, ps], "name": name,
+                                 "shard": None, "osd": acting[pos],
+                                 "error": "digest_mismatch"}
+                            )
+        self.perf.inc("scrub_errors", len(errors))
+        return {"errors": errors}
+
+    async def _repair(self, pool_id: int) -> dict:
+        """Deep-scrub, then overwrite every inconsistent copy with content
+        rebuilt from VERIFIED sources only (the `ceph pg repair` flow): EC
+        shards decode from hinfo-checked survivors, replicated copies pull
+        from a digest-majority member — never from the copy being
+        repaired."""
+        from ceph_tpu.common.crc import ceph_crc32c
+
+        report = await self._scrub(pool_id, deep=True)
+        ec = self.codec(pool_id)
+        repaired = 0
+        for err in report["errors"]:
+            pid, ps = err["pg"]
+            pg = self.pgs[(pid, ps)]
+            acting, _ = self.acting_of(pid, ps)
+            entry = pg.latest_objects().get(err["name"])
+            if entry is None:
+                continue
+            shard = err["shard"]
+            bad_osd = err["osd"]
+            # gather verified sources, excluding the copy under repair
+            chunks: dict[int, bytes] = {}
+            attrs = None
+            data = None
+            for pos, osd in enumerate(acting):
+                if osd in (_NONE, bad_osd) or self.osdmap.is_down(osd):
+                    continue
+                spos = pos if ec is not None else None
+                got = await self._scrub_fetch(
+                    pg, shard_name(err["name"], spos), osd
+                )
+                if isinstance(got, str):
+                    continue
+                d, a = got
+                if a.get("ver") != entry["obj_ver"]:
+                    continue
+                if ec is not None:
+                    hinfo = a.get("hinfo")
+                    if hinfo is None or ceph_crc32c(
+                        0xFFFFFFFF, d
+                    ) != hinfo.get_chunk_hash(pos):
+                        continue  # never decode from an unverified shard
+                    chunks[pos] = d
+                    attrs = attrs or a
+                    if len(chunks) >= ec.get_data_chunk_count():
+                        break
+                else:
+                    chunks[pos] = d
+                    attrs = attrs or a
+            if ec is not None:
+                if len(chunks) < ec.get_data_chunk_count():
+                    continue
+                data = ec.decode({shard}, chunks)[shard]
+            elif chunks:
+                # replicated: the digest-majority copy wins (ties -> the
+                # lowest acting position, like be_select_auth_object)
+                counts: dict[bytes, int] = {}
+                for d in chunks.values():
+                    counts[d] = counts.get(d, 0) + 1
+                best = max(counts.values())
+                data = next(
+                    d for _pos, d in sorted(chunks.items())
+                    if counts[d] == best
+                )
+            if data is None or attrs is None:
+                continue
+            try:
+                if bad_osd == self.id:
+                    txn = Transaction().write(
+                        pg.coll, shard_name(err["name"], shard), data,
+                        attrs=attrs,
+                    )
+                    self.store.queue_transaction(txn)
+                else:
+                    await self._peer_call(
+                        bad_osd, "obj_push",
+                        {"pgid": [pid, ps], "shard": shard,
+                         "entry": entry, "data": data.hex(),
+                         "attrs": _attrs_to(attrs)},
+                        timeout=5.0,
+                    )
+                repaired += 1
+            except (asyncio.TimeoutError, RuntimeError):
+                continue
+        return {"repaired": repaired, "errors": report["errors"]}
 
 
 def _attrs_to(attrs: dict | None) -> dict:

@@ -64,11 +64,38 @@ class Objecter(Dispatcher):
         return self.mon.osdmap
 
     async def ms_dispatch(self, conn, msg: Message) -> None:
-        if msg.type == "osd_op_reply":
+        if msg.type in ("osd_op_reply", "osd_admin_reply"):
             p = json.loads(msg.data)
             fut = self._waiters.get(p.get("tid"))
             if fut is not None and not fut.done():
                 fut.set_result(p)
+
+    async def osd_admin(
+        self, osd: int, cmd: str, args: dict | None = None,
+        timeout: float = 30.0,
+    ) -> dict:
+        """Admin command straight to one daemon (`ceph daemon osd.N cmd` —
+        the admin-socket role over the messenger)."""
+        addr = self.osdmap.osd_addrs.get(osd)
+        if addr is None:
+            raise RadosError(f"no address for osd.{osd}")
+        tid = next(self._tids)
+        payload = {"tid": tid, "cmd": cmd, **(args or {})}
+        fut = asyncio.get_event_loop().create_future()
+        self._waiters[tid] = fut
+        try:
+            self.messenger.connect(
+                tuple(addr), Policy.lossless_client()
+            ).send_message(
+                Message(type="osd_admin", tid=tid,
+                        data=json.dumps(payload).encode())
+            )
+            reply = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._waiters.pop(tid, None)
+        if not reply.get("ok"):
+            raise RadosError(reply.get("error", "admin command failed"))
+        return reply.get("result", {})
 
     # -- targeting ------------------------------------------------------------
 
