@@ -189,6 +189,9 @@ class OSDService(Dispatcher):
         self._waiters: dict[int, asyncio.Future] = {}
         self._hb_last: dict[int, float] = {}
         self._reported: set[int] = set()
+        #: (pool, ps, name) -> [(conn, watcher, cookie)] watch sessions
+        self._watchers: dict[tuple, list] = {}
+        self._notify_waiters: dict[tuple, asyncio.Future] = {}
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
         self.mon.on_map_change(self._note_map)
@@ -762,6 +765,20 @@ class OSDService(Dispatcher):
                 async with pg.lock:
                     result = await self._primary_call(pg, acting, name, p)
                 self.perf.inc("op_rw")
+            elif p["op"] == "watch":
+                result = await self._h_op_watch(pg, conn, p)
+            elif p["op"] == "unwatch":
+                result = await self._h_op_unwatch(pg, conn, p)
+            elif p["op"] == "notify":
+                # replied by a task: waiting for acks inline would wedge
+                # this conn's dispatch loop, and the notifier may well be
+                # one of the watchers being notified on this very conn
+                self._tasks.append(
+                    asyncio.create_task(
+                        self._notify_and_reply(pg, conn, p)
+                    )
+                )
+                return
             else:
                 raise RuntimeError(f"unknown op {p['op']!r}")
             reply = {"tid": p["tid"], "ok": True, **result}
@@ -993,6 +1010,90 @@ class OSDService(Dispatcher):
             )
         return {"result": result}
 
+
+    # -- watch / notify (PrimaryLogPG watch/notify, src/osd/Watch.cc) ---------
+    #
+    # Watchers register on an object at its acting primary; a notify fans
+    # the payload to every watcher and completes when all have acked (or
+    # the per-notify timeout lapses), returning who acked — the librados
+    # coordination primitive rbd's exclusive lock rides. Watches are
+    # sessions on THIS primary: a new primary (or a restarted one) starts
+    # with no watchers and clients must re-watch, matching the reference's
+    # watch timeout + reconnect contract.
+
+    async def _h_op_watch(self, pg, conn, p) -> dict:
+        key = (pg.pool, pg.ps, p["name"])
+        entry = (conn, p.get("watcher", conn.peer_name), p.get("cookie", ""))
+        watchers = self._watchers.setdefault(key, [])
+        if not any(
+            w[1] == entry[1] and w[2] == entry[2] for w in watchers
+        ):
+            watchers.append(entry)
+        return {}
+
+    async def _h_op_unwatch(self, pg, conn, p) -> dict:
+        key = (pg.pool, pg.ps, p["name"])
+        me = (p.get("watcher", conn.peer_name), p.get("cookie", ""))
+        self._watchers[key] = [
+            w for w in self._watchers.get(key, [])
+            if (w[1], w[2]) != me
+        ]
+        return {}
+
+    async def _h_op_notify(self, pg, conn, p) -> dict:
+        key = (pg.pool, pg.ps, p["name"])
+        notify_id = next(self._tids)
+        waits = {}
+        for wconn, wname, cookie in list(self._watchers.get(key, [])):
+            if not wconn.is_connected:
+                continue
+            fut = asyncio.get_event_loop().create_future()
+            self._notify_waiters[(notify_id, wname, cookie)] = fut
+            waits[(wname, cookie)] = fut
+            wconn.send_message(
+                Message(
+                    type="watch_notify",
+                    data=json.dumps(
+                        {"pool": pg.pool, "name": p["name"],
+                         "notify_id": notify_id,
+                         "cookie": cookie,
+                         "payload": p.get("payload", "")}
+                    ).encode(),
+                )
+            )
+        timeout = p.get("timeout", 5.0)
+        acked, missed = [], []
+        for (wname, cookie), fut in waits.items():
+            try:
+                await asyncio.wait_for(fut, timeout)
+                acked.append({"watcher": wname, "cookie": cookie})
+            except asyncio.TimeoutError:
+                missed.append({"watcher": wname, "cookie": cookie})
+            finally:
+                self._notify_waiters.pop(
+                    (notify_id, wname, cookie), None
+                )
+        return {"acked": acked, "missed": missed}
+
+    async def _notify_and_reply(self, pg, conn, p) -> None:
+        try:
+            result = await self._h_op_notify(pg, conn, p)
+            reply = {"tid": p["tid"], "ok": True, **result}
+        except Exception as e:
+            reply = {"tid": p["tid"], "ok": False, "error": str(e)}
+        conn.send_message(
+            Message(type="osd_op_reply", tid=p["tid"],
+                    epoch=self.osdmap.epoch,
+                    data=json.dumps(reply).encode())
+        )
+
+    async def _h_notify_ack(self, conn, p) -> None:
+        fut = self._notify_waiters.get(
+            (p["notify_id"], p.get("watcher", conn.peer_name),
+             p.get("cookie", ""))
+        )
+        if fut is not None and not fut.done():
+            fut.set_result(None)
 
     # -- admin surface + scrub (admin_socket / `ceph daemon` analogue) --------
 

@@ -51,6 +51,8 @@ class Objecter(Dispatcher):
         )
         self._tids = itertools.count(1)
         self._waiters: dict[int, asyncio.Future] = {}
+        #: (pool, name, cookie) -> callback(name, payload)
+        self._watches: dict[tuple, object] = {}
 
     async def start(self) -> None:
         self.mon.subscribe()
@@ -69,6 +71,25 @@ class Objecter(Dispatcher):
             fut = self._waiters.get(p.get("tid"))
             if fut is not None and not fut.done():
                 fut.set_result(p)
+        elif msg.type == "watch_notify":
+            p = json.loads(msg.data)
+            cb = self._watches.get(
+                (p["pool"], p["name"], p.get("cookie", ""))
+            )
+            if cb is not None:
+                try:
+                    cb(p["name"], p.get("payload", ""))
+                finally:
+                    conn.send_message(
+                        Message(
+                            type="notify_ack",
+                            data=json.dumps(
+                                {"notify_id": p["notify_id"],
+                                 "watcher": self.name,
+                                 "cookie": p.get("cookie", "")}
+                            ).encode(),
+                        )
+                    )
 
     async def osd_admin(
         self, osd: int, cmd: str, args: dict | None = None,
@@ -217,6 +238,33 @@ class IoCtx:
             extra={"cls": cls, "method": method, "input": inp or {}},
         )
         return rep.get("result", {})
+
+    async def watch(self, name: str, callback, cookie: str = "") -> None:
+        """Register `callback(name, payload)` for notifies on the object
+        (rados_watch). Watches live on the current primary: re-watch after
+        a primary change, as the reference's watch/reconnect contract
+        requires."""
+        self.objecter._watches[(self.pool_id, name, cookie)] = callback
+        await self.objecter.op_submit(
+            self.pool_id, name, "watch",
+            extra={"watcher": self.objecter.name, "cookie": cookie},
+        )
+
+    async def unwatch(self, name: str, cookie: str = "") -> None:
+        self.objecter._watches.pop((self.pool_id, name, cookie), None)
+        await self.objecter.op_submit(
+            self.pool_id, name, "unwatch",
+            extra={"watcher": self.objecter.name, "cookie": cookie},
+        )
+
+    async def notify(self, name: str, payload: str = "",
+                     timeout: float = 5.0) -> dict:
+        """Notify every watcher; resolves with who acked and who timed out
+        (rados_notify2)."""
+        return await self.objecter.op_submit(
+            self.pool_id, name, "notify",
+            extra={"payload": payload, "timeout": timeout},
+        )
 
 
 class Rados:

@@ -122,3 +122,50 @@ def test_rados_striper_round_trip():
         await cluster.stop()
 
     run(main())
+
+
+def test_watch_notify():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        cfg = cluster.cfg
+        r1 = Rados("client.w1", cluster.monmap, config=cfg)
+        r2 = Rados("client.w2", cluster.monmap, config=cfg)
+        r3 = Rados("client.w3", cluster.monmap, config=cfg)
+        for r in (r1, r2, r3):
+            await r.connect()
+        await cluster.create_pools(r1)
+        io1, io2, io3 = (r.io_ctx(REP_POOL) for r in (r1, r2, r3))
+
+        await io1.write_full("hdr", b"x")
+        seen1, seen2 = [], []
+        await io1.watch("hdr", lambda n, p: seen1.append((n, p)))
+        await io2.watch("hdr", lambda n, p: seen2.append((n, p)))
+
+        # a third client notifies; both watchers see it and ack
+        rep = await io3.notify("hdr", "claim!")
+        assert {a["watcher"] for a in rep["acked"]} == {
+            "client.w1", "client.w2"
+        }
+        assert rep["missed"] == []
+        assert seen1 == [("hdr", "claim!")]
+        assert seen2 == [("hdr", "claim!")]
+
+        # a watcher notifying also hears itself (no self-deadlock)
+        rep = await io1.notify("hdr", "again")
+        assert {a["watcher"] for a in rep["acked"]} == {
+            "client.w1", "client.w2"
+        }
+        assert seen1[-1] == ("hdr", "again")
+
+        # unwatch drops delivery; a dead watcher times out as missed
+        await io2.unwatch("hdr")
+        rep = await io1.notify("hdr", "final")
+        assert {a["watcher"] for a in rep["acked"]} == {"client.w1"}
+        assert len(seen2) == 2  # no further deliveries
+
+        for r in (r1, r2, r3):
+            await r.shutdown()
+        await cluster.stop()
+
+    run(main())
