@@ -192,6 +192,10 @@ class OSDService(Dispatcher):
         #: (pool, ps, name) -> [(conn, watcher, cookie)] watch sessions
         self._watchers: dict[tuple, list] = {}
         self._notify_waiters: dict[tuple, asyncio.Future] = {}
+        # per-op event timeline ("slow request" reporting, TrackedOp.h)
+        from ceph_tpu.common.admin import OpTracker
+
+        self.op_tracker = OpTracker()
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
         self.mon.on_map_change(self._note_map)
@@ -718,11 +722,19 @@ class OSDService(Dispatcher):
     async def _h_osd_op(self, conn, p) -> None:
         pool_id = p["pool"]
         name = p["name"]
+        with self.op_tracker.track(
+            f"osd_op({p.get('op')} {pool_id}/{name} "
+            f"from {conn.peer_name})"
+        ) as tracked:
+            await self._do_osd_op(conn, p, pool_id, name, tracked)
+
+    async def _do_osd_op(self, conn, p, pool_id, name, tracked) -> None:
         try:
             if pool_id not in self.osdmap.pools:
                 raise RuntimeError(f"no pool {pool_id}")
             ps = self.object_pg(pool_id, name)
             acting, primary = self.acting_of(pool_id, ps)
+            tracked.mark_event("placed")
             if primary != self.id:
                 conn.send_message(
                     Message(
@@ -1114,6 +1126,10 @@ class OSDService(Dispatcher):
                     ),
                     "collections": len(self.store.list_collections()),
                 }
+            elif cmd == "dump_ops_in_flight":
+                result = self.op_tracker.dump_ops_in_flight()
+            elif cmd == "dump_historic_ops":
+                result = self.op_tracker.dump_historic_ops()
             elif cmd == "scrub":
                 result = await self._scrub(
                     p["pool"], deep=p.get("deep", False)

@@ -115,3 +115,31 @@ def test_scrub_finds_and_repair_fixes_corruption():
         await cluster.stop()
 
     run(main())
+
+
+def test_op_tracker_visible_via_admin():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        rados = Rados("client.trk", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        rep = rados.io_ctx(REP_POOL)
+        await rep.write_full("tracked", b"t" * 100)
+        await rep.read("tracked")
+        posd, _, _ = await primary_of(rados, cluster, REP_POOL, "tracked")
+        hist = await rados.objecter.osd_admin(posd.id, "dump_historic_ops")
+        descs = [o["description"] for o in hist["ops"]]
+        assert any("write" in d and "tracked" in d for d in descs)
+        assert any("read" in d and "tracked" in d for d in descs)
+        # event timeline recorded per op
+        op = next(o for o in hist["ops"] if "write" in o["description"])
+        assert any(ev["event"] == "placed" for ev in op["events"])
+        inflight = await rados.objecter.osd_admin(
+            posd.id, "dump_ops_in_flight"
+        )
+        assert inflight["num_slow_ops"] == 0
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
