@@ -1,0 +1,15 @@
+"""mgr: the manager layer (L8) — cluster-wide optimization modules.
+
+The reference's ceph-mgr hosts python modules over the live maps; the one
+that matters for placement is the balancer (src/pybind/mgr/balancer/
+module.py: do_upmap at 902 -> osdmap.calc_pg_upmaps). Here `BalancerModule`
+plays that role against a live cluster: pull the committed OSDMap from the
+mon, run the upmap optimization on the batched TPU mapper
+(OSDMap.calc_pg_upmaps — whole-pool placement in a handful of device
+launches), and commit the resulting pg_upmap_items through the mon's
+command path so every daemon and client re-targets on the next epoch.
+"""
+
+from ceph_tpu.mgr.balancer import BalancerModule
+
+__all__ = ["BalancerModule"]

@@ -1,0 +1,54 @@
+"""BalancerModule: upmap balancing over a live cluster.
+
+The loop the reference's balancer module runs (pybind/mgr/balancer):
+
+  1. fetch the latest committed OSDMap from the mon (MgrStandby's map
+     subscription);
+  2. optimize: OSDMap.calc_pg_upmaps on a local copy — here the batched
+     TPU mapper computes whole-pool placements per device launch;
+  3. execute: commit the new pg_upmap_items via mon commands
+     (`ceph osd pg-upmap-items` per PG; module.py:execute), after which the
+     next map epoch re-routes the moved PGs and primaries re-peer.
+
+`run_once` does one optimize+execute pass and returns what moved.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.osd.osdmap import OSDMap
+
+
+class BalancerModule:
+    def __init__(self, mon_client):
+        self.mon = mon_client
+
+    async def run_once(
+        self,
+        pools: set[int] | None = None,
+        max_deviation: float = 1.0,
+        max_changes: int = 10,
+    ) -> dict:
+        """One balancer pass; returns {changes, mappings} as committed."""
+        osdmap = await self.mon.wait_for_map()
+        # optimize on a scratch copy: the real map only changes when the
+        # mon commits (balancer module works on an OSDMap::Incremental)
+        scratch = OSDMap.decode(osdmap.encode())
+        before = dict(scratch.pg_upmap_items)
+        changes = scratch.calc_pg_upmaps(
+            max_deviation=max_deviation,
+            max_changes=max_changes,
+            pools=pools,
+        )
+        if not changes:
+            return {"changes": 0, "mappings": {}}
+        mappings: dict[str, list] = {}
+        for pg, items in scratch.pg_upmap_items.items():
+            if before.get(pg) != items:
+                mappings[f"{pg[0]}.{pg[1]}"] = [list(p) for p in items]
+        for pg in before:
+            if pg not in scratch.pg_upmap_items:
+                mappings[f"{pg[0]}.{pg[1]}"] = []
+        result = await self.mon.command(
+            "osd pg-upmap-items", {"mappings": mappings}
+        )
+        return {"changes": changes, "mappings": mappings, **result}

@@ -833,6 +833,26 @@ class Monitor(Dispatcher):
                             new_crush_text=args["crush_text"])
             )
             return {}
+        if cmd == "osd pg-upmap-items":
+            # balancer-committed placement overrides (OSDMonitor's
+            # osd pg-upmap-items command); mappings: {"pool.ps": [[f,t],..]}
+            new_items = {}
+            old_items = []
+            for pgid, pairs in args["mappings"].items():
+                pool_s, ps_s = pgid.split(".")
+                pg = (int(pool_s), int(ps_s))
+                if pairs:
+                    new_items[pg] = [tuple(p) for p in pairs]
+                else:
+                    old_items.append(pg)
+            await self._propose_osdmap(
+                Incremental(
+                    epoch=self.osdmap.epoch + 1,
+                    new_pg_upmap_items=new_items,
+                    old_pg_upmap_items=old_items,
+                )
+            )
+            return {"applied": len(new_items), "removed": len(old_items)}
         if cmd == "status":
             return {
                 "epoch": self.osdmap.epoch,
