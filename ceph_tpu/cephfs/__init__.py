@@ -1,0 +1,24 @@
+"""cephfs: the POSIX-ish file layer (L9, fs-lite).
+
+The reference's CephFS is a metadata SERVER (src/mds, 84k LoC: its own
+journal, distributed locks, dirfrag trees) with clients doing capability
+leases. The mini equivalent keeps the storage layout and the atomicity
+boundary while the MDS's serialization job is done by cls methods running
+at each directory object's primary OSD:
+
+  * every directory is a RADOS object ("dir.<ino>") whose entry map is
+    mutated only by the `fs_dir` object class (link/unlink are
+    atomic-per-directory, like an MDS dirfrag update);
+  * inode numbers come from an `fs_ino` allocator class on a table object
+    (the inotable's role);
+  * file content is striped over data objects via RadosStriper
+    ("ino.<n>" + striper header), the same file->objects layout idea as
+    the reference's file_layout_t.
+
+`FileSystem` walks paths from the root inode and exposes
+mkdir/listdir/create/write/read/unlink/rmdir/rename/stat.
+"""
+
+from ceph_tpu.cephfs.fs import FileSystem, FsError
+
+__all__ = ["FileSystem", "FsError"]

@@ -1,0 +1,19 @@
+"""rgw: the object-storage gateway (L9, rgw-lite).
+
+The reference's RGW (src/rgw, ~150k LoC) serves S3/Swift on top of RADOS;
+its load-bearing storage idea is the bucket index: a RADOS object whose
+omap, updated by cls methods INSIDE the OSD (src/cls/rgw/cls_rgw.cc), maps
+object keys to metadata — so index updates are atomic with respect to
+concurrent writers and listing is a server-side range scan, not a pool
+enumeration.
+
+The mini gateway keeps exactly that shape: `ObjectGateway` stores object
+data as RADOS objects and maintains a per-bucket index through a registered
+`rgw_index` object class (insert/remove/list with marker pagination), with
+ETags (crc32c of content, hex) computed at put. No HTTP frontend — the
+surface is the API the frontends would call.
+"""
+
+from ceph_tpu.rgw.gateway import ObjectGateway, register_rgw_classes
+
+__all__ = ["ObjectGateway", "register_rgw_classes"]

@@ -1,0 +1,165 @@
+"""ObjectGateway: buckets + keyed objects with a cls-maintained index.
+
+Layout (mirroring RGW's bucket-index design, src/cls/rgw/cls_rgw.cc):
+
+  ".bucket.index.<bucket>"   index object; entries live in its content as
+                             a sorted json map key -> {size, etag, mtime}
+                             mutated ONLY by rgw_index cls methods, so
+                             concurrent gateways update it atomically
+  "<bucket>/<key>"           the object data
+
+List is served by the index class with (prefix, marker, max) pagination —
+`list_objects` never enumerates the pool, exactly why RGW keeps an index.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.common.crc import ceph_crc32c
+from ceph_tpu.osd.cls import RD, WR, ClsError
+from ceph_tpu.rados.client import ObjectNotFound, RadosError
+
+
+# -- the rgw_index object class (runs inside the primary OSD) -----------------
+
+def _load_index(ctx) -> dict:
+    return json.loads(ctx.read().decode()) if ctx.exists() else {}
+
+
+def _store_index(ctx, index: dict) -> None:
+    ctx.write(json.dumps(index, sort_keys=True).encode())
+
+
+def _index_insert(ctx, inp):
+    index = _load_index(ctx)
+    index[inp["key"]] = inp["meta"]
+    _store_index(ctx, index)
+    return {"count": len(index)}
+
+
+def _index_remove(ctx, inp):
+    index = _load_index(ctx)
+    if inp["key"] not in index:
+        raise ClsError("ENOENT", f"no index entry {inp['key']!r}")
+    del index[inp["key"]]
+    _store_index(ctx, index)
+    return {"count": len(index)}
+
+
+def _index_list(ctx, inp):
+    """(prefix, marker, max_entries) pagination (cls_rgw list_op)."""
+    index = _load_index(ctx)
+    prefix = inp.get("prefix", "")
+    marker = inp.get("marker", "")
+    max_entries = int(inp.get("max_entries", 1000))
+    keys = sorted(
+        k for k in index if k.startswith(prefix) and k > marker
+    )
+    page = keys[:max_entries]
+    return {
+        "entries": {k: index[k] for k in page},
+        "truncated": len(keys) > len(page),
+        "next_marker": page[-1] if page else marker,
+    }
+
+
+def _index_stat(ctx, inp):
+    index = _load_index(ctx)
+    return {"count": len(index)}
+
+
+def register_rgw_classes(osd_service) -> None:
+    """Install the rgw_index class on a daemon (its __cls_init analogue)."""
+    h = osd_service.cls
+    h.register("rgw_index", "insert", RD | WR, _index_insert)
+    h.register("rgw_index", "remove", RD | WR, _index_remove)
+    h.register("rgw_index", "list", RD, _index_list)
+    h.register("rgw_index", "stat", RD, _index_stat)
+
+
+# -- the gateway --------------------------------------------------------------
+
+class GatewayError(RadosError):
+    pass
+
+
+class ObjectGateway:
+    def __init__(self, ioctx):
+        self.ioctx = ioctx
+
+    @staticmethod
+    def _index_obj(bucket: str) -> str:
+        return f".bucket.index.{bucket}"
+
+    @staticmethod
+    def _data_obj(bucket: str, key: str) -> str:
+        return f"{bucket}/{key}"
+
+    async def create_bucket(self, bucket: str) -> None:
+        try:
+            await self.ioctx.stat(self._index_obj(bucket))
+            raise GatewayError(f"bucket {bucket!r} exists")
+        except ObjectNotFound:
+            pass
+        await self.ioctx.write_full(self._index_obj(bucket), b"{}")
+
+    async def bucket_exists(self, bucket: str) -> bool:
+        try:
+            await self.ioctx.stat(self._index_obj(bucket))
+            return True
+        except ObjectNotFound:
+            return False
+
+    async def put_object(self, bucket: str, key: str, data: bytes) -> str:
+        """Store data, then index it atomically server-side; returns the
+        ETag."""
+        if not await self.bucket_exists(bucket):
+            raise GatewayError(f"no bucket {bucket!r}")
+        etag = f"{ceph_crc32c(0xFFFFFFFF, data):08x}"
+        await self.ioctx.write_full(self._data_obj(bucket, key), data)
+        await self.ioctx.exec(
+            self._index_obj(bucket), "rgw_index", "insert",
+            {"key": key, "meta": {"size": len(data), "etag": etag}},
+        )
+        return etag
+
+    async def get_object(self, bucket: str, key: str) -> bytes:
+        return await self.ioctx.read(self._data_obj(bucket, key))
+
+    async def head_object(self, bucket: str, key: str) -> dict:
+        listing = await self.ioctx.exec(
+            self._index_obj(bucket), "rgw_index", "list",
+            {"prefix": key, "max_entries": 1},
+        )
+        meta = listing["entries"].get(key)
+        if meta is None:
+            raise ObjectNotFound(f"{bucket}/{key}")
+        return meta
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        await self.ioctx.exec(
+            self._index_obj(bucket), "rgw_index", "remove", {"key": key}
+        )
+        await self.ioctx.remove(self._data_obj(bucket, key))
+
+    async def list_objects(
+        self,
+        bucket: str,
+        prefix: str = "",
+        marker: str = "",
+        max_entries: int = 1000,
+    ) -> dict:
+        return await self.ioctx.exec(
+            self._index_obj(bucket), "rgw_index", "list",
+            {"prefix": prefix, "marker": marker,
+             "max_entries": max_entries},
+        )
+
+    async def delete_bucket(self, bucket: str) -> None:
+        stat = await self.ioctx.exec(
+            self._index_obj(bucket), "rgw_index", "stat", {}
+        )
+        if stat["count"]:
+            raise GatewayError(f"bucket {bucket!r} not empty")
+        await self.ioctx.remove(self._index_obj(bucket))

@@ -1,0 +1,161 @@
+"""rgw-lite + fs-lite over the live cluster: bucket index semantics with
+pagination, and a POSIX-ish namespace with striped file content — both on
+cls-driven atomic metadata at the primaries."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cephfs import FileSystem, FsError
+from ceph_tpu.cephfs.fs import register_fs_classes
+from ceph_tpu.rados.client import ObjectNotFound, Rados, RadosError
+from ceph_tpu.rgw import ObjectGateway, register_rgw_classes
+from ceph_tpu.rgw.gateway import GatewayError
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 120))
+
+
+def test_object_gateway_bucket_semantics():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        for osd in cluster.osds.values():
+            register_rgw_classes(osd)
+        rados = Rados("client.rgw", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        gw = ObjectGateway(rados.io_ctx(EC_POOL))
+
+        await gw.create_bucket("photos")
+        with pytest.raises(GatewayError, match="exists"):
+            await gw.create_bucket("photos")
+        with pytest.raises(GatewayError, match="no bucket"):
+            await gw.put_object("nope", "k", b"x")
+
+        payloads = {
+            f"2024/{i:02d}.jpg": bytes([i]) * (100 + i) for i in range(7)
+        }
+        payloads["2025/01.jpg"] = b"newyear"
+        etags = {}
+        for key, data in payloads.items():
+            etags[key] = await gw.put_object("photos", key, data)
+
+        for key, data in payloads.items():
+            assert await gw.get_object("photos", key) == data
+            head = await gw.head_object("photos", key)
+            assert head["size"] == len(data)
+            assert head["etag"] == etags[key]
+
+        # prefix listing with pagination (marker/truncated)
+        page1 = await gw.list_objects("photos", prefix="2024/",
+                                      max_entries=3)
+        assert len(page1["entries"]) == 3 and page1["truncated"]
+        page2 = await gw.list_objects(
+            "photos", prefix="2024/", marker=page1["next_marker"],
+            max_entries=10,
+        )
+        assert len(page2["entries"]) == 4 and not page2["truncated"]
+        assert set(page1["entries"]) | set(page2["entries"]) == {
+            k for k in payloads if k.startswith("2024/")
+        }
+
+        # delete maintains the index; bucket deletion requires empty
+        with pytest.raises(GatewayError, match="not empty"):
+            await gw.delete_bucket("photos")
+        for key in payloads:
+            await gw.delete_object("photos", key)
+        with pytest.raises(ObjectNotFound):
+            await gw.get_object("photos", "2025/01.jpg")
+        assert (await gw.list_objects("photos"))["entries"] == {}
+        await gw.delete_bucket("photos")
+        assert not await gw.bucket_exists("photos")
+
+        # concurrent puts from two gateways: the cls index never loses one
+        await gw.create_bucket("race")
+        rados2 = Rados("client.rgw2", cluster.monmap, config=cluster.cfg)
+        await rados2.connect()
+        gw2 = ObjectGateway(rados2.io_ctx(EC_POOL))
+        await asyncio.gather(
+            *(gw.put_object("race", f"a{i}", b"1") for i in range(5)),
+            *(gw2.put_object("race", f"b{i}", b"2") for i in range(5)),
+        )
+        listing = await gw.list_objects("race")
+        assert len(listing["entries"]) == 10
+
+        await rados2.shutdown()
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_filesystem_namespace_and_striped_files():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        for osd in cluster.osds.values():
+            register_fs_classes(osd)
+        rados = Rados("client.fs", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        from ceph_tpu.rados.striper import StripeLayout
+
+        fs = FileSystem(
+            rados.io_ctx(REP_POOL),
+            StripeLayout(stripe_unit=1 << 10, stripe_count=2,
+                         object_size=1 << 11),
+        )
+        await fs.mkfs()
+
+        await fs.mkdir("/home")
+        await fs.mkdir("/home/user")
+        with pytest.raises(RadosError, match="EEXIST"):
+            await fs.mkdir("/home")
+
+        big = bytes(range(256)) * 24  # 6 KiB -> striped over objects
+        await fs.write_file("/home/user/data.bin", big)
+        await fs.write_file("/home/user/notes.txt", b"hello fs")
+        assert await fs.read_file("/home/user/data.bin") == big
+        assert sorted(await fs.listdir("/home/user")) == [
+            "data.bin", "notes.txt"
+        ]
+        st = await fs.stat("/home/user/data.bin")
+        assert st["type"] == "file" and st["size"] == len(big)
+
+        # overwrite in place keeps the same ino
+        ino = st["ino"]
+        await fs.write_file("/home/user/data.bin", b"short now")
+        assert await fs.read_file("/home/user/data.bin") == b"short now"
+        assert (await fs.stat("/home/user/data.bin"))["ino"] == ino
+
+        # rename across directories
+        await fs.mkdir("/archive")
+        await fs.rename("/home/user/notes.txt", "/archive/notes-old.txt")
+        assert await fs.read_file("/archive/notes-old.txt") == b"hello fs"
+        assert sorted(await fs.listdir("/home/user")) == ["data.bin"]
+
+        # rmdir refuses non-empty, unlink+rmdir succeed
+        with pytest.raises(FsError, match="not empty"):
+            await fs.rmdir("/home/user")
+        await fs.unlink("/home/user/data.bin")
+        await fs.rmdir("/home/user")
+        assert await fs.listdir("/home") == {}
+
+        # a second client sees the same namespace
+        rados2 = Rados("client.fs2", cluster.monmap, config=cluster.cfg)
+        await rados2.connect()
+        fs2 = FileSystem(
+            rados2.io_ctx(REP_POOL),
+            StripeLayout(stripe_unit=1 << 10, stripe_count=2,
+                         object_size=1 << 11),
+        )
+        assert await fs2.read_file("/archive/notes-old.txt") == b"hello fs"
+
+        await rados2.shutdown()
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
