@@ -194,10 +194,17 @@ class FileSystem:
 
     async def unlink(self, path: str) -> None:
         parent, name = await self._parent_and_name(path)
-        await self.ioctx.exec(
+        removed = await self.ioctx.exec(
             _dir_obj(parent), "fs_dir", "unlink",
             {"name": name, "must_be": "file"},
         )
+        # reclaim the striped data (inos are never reused, so an orphaned
+        # ino would leak its objects forever)
+        ino = removed["removed"]["ino"]
+        try:
+            await self.striper.remove(_file_soid(ino))
+        except ObjectNotFound:
+            pass  # created but never written
 
     async def rename(self, src: str, dst: str) -> None:
         """Move an entry. Like the reference across dirfrags, this is two

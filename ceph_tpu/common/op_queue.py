@@ -66,6 +66,10 @@ class _Band:
             if self.deficit[klass] >= cost:
                 q.popleft()
                 self.deficit[klass] -= cost
+                # rotate after a pop too, or a cheap klass at the ring
+                # head would be revisited (and re-funded) every call and
+                # starve its band-mates outright
+                self.rr.rotate(-1)
                 return item
             self.rr.rotate(-1)
 
@@ -163,7 +167,12 @@ class MClockQueue:
             if info.reservation
             else float("inf")
         )
-        w = max(last[1] + 1.0 / info.weight, arrival)
+        # weight 0 = reservation-only service (never competes in phase 2)
+        w = (
+            max(last[1] + 1.0 / info.weight, arrival)
+            if info.weight
+            else float("inf")
+        )
         lim = (
             max(last[2] + 1.0 / info.limit, arrival)
             if info.limit

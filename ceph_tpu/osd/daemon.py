@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import zlib
 
 from ceph_tpu.common.config import Config
 from ceph_tpu.common.kv import KeyValueDB
@@ -196,7 +197,17 @@ class OSDService(Dispatcher):
         from ceph_tpu.common.admin import OpTracker
 
         self.op_tracker = OpTracker()
+        # sharded weighted op queue (ShardedOpWQ): workers start in start()
+        from ceph_tpu.common.op_queue import WeightedPriorityQueue
+
+        class _OpShard:
+            def __init__(self):
+                self.queue = WeightedPriorityQueue()
+                self.kick = asyncio.Event()
+
+        self._op_shards = [_OpShard() for _ in range(4)]
         self._tasks: list[asyncio.Task] = []
+        self._ephemeral: set[asyncio.Task] = set()
         self._stopped = False
         self.mon.on_map_change(self._note_map)
         self._map_dirty = asyncio.Event()
@@ -234,13 +245,24 @@ class OSDService(Dispatcher):
             await asyncio.sleep(0.02)
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._peering_loop()))
+        for shard in self._op_shards:
+            self._tasks.append(
+                asyncio.create_task(self._op_shard_worker(shard))
+            )
         self._note_map(self.osdmap)
+
+    def _spawn(self, coro) -> None:
+        """Short-lived task that prunes itself on completion (notifies,
+        peering nudges): `_tasks` must not grow with daemon lifetime."""
+        task = asyncio.create_task(coro)
+        self._ephemeral.add(task)
+        task.add_done_callback(self._ephemeral.discard)
 
     async def stop(self) -> None:
         self._stopped = True
-        for t in self._tasks:
+        for t in list(self._tasks) + list(self._ephemeral):
             t.cancel()
-        for t in self._tasks:
+        for t in list(self._tasks) + list(self._ephemeral):
             try:
                 await t
             except (asyncio.CancelledError, Exception):
@@ -422,7 +444,7 @@ class OSDService(Dispatcher):
                 await asyncio.sleep(0.3)
                 self._map_dirty.set()
 
-            self._tasks.append(asyncio.create_task(nudge()))
+            self._spawn(nudge())
 
     async def _peer_and_recover(self, pg: PG, acting: list[int]) -> None:
         """GetInfo -> GetLog -> GetMissing -> push, one pass."""
@@ -720,13 +742,37 @@ class OSDService(Dispatcher):
     # -- client ops (the primary path) ----------------------------------------
 
     async def _h_osd_op(self, conn, p) -> None:
-        pool_id = p["pool"]
-        name = p["name"]
-        with self.op_tracker.track(
-            f"osd_op({p.get('op')} {pool_id}/{name} "
-            f"from {conn.peer_name})"
-        ) as tracked:
-            await self._do_osd_op(conn, p, pool_id, name, tracked)
+        """Client ops ride the sharded weighted op queue (ShardedOpWQ,
+        OSD.cc:9490 enqueue_op -> dequeue_op): the shard is picked by
+        object name so same-object ops keep their arrival order, and
+        within a shard the WPQ's deficit round-robin over client klasses
+        fair-shares service by op cost."""
+        shard = self._op_shards[
+            zlib.crc32(p["name"].encode()) % len(self._op_shards)
+        ]
+        shard.queue.enqueue(
+            63,  # osd_client_op_priority
+            max(1, len(p.get("data", "")) // 8192),
+            (conn, p),
+            klass=conn.peer_name,
+        )
+        shard.kick.set()
+
+    async def _op_shard_worker(self, shard) -> None:
+        while not self._stopped:
+            item = shard.queue.dequeue()
+            if item is None:
+                shard.kick.clear()
+                await shard.kick.wait()
+                continue
+            conn, p = item
+            pool_id = p["pool"]
+            name = p["name"]
+            with self.op_tracker.track(
+                f"osd_op({p.get('op')} {pool_id}/{name} "
+                f"from {conn.peer_name})"
+            ) as tracked:
+                await self._do_osd_op(conn, p, pool_id, name, tracked)
 
     async def _do_osd_op(self, conn, p, pool_id, name, tracked) -> None:
         try:
@@ -785,11 +831,7 @@ class OSDService(Dispatcher):
                 # replied by a task: waiting for acks inline would wedge
                 # this conn's dispatch loop, and the notifier may well be
                 # one of the watchers being notified on this very conn
-                self._tasks.append(
-                    asyncio.create_task(
-                        self._notify_and_reply(pg, conn, p)
-                    )
-                )
+                self._spawn(self._notify_and_reply(pg, conn, p))
                 return
             else:
                 raise RuntimeError(f"unknown op {p['op']!r}")
@@ -1075,16 +1117,17 @@ class OSDService(Dispatcher):
             )
         timeout = p.get("timeout", 5.0)
         acked, missed = [], []
+        if waits:
+            # one deadline for the whole fan-out: N silent watchers cost
+            # one timeout, not N stacked ones
+            await asyncio.wait(waits.values(), timeout=timeout)
         for (wname, cookie), fut in waits.items():
-            try:
-                await asyncio.wait_for(fut, timeout)
+            if fut.done():
                 acked.append({"watcher": wname, "cookie": cookie})
-            except asyncio.TimeoutError:
+            else:
+                fut.cancel()
                 missed.append({"watcher": wname, "cookie": cookie})
-            finally:
-                self._notify_waiters.pop(
-                    (notify_id, wname, cookie), None
-                )
+            self._notify_waiters.pop((notify_id, wname, cookie), None)
         return {"acked": acked, "missed": missed}
 
     async def _notify_and_reply(self, pg, conn, p) -> None:
@@ -1237,19 +1280,28 @@ class OSDService(Dispatcher):
                     for dg in digests.values():
                         counts[dg] = counts.get(dg, 0) + 1
                     best = max(counts.values())
-                    majority = {
-                        dg for dg, c in counts.items() if c == best
-                    }
-                    auth = next(
-                        dg for pos, dg in sorted(digests.items())
-                        if dg in majority
-                    )
-                    for pos, dg in sorted(digests.items()):
-                        if dg != auth:
+                    if best * 2 > len(digests):
+                        # flag minority copies ONLY under a strict digest
+                        # majority; a tie (e.g. 1:1 with a replica down)
+                        # has no safe authority — auto-picking one could
+                        # make repair overwrite the only good copy, so
+                        # ties report "inconsistent" and repair skips them
+                        auth = next(
+                            dg for dg, c in counts.items() if c == best
+                        )
+                        for pos, dg in sorted(digests.items()):
+                            if dg != auth:
+                                errors.append(
+                                    {"pg": [pid, ps], "name": name,
+                                     "shard": None, "osd": acting[pos],
+                                     "error": "digest_mismatch"}
+                                )
+                    else:
+                        for pos in sorted(digests):
                             errors.append(
                                 {"pg": [pid, ps], "name": name,
                                  "shard": None, "osd": acting[pos],
-                                 "error": "digest_mismatch"}
+                                 "error": "inconsistent"}
                             )
         self.perf.inc("scrub_errors", len(errors))
         return {"errors": errors}
@@ -1266,6 +1318,8 @@ class OSDService(Dispatcher):
         ec = self.codec(pool_id)
         repaired = 0
         for err in report["errors"]:
+            if err["error"] == "inconsistent":
+                continue  # no safe authority: surfaced, never auto-fixed
             pid, ps = err["pg"]
             pg = self.pgs[(pid, ps)]
             acting, _ = self.acting_of(pid, ps)

@@ -131,19 +131,49 @@ class Striper:
 class RadosStriper:
     """libradosstriper over a LIVE cluster IoCtx (async twin of Striper).
 
-    The reference's libradosstriper stores the logical size in a
-    `striper.size` xattr on the first object (StriperImpl); plain writes
-    here replace user xattrs, so a tiny `<soid>.striperhdr` object carries
-    it instead — a fresh client can still open striped objects it did not
-    write.
+    The reference's libradosstriper stores the logical size AND the
+    file_layout_t in xattrs on the first object (StriperImpl) — the layout
+    must travel with the data, or a reader configured differently would
+    silently permute bytes. Plain writes here replace user xattrs, so a
+    tiny `<soid>.striperhdr` object carries both; reads always use the
+    layout recorded at write time, never the handle's default.
     """
 
     def __init__(self, ioctx, layout: StripeLayout | None = None):
         self.ioctx = ioctx
         self.layout = layout or StripeLayout()
 
+    @staticmethod
+    def _hdr_name(soid: str) -> str:
+        return f"{soid}.striperhdr"
+
+    async def _read_header(self, soid: str) -> tuple[int, StripeLayout]:
+        import json
+
+        h = json.loads(await self.ioctx.read(self._hdr_name(soid)))
+        return h["size"], StripeLayout(
+            stripe_unit=h["su"], stripe_count=h["sc"],
+            object_size=h["os"],
+        )
+
     async def write(self, soid: str, data: bytes) -> int:
+        # shrinking overwrite: trim data objects the new extent set no
+        # longer covers, or they would leak (and remove() after a later
+        # header rewrite would miss them)
+        try:
+            old_total, old_layout = await self._read_header(soid)
+        except Exception:
+            old_total, old_layout = 0, None
         extents = file_to_extents(self.layout, 0, len(data))
+        if old_layout is not None and old_total:
+            for objectno in file_to_extents(old_layout, 0, old_total):
+                if objectno not in extents:
+                    try:
+                        await self.ioctx.remove(
+                            object_name(soid, objectno)
+                        )
+                    except Exception:
+                        pass
         for objectno, runs in sorted(extents.items()):
             end = max(obj_off + n for obj_off, n, _ in runs)
             buf = bytearray(end)
@@ -152,20 +182,24 @@ class RadosStriper:
             await self.ioctx.write_full(
                 object_name(soid, objectno), bytes(buf)
             )
-        # record the logical size on a header object (first-object xattr
-        # in the reference; a tiny header object here since plain writes
-        # reset user xattrs)
+        import json
+
         await self.ioctx.write_full(
-            f"{soid}.striperhdr", str(len(data)).encode()
+            self._hdr_name(soid),
+            json.dumps(
+                {"size": len(data), "su": self.layout.stripe_unit,
+                 "sc": self.layout.stripe_count,
+                 "os": self.layout.object_size}
+            ).encode(),
         )
         return len(extents)
 
     async def size(self, soid: str) -> int:
-        return int(await self.ioctx.read(f"{soid}.striperhdr"))
+        return (await self._read_header(soid))[0]
 
     async def read(self, soid: str, offset: int = 0,
                    length: int | None = None) -> bytes:
-        total = await self.size(soid)
+        total, layout = await self._read_header(soid)
         if length is None:
             length = total - offset
         length = max(0, min(length, total - offset))
@@ -174,7 +208,7 @@ class RadosStriper:
         out = bytearray(length)
         cache: dict[int, bytes] = {}
         for objectno, runs in file_to_extents(
-            self.layout, offset, length
+            layout, offset, length
         ).items():
             if objectno not in cache:
                 cache[objectno] = await self.ioctx.read(
@@ -186,3 +220,13 @@ class RadosStriper:
                 piece = piece + b"\0" * (n - len(piece))
                 out[file_off - offset: file_off - offset + n] = piece
         return bytes(out)
+
+    async def remove(self, soid: str) -> None:
+        """Delete every data object + the header (rados_striper_remove)."""
+        total, layout = await self._read_header(soid)
+        for objectno in file_to_extents(layout, 0, max(total, 1)):
+            try:
+                await self.ioctx.remove(object_name(soid, objectno))
+            except Exception:
+                pass  # sparse/already-gone objects
+        await self.ioctx.remove(self._hdr_name(soid))

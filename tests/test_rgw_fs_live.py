@@ -159,3 +159,58 @@ def test_filesystem_namespace_and_striped_files():
         await cluster.stop()
 
     run(main())
+
+
+def test_unlink_reclaims_striped_data_and_layout_travels():
+    async def main():
+        from ceph_tpu.rados.striper import RadosStriper, StripeLayout
+
+        cluster = Cluster()
+        await cluster.start()
+        for osd in cluster.osds.values():
+            register_fs_classes(osd)
+        rados = Rados("client.fs3", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        ioctx = rados.io_ctx(REP_POOL)
+
+        def pool_objects():
+            total = 0
+            for osd in cluster.osds.values():
+                for coll in osd.store.list_collections():
+                    if coll.startswith(f"pg_{REP_POOL}_"):
+                        total += len([
+                            o for o in osd.store.list_objects(coll)
+                            if not o.startswith(".")
+                        ])
+            return total
+
+        fs = FileSystem(
+            ioctx,
+            StripeLayout(stripe_unit=1 << 10, stripe_count=2,
+                         object_size=1 << 11),
+        )
+        await fs.mkfs()
+        baseline = pool_objects()
+        await fs.write_file("/junk", bytes(range(256)) * 32)  # 8 KiB
+        assert pool_objects() > baseline
+        await fs.unlink("/junk")
+        # data objects + striper header reclaimed (replica-counted)
+        assert pool_objects() == baseline
+
+        # layout travels in the header: a reader with a DIFFERENT default
+        # layout still reconstructs the bytes exactly
+        writer = RadosStriper(
+            ioctx, StripeLayout(stripe_unit=1 << 10, stripe_count=3,
+                                object_size=1 << 12)
+        )
+        data = bytes(range(256)) * 40
+        await writer.write("xlay", data)
+        reader = RadosStriper(ioctx)  # default 64K/4/256K layout
+        assert await reader.read("xlay") == data
+        assert await reader.read("xlay", 3000, 2000) == data[3000:5000]
+
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
