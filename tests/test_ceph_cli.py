@@ -1,0 +1,142 @@
+"""The operator CLI + dencoder against a live cluster: status, osd tree,
+pool admin, pg dump, daemon commands, balancer — and wire-blob round
+trips.
+
+The cluster runs on its own event loop in a background thread — exactly
+the out-of-process shape the CLI targets — while each CLI invocation spins
+its own loop in the test thread, like a real shell invocation would."""
+
+import asyncio
+import json
+import threading
+
+from tests.test_cluster_live import Cluster
+from tools import ceph as ceph_cli
+from tools import dencoder
+
+
+class ClusterThread:
+    """A live cluster on a dedicated loop+thread; drive it via submit()."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(
+            target=self.loop.run_forever, daemon=True
+        )
+        self.thread.start()
+        self.cluster = Cluster()
+        self.submit(self.cluster.start())
+
+    def submit(self, coro, timeout=120):
+        return asyncio.run_coroutine_threadsafe(
+            coro, self.loop
+        ).result(timeout)
+
+    def stop(self):
+        self.submit(self.cluster.stop())
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(10)
+
+
+def cli(capsys, monmap, *argv):
+    """Run the CLI in-process; returns its parsed JSON output."""
+    mon_host = ",".join(f"{h}:{p}" for h, p in monmap.addrs)
+    rc = ceph_cli.main(["--mon-host", mon_host, *argv])
+    assert rc == 0
+    out = capsys.readouterr().out
+    return json.loads(out)
+
+
+def test_ceph_cli_surface(capsys):
+    ct = ClusterThread()
+    cluster = ct.cluster
+    try:
+        st = cli(capsys, cluster.monmap, "status")
+        assert st["num_up"] == 6 and sorted(st["quorum"]) == [0, 1, 2]
+
+        cli(capsys, cluster.monmap, "--name", "client.admin2",
+            "osd", "erasure-code-profile", "set", "cliprof",
+            "plugin=tpu", "k=2", "m=1")
+        created = cli(
+            capsys, cluster.monmap, "--profile", "cliprof",
+            "--pg-num", "8", "osd", "pool", "create", "9", "0",
+        )
+        assert created["pool_id"] == 9
+
+        tree = cli(capsys, cluster.monmap, "osd", "tree")
+        osd_nodes = [n for n in tree["nodes"] if n["type"] == "osd"]
+        assert len(osd_nodes) == 6
+        assert all(n["status"] == "up" for n in osd_nodes)
+        hosts = [n for n in tree["nodes"] if n["depth"] == 1]
+        assert len(hosts) == 6  # one host bucket per osd in this fixture
+
+        dump = cli(capsys, cluster.monmap, "--pool", "9", "pg", "dump")
+        assert dump["num_pgs"] == 8
+        assert all(len(pg["acting"]) == 3 for pg in dump["pgs"])  # k+m
+
+        down = cli(capsys, cluster.monmap, "osd", "down", "4")
+        assert down == {}
+
+        async def wait_down():
+            leader = next(m for m in cluster.mons if m.is_leader)
+            while not leader.osdmap.is_down(4):
+                await asyncio.sleep(0.02)
+
+        ct.submit(wait_down(), timeout=20)
+        tree = cli(capsys, cluster.monmap, "osd", "tree")
+        assert any(
+            n["type"] == "osd" and n["id"] == 4 and n["status"] == "down"
+            for n in tree["nodes"]
+        )
+
+        perf = cli(capsys, cluster.monmap, "daemon", "osd.0",
+                   "perf", "dump")
+        assert "osd.0" in perf
+        scrub = cli(capsys, cluster.monmap, "daemon", "osd.0",
+                    "scrub", "pool=9", "deep=1")
+        assert scrub["errors"] == []
+    finally:
+        ct.stop()
+
+
+def test_dencoder_round_trips(capsys):
+    from ceph_tpu.msg.frames import Message
+    from ceph_tpu.osd.osdmap import Incremental
+    from tests.conftest import make_mini_cluster
+
+    assert dencoder.main(["list_types"]) == 0
+    types = json.loads(capsys.readouterr().out)
+    assert {"osdmap", "osdmap_incremental", "message"} <= set(types)
+
+    m = make_mini_cluster(n_hosts=3).osdmap
+    raw = m.encode()
+    import io
+    import sys as _sys
+
+    class FakeIn:
+        def __init__(self, b):
+            self.buffer = io.BytesIO(b)
+
+    old = _sys.stdin
+    try:
+        _sys.stdin = FakeIn(raw)
+        assert dencoder.main(["decode", "osdmap"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["epoch"] == m.epoch and doc["max_osd"] == m.max_osd
+
+        _sys.stdin = FakeIn(raw)
+        assert dencoder.main(["round_trip", "osdmap"]) == 0
+        assert json.loads(capsys.readouterr().out)["round_trip"] == "exact"
+
+        inc = Incremental(epoch=2, new_down=[1],
+                          new_osd_addrs={1: ("127.0.0.1", 1)})
+        _sys.stdin = FakeIn(inc.encode())
+        assert dencoder.main(["round_trip", "osdmap_incremental"]) == 0
+        capsys.readouterr()
+
+        msg = Message(type="osd_op", tid=9, data=b"abc")
+        _sys.stdin = FakeIn(msg.encode())
+        assert dencoder.main(["decode", "message"]) == 0
+        assert json.loads(capsys.readouterr().out)["tid"] == 9
+    finally:
+        _sys.stdin = old
