@@ -197,6 +197,12 @@ class OSDService(Dispatcher):
         from ceph_tpu.common.admin import OpTracker
 
         self.op_tracker = OpTracker()
+        # dout-style subsystem logging with the always-on recent ring
+        # (src/log/Log.cc); dumped via the `log dump` admin command
+        from ceph_tpu.common.log import LogRegistry
+
+        self.logs = LogRegistry(self.config)
+        self.dlog = self.logs.get_logger("osd")
         # sharded weighted op queue (ShardedOpWQ): workers start in start()
         from ceph_tpu.common.op_queue import WeightedPriorityQueue
 
@@ -243,6 +249,9 @@ class OSDService(Dispatcher):
                 )
                 next_boot = loop.time() + 1.0
             await asyncio.sleep(0.02)
+        if (d := self.dlog.dout(1)) is not None:
+            d(f"osd.{self.id} booted at {self.messenger.my_addr}, "
+              f"epoch {self.osdmap.epoch}")
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._peering_loop()))
         for shard in self._op_shards:
@@ -380,6 +389,9 @@ class OSDService(Dispatcher):
                         peer, loop.time()
                     )
                     if silent > grace and peer not in self._reported:
+                        if (d := self.dlog.dout(1)) is not None:
+                            d(f"peer osd.{peer} silent {silent:.1f}s: "
+                              f"reporting failure")
                         self.mon.report_failure(peer)
                         self._reported.add(peer)
                         self.perf.inc("heartbeat_failures")
@@ -435,6 +447,8 @@ class OSDService(Dispatcher):
                     await self._peer_and_recover(pg, acting)
                 pg.active = True
                 pg.last_acting = list(acting)
+                if (d := self.dlog.dout(5)) is not None:
+                    d(f"pg {pool_id}.{ps} active, acting {acting}")
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -1169,6 +1183,8 @@ class OSDService(Dispatcher):
                     ),
                     "collections": len(self.store.list_collections()),
                 }
+            elif cmd == "log dump":
+                result = {"entries": self.logs.dump_recent()}
             elif cmd == "dump_ops_in_flight":
                 result = self.op_tracker.dump_ops_in_flight()
             elif cmd == "dump_historic_ops":
