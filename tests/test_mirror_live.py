@@ -1,0 +1,103 @@
+"""Async replication between TWO live clusters: journaled image writes on
+cluster A replayed by an rbd-mirror-style daemon onto cluster B — ordered,
+incremental, and convergent."""
+
+import asyncio
+
+from ceph_tpu.journal import ImageReplayer, Journaler, MirroredImage
+from ceph_tpu.journal.journal import register_journal_classes
+from ceph_tpu.rados.client import Rados
+from ceph_tpu.rbd import Image
+from tests.test_cluster_live import REP_POOL, Cluster
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+def test_journal_append_read_commit_trim():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        for osd in cluster.osds.values():
+            register_journal_classes(osd)
+        rados = Rados("client.j", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        j = Journaler(rados.io_ctx(REP_POOL), "t")
+
+        assert await j.append({"n": 1}) == 1
+        assert await j.append({"n": 2}) == 2
+        assert await j.append({"n": 3}) == 3
+        page = await j.read()
+        assert [e["event"]["n"] for e in page["entries"]] == [1, 2, 3]
+
+        assert await j.commit_and_trim(2) == 2
+        page = await j.read()
+        assert [e["pos"] for e in page["entries"]] == [3]
+        assert page["commit"] == 2 and page["head"] == 3
+        # commit can never outrun the head
+        assert await j.commit_and_trim(99) == 3
+
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_two_cluster_image_mirroring():
+    async def main():
+        site_a = Cluster()
+        site_b = Cluster()
+        await site_a.start()
+        await site_b.start()
+        for osd in site_a.osds.values():
+            register_journal_classes(osd)
+        ra = Rados("client.site_a", site_a.monmap, config=site_a.cfg)
+        rb = Rados("client.site_b", site_b.monmap, config=site_b.cfg)
+        await ra.connect()
+        await rb.connect()
+        await site_a.create_pools(ra)
+        await site_b.create_pools(rb)
+        io_a = ra.io_ctx(REP_POOL)
+        io_b = rb.io_ctx(REP_POOL)
+
+        # journaled image on site A
+        img = await MirroredImage.create(io_a, "mirrored", 32 * 1024,
+                                         order=12)
+        await img.write(1000, b"alpha" * 100)
+        await img.write(5000, b"beta" * 200)
+
+        replayer = ImageReplayer(io_a, io_b, "mirrored")
+        applied = await replayer.run_once()
+        assert applied == 3  # create + 2 writes
+
+        remote = await Image.open(io_b, "mirrored")
+        assert remote.size == 32 * 1024 and remote.order == 12
+        assert await remote.read(1000, 500) == b"alpha" * 100
+        assert await remote.read(5000, 800) == b"beta" * 200
+
+        # incremental: later writes replay from the commit position only
+        await img.write(1000, b"ALPHA" * 100)  # overwrite
+        await img.resize(16 * 1024)
+        assert await replayer.run_once() == 2
+        assert await remote.read(1000, 500) == b"ALPHA" * 100
+        assert (await Image.open(io_b, "mirrored")).size == 16 * 1024
+
+        # idempotent when caught up; journal stays trimmed
+        assert await replayer.run_once() == 0
+        page = await Journaler(io_a, "img.mirrored").read()
+        assert page["entries"] == []
+
+        # site A and B images byte-identical over the full span
+        local = await Image.open(io_a, "mirrored")
+        assert await local.read(0, 16 * 1024) == await (
+            await Image.open(io_b, "mirrored")
+        ).read(0, 16 * 1024)
+
+        await ra.shutdown()
+        await rb.shutdown()
+        await site_a.stop()
+        await site_b.stop()
+
+    run(main())
