@@ -1,0 +1,101 @@
+"""Thrashing the LIVE cluster (qa Thrasher over real daemons): a seeded
+random schedule of writes/overwrites/reads/daemon-kills/revivals with a
+consistency oracle — every read must return exactly what the model says,
+through failure detection, degraded service, and peering recovery."""
+
+import asyncio
+
+import numpy as np
+
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import (
+    EC_POOL,
+    N_OSDS,
+    REP_POOL,
+    Cluster,
+    wait_until,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 300))
+
+
+def test_live_thrash_with_consistency_oracle():
+    async def main():
+        rng = np.random.default_rng(11)
+        cluster = Cluster()
+        await cluster.start()
+        rados = Rados("client.thrash", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        ios = {REP_POOL: rados.io_ctx(REP_POOL),
+               EC_POOL: rados.io_ctx(EC_POOL)}
+        model: dict[tuple[int, str], bytes] = {}
+        dead: list[int] = []
+
+        def leader():
+            return next(m for m in cluster.mons if m.is_leader)
+
+        def payload():
+            n = int(rng.integers(1, 4000))
+            return rng.integers(0, 256, n, np.uint8).tobytes()
+
+        ops = 0
+        for step in range(60):
+            op = rng.choice(
+                ["put", "put", "get", "get", "overwrite", "kill",
+                 "revive"]
+            )
+            pool = int(rng.choice([REP_POOL, EC_POOL]))
+            if op == "put" or (op == "overwrite" and not model):
+                name = f"t{int(rng.integers(0, 25))}"
+                data = payload()
+                await ios[pool].write_full(name, data)
+                model[(pool, name)] = data
+                ops += 1
+            elif op == "overwrite":
+                keys = sorted(model)
+                pool, name = keys[int(rng.integers(0, len(keys)))]
+                data = payload()
+                await ios[pool].write_full(name, data)
+                model[(pool, name)] = data
+                ops += 1
+            elif op == "get" and model:
+                keys = sorted(model)
+                key = keys[int(rng.integers(0, len(keys)))]
+                got = await ios[key[0]].read(key[1])
+                assert got == model[key], key
+                ops += 1
+            elif op == "kill" and not dead:
+                # one daemon down at a time: rep size 3 and EC m=2 both
+                # stay writable through it
+                victim = int(rng.choice(sorted(cluster.osds)))
+                await cluster.kill_osd(victim)
+                dead.append(victim)
+                await wait_until(
+                    lambda: leader().osdmap.is_down(victim), timeout=30
+                )
+            elif op == "revive" and dead:
+                osd = dead.pop()
+                await cluster.start_osd(osd)  # amnesiac revival
+                await wait_until(
+                    lambda: not leader().osdmap.is_down(osd), timeout=30
+                )
+
+        # settle: revive everything, then the full model must read back
+        while dead:
+            osd = dead.pop()
+            await cluster.start_osd(osd)
+            await wait_until(
+                lambda: not leader().osdmap.is_down(osd), timeout=30
+            )
+        for (pool, name), want in sorted(model.items()):
+            assert await ios[pool].read(name) == want, (pool, name)
+        assert ops > 30
+        assert len(cluster.osds) == N_OSDS
+
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
