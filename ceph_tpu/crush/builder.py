@@ -195,3 +195,41 @@ def make_simple_rule(
         RuleStep(RuleOp.EMIT),
     ]
     return make_rule(map, rule_id, steps, rule_type=1 if mode == "firstn" else 3)
+
+
+def bucket_add_item(
+    map: CrushMap, bucket_id: int, item: int, weight: int
+) -> None:
+    """Add one item to a straw2 bucket and propagate the weight change up
+    the hierarchy (crush_bucket_add_item, builder.c:863, plus the ancestor
+    reweight CrushWrapper::insert_item performs).
+
+    straw2 needs no per-item recalibration (the draw divides by the raw
+    16.16 weight), which is why cluster expansion targets straw2 maps; the
+    legacy algs would need their derived tables rebuilt."""
+    b = map.buckets.get(bucket_id)
+    if b is None:
+        raise ValueError(f"no bucket {bucket_id}")
+    if b.alg != BucketAlg.STRAW2:
+        raise ValueError("bucket_add_item supports straw2 buckets only")
+    if item in b.items:
+        raise ValueError(f"item {item} already in bucket {bucket_id}")
+    b.items.append(item)
+    b.item_weights.append(weight)
+    b.weight += weight
+    if item >= 0 and map.max_devices <= item:
+        map.max_devices = item + 1
+    _adjust_ancestor_weights(map, bucket_id, weight)
+
+
+def _adjust_ancestor_weights(map: CrushMap, child: int, delta: int) -> None:
+    for bid, parent in map.buckets.items():
+        if child in parent.items:
+            idx = parent.items.index(child)
+            parent.item_weights[idx] += delta
+            parent.weight += delta
+            if parent.alg != BucketAlg.STRAW2:
+                raise ValueError(
+                    "ancestor reweight supports straw2 buckets only"
+                )
+            _adjust_ancestor_weights(map, bid, delta)

@@ -158,11 +158,21 @@ class MonClient(Dispatcher):
                     data=json.dumps({"target": target_osd}).encode())
         )
 
-    def send_boot(self, osd: int, addr: tuple[str, int]) -> None:
+    def send_boot(
+        self,
+        osd: int,
+        addr: tuple[str, int],
+        location: dict | None = None,
+        weight: int = 0x10000,
+    ) -> None:
+        payload = {"osd": osd, "addr": list(addr)}
+        if location:
+            # crush location announced at boot (CrushLocation's role):
+            # lets the mon place a brand-new device in the hierarchy
+            payload["location"] = location
+            payload["weight"] = weight
         self._conn().send_message(
-            Message(type="osd_boot",
-                    data=json.dumps({"osd": osd,
-                                     "addr": list(addr)}).encode())
+            Message(type="osd_boot", data=json.dumps(payload).encode())
         )
 
     def send_pg_temp(self, pgid: tuple[int, int], acting: list[int]) -> None:

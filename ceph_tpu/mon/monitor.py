@@ -768,8 +768,73 @@ class Monitor(Dispatcher):
         )
         if osd >= self.osdmap.max_osd:
             inc.new_max_osd = osd + 1
+        if p.get("location") and not self._in_crush(osd):
+            # cluster expansion: a brand-new device announces its crush
+            # location at boot and the mon places it in the hierarchy
+            # (CrushLocation + `osd crush add` semantics) — without this
+            # the new OSD would exist in the map but own no PGs
+            text = self._crush_with_device(
+                osd, p["location"], p.get("weight", 0x10000)
+            )
+            if text is not None:
+                inc.new_crush_text = text
         self._failure_reports.pop(osd, None)
         await self._propose_osdmap(inc)
+
+    def _in_crush(self, osd: int) -> bool:
+        return any(
+            osd in b.items for b in self.osdmap.crush.buckets.values()
+        )
+
+    def _crush_with_device(
+        self, osd: int, location: dict, weight: int
+    ) -> str | None:
+        """Decompiled crush text with `osd` inserted under its location's
+        host bucket (created under the root if new)."""
+        from ceph_tpu.crush import builder as cb
+        from ceph_tpu.crush.compiler import (
+            compile_crushmap,
+            decompile_crushmap,
+        )
+        from ceph_tpu.crush.types import BucketAlg
+
+        scratch = compile_crushmap(decompile_crushmap(self.osdmap.crush))
+        host_name = location.get("host")
+        if not host_name:
+            return None
+        by_name = {v: k for k, v in scratch.item_names.items()}
+        host_id = by_name.get(host_name)
+        if host_id is None:
+            # new failure domain: create the host bucket under the root
+            root_name = location.get("root")
+            if root_name is not None:
+                root_id = by_name.get(root_name)
+            else:
+                children = {
+                    i for b in scratch.buckets.values()
+                    for i in b.items if i < 0
+                }
+                root_id = min(
+                    (bid for bid in scratch.buckets
+                     if bid not in children),
+                    default=None,
+                )
+            if root_id is None:
+                return None
+            # same bucket type as existing device-holding buckets (host)
+            host_type = next(
+                (b.type for b in scratch.buckets.values()
+                 if any(i >= 0 for i in b.items)),
+                1,
+            )
+            host_id = min(scratch.buckets) - 1
+            host = cb.make_bucket(
+                scratch, host_id, BucketAlg.STRAW2, host_type, [], [],
+            )
+            scratch.item_names[host_id] = host_name
+            cb.bucket_add_item(scratch, root_id, host.id, 0)
+        cb.bucket_add_item(scratch, host_id, osd, weight)
+        return decompile_crushmap(scratch)
 
     async def _h_pg_temp(self, conn, p) -> None:
         """Peering primaries request temp mappings (MOSDPGTemp)."""

@@ -423,6 +423,9 @@ class Messenger:
         #: (peer_name, peer_nonce) -> un-acked server->client messages,
         #: shared across accepted-connection instances (replayed on accept)
         self._peer_unacked: dict[tuple, list] = {}
+        #: live accept-handler tasks (cancelled on shutdown; wait_closed
+        #: blocks on handlers, so they must not outlive us)
+        self._handler_tasks: set = set()
         self._rng = random.Random(seed)
         #: instance identity (entity_addr_t::nonce): a restarted daemon
         #: reusing its name/address presents a fresh nonce, so peers reset
@@ -438,12 +441,24 @@ class Messenger:
         self.my_addr = self._server.sockets[0].getsockname()[:2]
 
     async def shutdown(self) -> None:
+        # stop accepting FIRST: peers reconnect aggressively (heartbeats,
+        # resend loops) and a session accepted after we close existing
+        # conns would keep wait_closed() blocked forever
+        if self._server is not None:
+            self._server.close()
+        for t in list(self._handler_tasks):
+            t.cancel()
+        for t in list(self._handler_tasks):
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._handler_tasks.clear()
         for conn in list(self._conns.values()) + list(self._accepted):
             await conn.close()
         self._conns.clear()
         self._accepted.clear()
         if self._server is not None:
-            self._server.close()
             await self._server.wait_closed()
             self._server = None
 
@@ -475,6 +490,10 @@ class Messenger:
         conn = Connection(
             self, None, Policy.stateful_server(), outgoing=False
         )
+        task = asyncio.current_task()
+        if task is not None:
+            self._handler_tasks.add(task)
+            task.add_done_callback(self._handler_tasks.discard)
         try:
             if await reader.readexactly(len(BANNER)) != BANNER:
                 raise FrameError("bad banner")

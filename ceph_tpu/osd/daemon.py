@@ -152,8 +152,12 @@ class OSDService(Dispatcher):
         db: KeyValueDB | None = None,
         config: Config | None = None,
         keyring: dict[str, bytes] | None = None,
+        crush_location: dict | None = None,
     ):
         self.id = osd_id
+        #: e.g. {"host": "host9"} — announced at boot so the mon can place
+        #: a brand-new device in the crush hierarchy (cluster expansion)
+        self.crush_location = crush_location
         self.name = f"osd.{osd_id}"
         self.config = config if config is not None else Config()
         self.store = KStore(db)
@@ -245,7 +249,8 @@ class OSDService(Dispatcher):
                 break
             if loop.time() >= next_boot:
                 self.mon.send_boot(
-                    self.id, tuple(self.messenger.my_addr)
+                    self.id, tuple(self.messenger.my_addr),
+                    location=self.crush_location,
                 )
                 next_boot = loop.time() + 1.0
             await asyncio.sleep(0.02)
@@ -444,11 +449,14 @@ class OSDService(Dispatcher):
             pg.active = False
             try:
                 async with pg.lock:
-                    await self._peer_and_recover(pg, acting)
-                pg.active = True
-                pg.last_acting = list(acting)
-                if (d := self.dlog.dout(5)) is not None:
-                    d(f"pg {pool_id}.{ps} active, acting {acting}")
+                    complete = await self._peer_and_recover(pg, acting)
+                if complete:
+                    pg.active = True
+                    pg.last_acting = list(acting)
+                    if (d := self.dlog.dout(5)) is not None:
+                        d(f"pg {pool_id}.{ps} active, acting {acting}")
+                else:
+                    retry_needed = True  # partial recovery: stay peering
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -460,13 +468,16 @@ class OSDService(Dispatcher):
 
             self._spawn(nudge())
 
-    async def _peer_and_recover(self, pg: PG, acting: list[int]) -> None:
-        """GetInfo -> GetLog -> GetMissing -> push, one pass."""
+    async def _peer_and_recover(self, pg: PG, acting: list[int]) -> bool:
+        """GetInfo -> GetLog -> GetMissing -> push, one pass. True only
+        when the PG is known complete (safe to go active).
+
+        Info is collected from acting members AND every other up OSD: a
+        remap (cluster expansion, failed host) can hand the whole acting
+        set to newcomers, leaving the authoritative log only on strays."""
         members = [o for o in acting if o != _NONE and o != self.id]
         infos: dict[int, int] = {self.id: pg.last_update}
-        for osd in members:
-            if self.osdmap.is_down(osd):
-                continue
+        for osd in set(members) | set(self._up_peers()):
             try:
                 rep = await self._peer_call(
                     osd, "pg_info", {"pgid": [pg.pool, pg.ps]},
@@ -476,38 +487,49 @@ class OSDService(Dispatcher):
             except (asyncio.TimeoutError, RuntimeError):
                 continue
         best_osd = max(infos, key=lambda o: (infos[o], o == self.id))
+        ok = True
         if infos[best_osd] > pg.last_update:
-            await self._pull_log_and_objects(pg, best_osd, acting)
-        await self._push_missing(pg, acting, infos)
+            ok = await self._pull_log_and_objects(pg, best_osd, acting)
+        member_infos = {
+            o: v for o, v in infos.items() if o in members or o == self.id
+        }
+        pushed = await self._push_missing(pg, acting, member_infos)
+        return ok and pushed
 
     async def _pull_log_and_objects(
         self, pg: PG, source: int, acting: list[int]
-    ) -> None:
-        """Adopt a more advanced member's log (GetLog + pull)."""
+    ) -> bool:
+        """Adopt a more advanced holder's log (GetLog + pull). Aborts at
+        the first entry whose data is unreachable: appending later entries
+        past a gap would advance last_update and silently orphan the
+        skipped one forever."""
         rep = await self._peer_call(
             source, "pg_log", {"pgid": [pg.pool, pg.ps],
                                "from": pg.last_update},
         )
-        ec = self.codec(pg.pool)
         my_shard = self._my_shard(pg, acting)
+        inventory: dict[str, dict] = {}
+        for e in rep["entries"]:
+            inventory[e["name"]] = e
         for e in rep["entries"]:
             txn = Transaction()
             if e["kind"] == "delete":
                 txn.remove(pg.coll, shard_name(e["name"], my_shard))
+            elif inventory[e["name"]]["version"] != e["version"]:
+                pass  # superseded within this pull: newest entry has it
             else:
-                # pull our copy/shard of the object this entry names
                 want = shard_name(e["name"], my_shard)
                 got = await self._pull_object(
                     pg, e["name"], my_shard, acting, e
                 )
                 if got is None:
-                    continue  # unreachable for now; next epoch retries
+                    return False  # retry the whole tail next pass
                 data, attrs = got
                 txn.write(pg.coll, want, data, attrs=attrs)
             pg.append_log(txn, e)
             self.store.queue_transaction(txn)
             self.perf.inc("recovery_pulls")
-        _ = ec  # codec warmed for pull path
+        return True
 
     def _my_shard(self, pg: PG, acting: list[int]) -> int | None:
         if self.codec(pg.pool) is None:
@@ -517,66 +539,126 @@ class OSDService(Dispatcher):
         except ValueError:
             return None
 
-    async def _pull_object(
-        self, pg: PG, name: str, shard: int | None, acting: list[int], entry
-    ):
-        """Fetch our copy/shard: direct from any holder, else (EC) rebuild
-        by decoding the minimum shard set (RecoveryOp READING)."""
-        members = [o for o in acting if o != _NONE and o != self.id]
-        # direct copy: replicated from anyone, EC from a holder of our shard
-        for osd in members:
-            if self.osdmap.is_down(osd):
+    def _up_peers(self) -> list[int]:
+        m = self.osdmap
+        return [
+            o for o in sorted(m.osd_addrs)
+            if o != self.id and o < m.max_osd and not m.is_down(o)
+        ]
+
+    def _holders_for(self, acting: list[int], pos: int | None) -> list[int]:
+        """Candidate holders of a copy/shard: the acting home first, then
+        every other up OSD — after a remap the surviving data lives on
+        previous-interval STRAYS, which is exactly what the reference's
+        MissingLoc tracks (src/osd/MissingLoc.cc). Includes self (local
+        store) since we may hold stray shards of other positions."""
+        out = []
+        if pos is not None and pos < len(acting):
+            home = acting[pos]
+            if home != _NONE and not self.osdmap.is_down(home):
+                out.append(home)
+        if self.id not in out:
+            out.append(self.id)
+        acting_set = set(acting)
+        out.extend(
+            o for o in self._up_peers()
+            if o not in acting_set and o not in out
+        )
+        # remaining acting members too (replicated: any member has a copy)
+        out.extend(
+            o for o in acting
+            if o not in (_NONE, *out) and not self.osdmap.is_down(o)
+        )
+        return out
+
+    async def _fetch_copy(self, pg: PG, sname: str, ver: int, candidates):
+        """First current-version (data, attrs) among candidates, or None."""
+        for osd in candidates:
+            if osd == self.id:
+                try:
+                    data = self.store.read(pg.coll, sname)
+                    attrs = self.store.getattrs(pg.coll, sname)
+                except StoreError:
+                    continue
+                if attrs.get("ver") == ver:
+                    return data, attrs
                 continue
             try:
                 rep = await self._peer_call(
                     osd, "obj_read",
-                    {"coll": pg.coll, "name": shard_name(name, shard),
-                     "ver": entry["obj_ver"]},
+                    {"coll": pg.coll, "name": sname, "ver": ver},
                     timeout=2.0,
                 )
             except (asyncio.TimeoutError, RuntimeError):
                 continue
             if rep.get("ok"):
                 return bytes.fromhex(rep["data"]), _attrs_from(rep)
+        return None
+
+    async def _rebuild_shard(
+        self, pg: PG, name: str, shard: int, acting: list[int], ver: int,
+        exclude: int | None = None,
+    ):
+        """Decode shard `shard` from current-version source shards found at
+        acting homes or strays (RecoveryOp READING with MissingLoc)."""
         ec = self.codec(pg.pool)
-        if ec is None or shard is None:
-            return None
-        # rebuild our shard from surviving shards
         chunks: dict[int, bytes] = {}
         attrs = None
-        for pos, osd in enumerate(acting):
-            if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
+        for pos in range(len(acting)):
+            if pos == shard:
                 continue
-            try:
-                rep = await self._peer_call(
-                    osd, "obj_read",
-                    {"coll": pg.coll, "name": shard_name(name, pos),
-                     "ver": entry["obj_ver"]},
-                    timeout=2.0,
-                )
-            except (asyncio.TimeoutError, RuntimeError):
-                continue
-            if rep.get("ok"):
-                chunks[pos] = bytes.fromhex(rep["data"])
-                attrs = attrs or _attrs_from(rep)
+            cands = [
+                o for o in self._holders_for(acting, pos) if o != exclude
+            ]
+            got = await self._fetch_copy(
+                pg, shard_name(name, pos), ver, cands
+            )
+            if got is not None:
+                chunks[pos] = got[0]
+                attrs = attrs or got[1]
             if len(chunks) >= ec.get_data_chunk_count():
                 break
         if len(chunks) < ec.get_data_chunk_count():
             return None
-        decoded = ec.decode({shard}, chunks)
-        return decoded[shard], attrs
+        return ec.decode({shard}, chunks)[shard], attrs
+
+    async def _pull_object(
+        self, pg: PG, name: str, shard: int | None, acting: list[int], entry
+    ):
+        """Fetch our copy/shard: direct from any holder (acting or stray),
+        else (EC) rebuild by decoding (RecoveryOp READING)."""
+        cands = [
+            o for o in self._holders_for(acting, shard) if o != self.id
+        ]
+        got = await self._fetch_copy(
+            pg, shard_name(name, shard), entry["obj_ver"], cands
+        )
+        if got is not None:
+            return got
+        ec = self.codec(pg.pool)
+        if ec is None or shard is None:
+            return None
+        return await self._rebuild_shard(
+            pg, name, shard, acting, entry["obj_ver"]
+        )
 
     async def _push_missing(
         self, pg: PG, acting: list[int], infos: dict[int, int]
-    ) -> None:
-        """Push log entries + object data to every laggard member."""
+    ) -> bool:
+        """Push log entries + object data to every laggard member; True
+        only when every member is known complete — the PG must not go
+        active on a partial recovery."""
         inventory = pg.latest_objects()
         ec = self.codec(pg.pool)
+        complete = True
         for pos, osd in enumerate(acting):
             if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
                 continue
             since = infos.get(osd)
-            if since is None or since >= pg.last_update:
+            if since is None:
+                complete = False  # unreachable member: state unknown
+                continue
+            if since >= pg.last_update:
                 continue
             shard = pos if ec is not None else None
             for e in pg.log_entries(since):
@@ -591,6 +673,7 @@ class OSDService(Dispatcher):
                         pg, e, shard, acting
                     )
                     if got is None:
+                        complete = False  # sources unavailable right now
                         continue
                     data, attrs = got
                     payload = {
@@ -607,52 +690,28 @@ class OSDService(Dispatcher):
                     )
                     self.perf.inc("recovery_pushes")
                 except (asyncio.TimeoutError, RuntimeError):
-                    break  # next epoch retries this member
+                    complete = False
+                    break  # next pass retries this member
+        return complete
 
     async def _object_for_push(
         self, pg: PG, entry: dict, shard: int | None, acting: list[int]
     ):
-        """Data for the target's copy/shard, decoding if we don't hold it."""
-        try:
-            data = self.store.read(
-                pg.coll, shard_name(entry["name"], self._my_shard(pg, acting))
-            )
-            attrs = self.store.getattrs(
-                pg.coll,
-                shard_name(entry["name"], self._my_shard(pg, acting)),
-            )
-        except StoreError:
-            return None
+        """Data for the target's copy/shard: our own copy when we hold it
+        at the right version, else fetched/rebuilt from acting + stray
+        holders."""
+        ver = entry["obj_ver"]
+        my = self._my_shard(pg, acting)
         ec = self.codec(pg.pool)
-        if ec is None:
-            if attrs.get("ver") != entry["obj_ver"]:
-                return None
-            return data, attrs
-        if shard == self._my_shard(pg, acting):
-            return data, attrs
-        # rebuild the target's shard from the cluster (incl. our own shard)
-        chunks = {self._my_shard(pg, acting): data}
-        for pos, osd in enumerate(acting):
-            if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
-                continue
-            if len(chunks) >= ec.get_data_chunk_count():
-                break
-            try:
-                rep = await self._peer_call(
-                    osd, "obj_read",
-                    {"coll": pg.coll,
-                     "name": shard_name(entry["name"], pos),
-                     "ver": entry["obj_ver"]},
-                    timeout=2.0,
-                )
-            except (asyncio.TimeoutError, RuntimeError):
-                continue
-            if rep.get("ok"):
-                chunks[pos] = bytes.fromhex(rep["data"])
-        if len(chunks) < ec.get_data_chunk_count():
-            return None
-        decoded = ec.decode({shard}, chunks)
-        return decoded[shard], attrs
+        if ec is None or shard == my:
+            sname = shard_name(entry["name"], my if ec is not None else None)
+            got = await self._fetch_copy(
+                pg, sname, ver, self._holders_for(acting, my)
+            )
+            return got
+        return await self._rebuild_shard(
+            pg, entry["name"], shard, acting, ver
+        )
 
     # -- peer sub-op servers --------------------------------------------------
 
@@ -1019,6 +1078,18 @@ class OSDService(Dispatcher):
                 except (asyncio.TimeoutError, RuntimeError):
                     rep = {"ok": False}
                 if not rep.get("ok"):
+                    # acting home lacks the shard (mid-recovery interval):
+                    # previous-interval strays may still hold it
+                    stray = await self._fetch_copy(
+                        pg, shard_name(name, s), entry["obj_ver"],
+                        [o for o in self._up_peers()
+                         if o not in set(acting)],
+                    )
+                    if stray is not None:
+                        chunks[s] = stray[0]
+                        if size is None:
+                            size = stray[1].get("size")
+                        continue
                     failed = s
                     break
                 chunks[s] = bytes.fromhex(rep["data"])

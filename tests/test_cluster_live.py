@@ -296,3 +296,74 @@ def test_osd_restart_on_persistent_store_resumes(tmp_path):
         await cluster.stop()
 
     run(main())
+
+
+def test_cluster_expansion_new_osd_takes_load():
+    """A brand-new OSD id boots with a crush location; the mon places it
+    in the hierarchy, PGs rebalance onto it, recovery populates it, and
+    IO continues correct throughout (the `osd crush add` expansion flow)."""
+
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        rados = Rados("client.grow", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        rep = rados.io_ctx(REP_POOL)
+        ec = rados.io_ctx(EC_POOL)
+        payloads = {}
+        for i in range(10):
+            payloads[f"g{i}"] = bytes([i]) * (500 + 29 * i)
+            await rep.write_full(f"g{i}", payloads[f"g{i}"])
+            await ec.write_full(f"g{i}", payloads[f"g{i}"])
+
+        new_id = N_OSDS  # an id the initial map has never seen
+        osd = OSDService(
+            new_id, cluster.monmap, config=cluster.cfg,
+            crush_location={"host": f"host{new_id}"},
+        )
+        await osd.start()
+        cluster.osds[new_id] = osd
+
+        leader = next(m for m in cluster.mons if m.is_leader)
+        await wait_until(
+            lambda: new_id < leader.osdmap.max_osd
+            and leader.osdmap.osd_up[new_id]
+        )
+        # it is really in the crush hierarchy...
+        assert any(
+            new_id in b.items
+            for b in leader.osdmap.crush.buckets.values()
+        )
+        # ...and owns PGs in both pools under the expanded map
+        owned = set()
+        for pool in (REP_POOL, EC_POOL):
+            for ps in range(leader.osdmap.pools[pool].pg_num):
+                acting = leader.osdmap.pg_to_up_acting_osds(pool, ps)[2]
+                if new_id in acting:
+                    owned.add((pool, ps))
+        assert owned, "the new OSD must take over some PGs"
+
+        # recovery populates it with real data for those PGs
+        def populated():
+            total = 0
+            for coll in osd.store.list_collections():
+                total += len([
+                    o for o in osd.store.list_objects(coll)
+                    if not o.startswith(".")
+                ])
+            return total
+
+        await wait_until(lambda: populated() > 0, timeout=30)
+
+        # IO stays correct across the rebalance
+        for name, data in payloads.items():
+            assert await rep.read(name) == data
+            assert await ec.read(name) == data
+        await rep.write_full("post-grow", b"expanded")
+        assert await rep.read("post-grow") == b"expanded"
+
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
