@@ -1,0 +1,56 @@
+"""The msgr-failures tier over the live cluster: every messenger in the
+system (mons, OSDs, client) randomly drops 1-in-N frame I/Os — the qa
+suites' `ms inject socket failures` fragments — and the cluster must stay
+correct: Paxos commits, boot, sub-op fan-outs, and client IO all ride the
+lossless resend contract."""
+
+import asyncio
+
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster, live_config
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 240))
+
+
+def test_live_cluster_under_injected_socket_failures():
+    async def main():
+        cfg = live_config()
+        # 1-in-60 per frame I/O: with handshakes, heartbeats, paxos, and
+        # sub-ops in flight this produces a steady stream of connection
+        # drops everywhere
+        cfg.set("ms_inject_socket_failures", 60)
+        cluster = Cluster(cfg=cfg)
+        await cluster.start()
+        rados = Rados("client.inj", cluster.monmap, config=cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        rep = rados.io_ctx(REP_POOL)
+        ec = rados.io_ctx(EC_POOL)
+
+        payloads = {}
+        for i in range(12):
+            payloads[f"f{i}"] = bytes([i]) * (400 + 61 * i)
+            await rep.write_full(f"f{i}", payloads[f"f{i}"])
+            await ec.write_full(f"f{i}", payloads[f"f{i}"])
+        for i in range(12):
+            assert await rep.read(f"f{i}") == payloads[f"f{i}"]
+            assert await ec.read(f"f{i}") == payloads[f"f{i}"]
+
+        # overwrites + stat under continued injection
+        for i in range(0, 12, 3):
+            payloads[f"f{i}"] = b"v2" * (50 + i)
+            await rep.write_full(f"f{i}", payloads[f"f{i}"])
+            assert await rep.read(f"f{i}") == payloads[f"f{i}"]
+
+        # the fault hooks really fired across the fleet
+        injected = sum(
+            o.messenger.injected_failures for o in cluster.osds.values()
+        ) + sum(m.messenger.injected_failures for m in cluster.mons)
+        assert injected > 10, injected
+
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
