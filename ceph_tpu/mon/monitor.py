@@ -100,6 +100,8 @@ class Monitor(Dispatcher):
         self._election_task: asyncio.Task | None = None
         self._lease_task: asyncio.Task | None = None
         self._last_lease = 0.0
+        #: leader-side: peon rank -> last px_lease_ack time
+        self._lease_acks: dict[int, float] = {}
 
         # paxos state (persisted)
         self.last_committed = self._load_u64(b"last_committed", 0)
@@ -271,6 +273,7 @@ class Monitor(Dispatcher):
         if len(self._acks) + 1 >= self.monmap.majority:
             self.state = "leader"
             self.leader_rank = self.rank
+            self._promise_reign(self.election_epoch, self.rank)
             self.quorum = {self.rank} | set(self._acks)
             self._bcast(
                 "el_victory",
@@ -333,6 +336,9 @@ class Monitor(Dispatcher):
 
     async def _lease_loop(self) -> None:
         interval = self.config.get("mon_lease")
+        factor = self.config.get("mon_lease_ack_timeout_factor")
+        loop = asyncio.get_event_loop()
+        self._lease_acks = {r: loop.time() for r in range(self.monmap.size)}
         while self.is_leader and not self._stopped:
             self._bcast(
                 "px_lease",
@@ -340,6 +346,20 @@ class Monitor(Dispatcher):
                  "last_committed": self.last_committed},
             )
             await asyncio.sleep(interval)
+            if not self.is_leader or self._stopped:
+                return  # deposed mid-sleep: the new reign is not ours to judge
+            # a leader partitioned from its quorum must step down rather
+            # than keep proposing against a reign it no longer leads
+            # (lease_ack_timeout in the reference forces a bootstrap)
+            fresh = sum(
+                1 for r in range(self.monmap.size)
+                if r != self.rank
+                and loop.time() - self._lease_acks.get(r, 0)
+                <= interval * factor
+            )
+            if self.monmap.size > 1 and fresh + 1 < self.monmap.majority:
+                self.start_election()
+                return
 
     async def _lease_watchdog(self) -> None:
         interval = self.config.get("mon_lease")
@@ -530,11 +550,26 @@ class Monitor(Dispatcher):
 
     # election messages
 
+    def _promise_reign(self, epoch: int, rank: int) -> None:
+        """Joining a reign IS a Paxos promise (Paxos::handle_collect bumps
+        accepted_pn during collect for the same reason): once we ack an
+        election proposal or accept a victory, any px_begin carrying a pn
+        from an older reign must be rejected, or a deposed leader's
+        in-flight begin could still reach majority and commit a different
+        value at the same version the new leader is committing."""
+        pn = (epoch << 8) | rank
+        if pn > self.promised_pn:
+            txn = KVTransaction()
+            self._store_meta(txn, b"promised_pn", pn)
+            self.db.submit_transaction(txn)
+            self.promised_pn = pn
+
     async def _h_el_propose(self, conn, p) -> None:
         if p["epoch"] > self.election_epoch:
             self.election_epoch = p["epoch"]
             self.state = "electing"
         if p["rank"] < self.rank:
+            self._promise_reign(p["epoch"], p["rank"])
             pending = None
             if self._pending is not None:
                 pending = {
@@ -587,6 +622,7 @@ class Monitor(Dispatcher):
         if self.state == "leader":
             self._abort_proposals()
         self.election_epoch = p["epoch"]
+        self._promise_reign(p["epoch"], p["leader"])
         self.state = "peon"
         self.leader_rank = p["leader"]
         self.quorum = set(p["quorum"])
@@ -625,7 +661,8 @@ class Monitor(Dispatcher):
                 conn,
                 "px_nack",
                 {"rank": self.rank,
-                 "last_committed": self.last_committed},
+                 "last_committed": self.last_committed,
+                 "promised_pn": self.promised_pn},
             )
 
     async def _h_px_accept(self, conn, p) -> None:
@@ -648,6 +685,14 @@ class Monitor(Dispatcher):
                 p["rank"], "px_entries",
                 {"entries": entries, "to_rank": p["rank"]},
             )
+        elif self.is_leader and p.get("promised_pn", 0) > self._pn():
+            # a peon promised a dead candidate of this very epoch a
+            # higher pn than our reign's: our begins can never succeed
+            # there. Re-electing bumps the epoch, and (epoch+1)<<8
+            # outranks any promise from this epoch — classic Paxos
+            # "retry with a higher proposal number", expressed through
+            # the election that doubles as our collect phase.
+            self.start_election()
 
     async def _h_px_commit(self, conn, p) -> None:
         value = bytes.fromhex(p["value"])
@@ -682,6 +727,10 @@ class Monitor(Dispatcher):
     async def _h_px_lease(self, conn, p) -> None:
         if self.state == "peon":
             self._last_lease = asyncio.get_event_loop().time()
+            self._send(
+                conn, "px_lease_ack",
+                {"epoch": p["epoch"], "rank": self.rank},
+            )
             if p["last_committed"] > self.last_committed and (
                 self.leader_rank is not None
             ):
@@ -690,6 +739,10 @@ class Monitor(Dispatcher):
                     {"from": self.last_committed + 1,
                      "to_rank": self.rank},
                 )
+
+    async def _h_px_lease_ack(self, conn, p) -> None:
+        if self.is_leader and p["epoch"] == self.election_epoch:
+            self._lease_acks[p["rank"]] = asyncio.get_event_loop().time()
 
     # subscriptions + client commands
 

@@ -323,12 +323,22 @@ class Connection:
             raise FrameError(f"expected AUTH_CHALLENGE, got {chal.tag}")
         nonce_s = Decoder(chal.payload).blob()
         proof = hmac_mod.new(
-            secret, nonce_c + nonce_s, hashlib.sha256
+            secret, b"cli" + nonce_c + nonce_s, hashlib.sha256
         ).digest()
         await stream.send(Frame(Tag.AUTH_PROOF, proof), None)
         done = await stream.recv(None)
         if done.tag != Tag.AUTH_DONE:
             raise FrameError("auth refused")
+        # mutual auth (cephx is mutual): the server must prove knowledge
+        # of the shared secret too, or a spoofed daemon address could
+        # complete the handshake and read every payload we send. The two
+        # proofs are domain-separated ("cli"/"srv") so a fake server that
+        # sets nonce_s == nonce_c cannot reflect ours back at us.
+        server_proof = hmac_mod.new(
+            secret, b"srv" + nonce_s + nonce_c, hashlib.sha256
+        ).digest()
+        if not hmac_mod.compare_digest(done.payload, server_proof):
+            raise FrameError("server failed mutual auth proof")
         self.session_key = _session_key(secret, nonce_c, nonce_s)
 
     # -- shared loops ---------------------------------------------------------
@@ -588,13 +598,16 @@ class Messenger:
         )
         proof = await stream.recv(None)
         want = hmac_mod.new(
-            secret, nonce_c + nonce_s, hashlib.sha256
+            secret, b"cli" + nonce_c + nonce_s, hashlib.sha256
         ).digest()
         if proof.tag != Tag.AUTH_PROOF or not hmac_mod.compare_digest(
             proof.payload, want
         ):
             await stream.send(Frame(Tag.RESET, b""), None)
             return False
-        await stream.send(Frame(Tag.AUTH_DONE, b""), None)
+        server_proof = hmac_mod.new(
+            secret, b"srv" + nonce_s + nonce_c, hashlib.sha256
+        ).digest()
+        await stream.send(Frame(Tag.AUTH_DONE, server_proof), None)
         conn.session_key = _session_key(secret, nonce_c, nonce_s)
         return True

@@ -926,6 +926,23 @@ class OSDService(Dispatcher):
         e = pg.latest_objects().get(name)
         return 0 if e is None else e["obj_ver"]
 
+    def _check_min_size(self, pg: PG, acting: list[int]) -> None:
+        """The reference blocks IO below pool min_size: acking a write
+        that landed on fewer than min_size members risks silently losing
+        it if the lone holder then fails and stale replicas re-peer. The
+        error is retryable (no errno) so the client resends once the
+        cluster heals."""
+        pool = self.osdmap.pools[pg.pool]
+        alive = sum(
+            1 for o in acting
+            if o != _NONE and not self.osdmap.is_down(o)
+        )
+        if alive < pool.min_size:
+            raise RuntimeError(
+                f"pg {pg.pool}.{pg.ps} has {alive} acting members, "
+                f"below min_size {pool.min_size}"
+            )
+
     async def _primary_write(
         self, pg: PG, acting: list[int], name: str, data: bytes,
         user_attrs: dict | None = None,
@@ -943,6 +960,7 @@ class OSDService(Dispatcher):
             json.dumps(user_attrs, sort_keys=True).encode()
             if user_attrs else None
         )
+        self._check_min_size(pg, acting)
         ec = self.codec(pg.pool)
         if ec is None:
             attrs = {"ver": entry["obj_ver"]}
@@ -1002,6 +1020,7 @@ class OSDService(Dispatcher):
             "obj_ver": self._obj_version(pg, name) + 1,
             "kind": "delete",
         }
+        self._check_min_size(pg, acting)
         ec = self.codec(pg.pool)
         waits = []
         for pos, osd in enumerate(acting):

@@ -155,5 +155,19 @@ class Image:
                 await self.ioctx.remove(self._data_name(objectno))
             except ObjectNotFound:
                 pass
+        if new_size < self.size and new_size & (objsize - 1):
+            # shrink: truncate the partial boundary object too, or a later
+            # grow would re-expose stale bytes where zeros are expected
+            # (the reference truncates the boundary object on shrink)
+            boundary = new_size >> self.order
+            keep = new_size & (objsize - 1)
+            try:
+                cur = await self.ioctx.read(self._data_name(boundary))
+                if len(cur) > keep:
+                    await self.ioctx.write_full(
+                        self._data_name(boundary), cur[:keep]
+                    )
+            except ObjectNotFound:
+                pass
         self.size = new_size
         await self._save_header()

@@ -1,0 +1,285 @@
+"""Regression tests for the round-2 advisor findings:
+
+  1. elections act as Paxos promises (promised_pn raised on ack/victory)
+  2. writes are refused below pool min_size
+  3. messenger auth is mutual (server must prove the shared secret)
+  4. a leader partitioned from its quorum steps down (lease acks)
+  5. rbd shrink truncates the boundary object
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import Message, Messenger, Policy
+from ceph_tpu.msg.frames import Frame, Tag
+from tests.test_mon import fast_config, start_cluster, wait_until
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 120))
+
+
+def test_election_acts_as_paxos_promise():
+    """After an election settles, every member has promised the winning
+    reign's pn: a px_begin from any older reign must be nacked, so a
+    deposed leader's in-flight begin can never reach majority."""
+
+    async def main():
+        mons, monmap, cfg = await start_cluster(3)
+        leader = next(m for m in mons if m.is_leader)
+        reign_pn = (leader.election_epoch << 8) | leader.rank
+        for m in mons:
+            assert m.promised_pn >= reign_pn, (
+                f"mon.{m.rank} promised {m.promised_pn:#x} < "
+                f"reign {reign_pn:#x}"
+            )
+        # a begin carrying a pre-reign pn is rejected even when the
+        # version lines up (the exact stale-leader race window)
+        peon = next(m for m in mons if not m.is_leader)
+        nacks = []
+        orig = peon._send
+
+        def spy(rank_or_conn, mtype, payload):
+            if mtype == "px_nack":
+                nacks.append(payload)
+                if rank_or_conn is None:
+                    return None  # injected begin has no real connection
+            return orig(rank_or_conn, mtype, payload)
+
+        peon._send = spy
+        await peon._h_px_begin(
+            None,
+            {"epoch": leader.election_epoch - 1,
+             "pn": reign_pn - 256,  # an older reign's pn
+             "version": peon.last_committed + 1,
+             "value": b"\x00".hex()},
+        )
+        assert nacks, "stale-reign px_begin was not nacked"
+        for m in mons:
+            await m.stop()
+
+    run(main())
+
+
+def test_orphaned_promise_does_not_wedge_proposals():
+    """A peon that promised a dead candidate of the same epoch a higher
+    pn than the winner's must not wedge the cluster: the nacked leader
+    re-elects at a higher epoch and the proposal lands."""
+
+    async def main():
+        mons, monmap, cfg = await start_cluster(3)
+        leader = next(m for m in mons if m.is_leader)
+        # simulate having acked a now-dead higher-pn candidate of this epoch
+        for m in mons:
+            if not m.is_leader:
+                m.promised_pn = ((leader.election_epoch << 8) | 0xFF)
+        from ceph_tpu.osd.osdmap import Incremental
+
+        async def try_propose():
+            while True:
+                target = next(
+                    (m for m in mons if m.is_leader), None
+                )
+                if target is not None:
+                    try:
+                        await target._propose_osdmap(
+                            Incremental(epoch=target.osdmap.epoch + 1,
+                                        new_down=[5])
+                        )
+                        return
+                    except RuntimeError:
+                        pass  # leadership churned: retry, like reporters do
+                await asyncio.sleep(0.05)
+
+        await asyncio.wait_for(try_propose(), 30)
+        await wait_until(
+            lambda: all(m.osdmap.is_down(5) for m in mons), timeout=20
+        )
+        for m in mons:
+            await m.stop()
+
+    run(main())
+
+
+def test_reflected_server_proof_rejected():
+    """A fake server that sets nonce_s == nonce_c and echoes the client's
+    own proof back as AUTH_DONE must still fail (domain separation)."""
+
+    async def main():
+        server = Messenger("mon.0", keyring={})
+
+        async def reflecting_auth(stream, conn):
+            req = await stream.recv(None)
+            from ceph_tpu.common.encoding import Decoder, Encoder
+
+            d = Decoder(req.payload)
+            d.string()
+            nonce_c = d.blob()
+            await stream.send(
+                Frame(Tag.AUTH_CHALLENGE,
+                      Encoder().blob(nonce_c).bytes()),
+                None,
+            )
+            proof = await stream.recv(None)
+            await stream.send(Frame(Tag.AUTH_DONE, proof.payload), None)
+            return True
+
+        server._server_auth = reflecting_auth
+        server.keyring = {"x": b"y"}  # truthy so the auth path runs
+        await server.bind()
+
+        client = Messenger(
+            "client.good", keyring={"client.good": b"secret-1"}
+        )
+        cd = _Collector()
+        client.dispatcher = cd
+        conn = client.connect(server.my_addr, Policy.lossy_client())
+        conn.send_message(Message(type="ping", data=b"zz"))
+        await asyncio.sleep(0.5)
+        assert not conn.is_connected, "reflected proof was accepted"
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+def test_partitioned_leader_steps_down():
+    """Missing a majority of lease acks forces the leader to re-elect
+    instead of believing it still leads (mon_lease_ack_timeout role)."""
+
+    async def main():
+        mons, monmap, cfg = await start_cluster(3)
+        leader = next(m for m in mons if m.is_leader)
+        peons = [m for m in mons if m is not leader]
+        for p in peons:
+            await p.stop()
+        interval = cfg.get("mon_lease")
+        factor = cfg.get("mon_lease_ack_timeout_factor")
+        await wait_until(
+            lambda: not leader.is_leader,
+            timeout=interval * factor * 10 + 5,
+        )
+        await leader.stop()
+
+    run(main())
+
+
+class _Collector:
+    def __init__(self):
+        self.messages = []
+        self.resets = 0
+
+    async def ms_dispatch(self, conn, msg):
+        self.messages.append(msg)
+
+    async def ms_handle_accept(self, conn):
+        pass
+
+    async def ms_handle_reset(self, conn):
+        self.resets += 1
+
+
+def test_server_must_prove_secret():
+    """A server impersonator that skips verification and replies a bare
+    AUTH_DONE (no proof) must be refused by the client: mutual auth."""
+
+    async def main():
+        keyring = {"client.good": b"secret-1"}
+
+        server = Messenger("mon.0", keyring={"client.good": b"secret-1"})
+        sd = _Collector()
+        server.dispatcher = sd
+
+        async def fake_auth(stream, conn):
+            # swallow AUTH_REQUEST/AUTH_PROOF, bless the session blindly
+            await stream.recv(None)
+            await stream.send(
+                Frame(Tag.AUTH_CHALLENGE, b"\x10" + b"\x00" * 16), None
+            )
+            await stream.recv(None)
+            await stream.send(Frame(Tag.AUTH_DONE, b""), None)
+            return True
+
+        server._server_auth = fake_auth
+        await server.bind()
+
+        client = Messenger("client.good", keyring=dict(keyring))
+        cd = _Collector()
+        client.dispatcher = cd
+        conn = client.connect(server.my_addr, Policy.lossy_client())
+        conn.send_message(Message(type="ping", data=b"zz"))
+        await asyncio.sleep(0.5)
+        assert not sd.messages, "client sent payload to unproven server"
+        assert not conn.is_connected
+        await client.shutdown()
+        await server.shutdown()
+
+    run(main())
+
+
+def test_write_blocked_below_min_size():
+    """Killing members below pool min_size makes writes fail-retryable
+    instead of acking a write that landed on too few copies."""
+    from ceph_tpu.rados.client import Rados, RadosError
+    from tests.test_cluster_live import REP_POOL, Cluster, wait_until
+
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        rados = Rados("client.ms", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        io = rados.io_ctx(REP_POOL)  # size=3, min_size=2
+        await io.write_full("obj-a", b"healthy")
+
+        # find obj-a's acting set and kill two of its three members
+        osd0 = next(iter(cluster.osds.values()))
+        ps = osd0.object_pg(REP_POOL, "obj-a")
+        acting, primary = osd0.acting_of(REP_POOL, ps)
+        victims = [o for o in acting if o != primary][:2]
+        for v in victims:
+            await cluster.kill_osd(v)
+        await wait_until(
+            lambda: all(
+                osd.osdmap.is_down(v)
+                for v in victims
+                for osd in cluster.osds.values()
+            ),
+            timeout=30,
+        )
+        with pytest.raises(RadosError):
+            await rados.objecter.op_submit(
+                REP_POOL, "obj-a", "write", b"doomed", timeout=4.0
+            )
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_rbd_shrink_truncates_boundary_object():
+    from ceph_tpu.rados.client import Rados
+    from ceph_tpu.rbd import Image
+    from tests.test_cluster_live import REP_POOL, Cluster
+
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        rados = Rados("client.rbd2", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        io = rados.io_ctx(REP_POOL)
+
+        img = await Image.create(io, "volb", size=8192, order=12)
+        await img.write(0, b"\xaa" * 8192)
+        # shrink to mid-object: bytes past 1000 must be gone for good
+        await img.resize(1000)
+        await img.resize(8192)
+        data = await img.read(0, 8192)
+        assert data[:1000] == b"\xaa" * 1000
+        assert data[1000:] == b"\x00" * 7192, "stale bytes re-exposed"
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
