@@ -23,38 +23,42 @@ class FsError(RadosError):
 
 # -- object classes (registered on every OSD) ---------------------------------
 
-def _dir_load(ctx) -> dict:
-    return json.loads(ctx.read().decode()) if ctx.exists() else {}
-
-
-def _dir_store(ctx, entries: dict) -> None:
-    ctx.write(json.dumps(entries, sort_keys=True).encode())
-
-
 def _dir_link(ctx, inp):
-    entries = _dir_load(ctx)
-    name = inp["name"]
-    if name in entries and not inp.get("replace", False):
-        raise ClsError("EEXIST", f"entry {name!r} exists")
-    entries[name] = {"ino": inp["ino"], "type": inp["type"]}
-    _dir_store(ctx, entries)
+    """Dentries are real omap rows (name -> json {ino,type}): dirfrag
+    commits touch one row, not a whole-directory blob (CDir dentry
+    storage is omap in the reference too)."""
+    name = inp["name"].encode()
+    if ctx.omap_get_val(name) is not None and not inp.get(
+        "replace", False
+    ):
+        raise ClsError("EEXIST", f"entry {inp['name']!r} exists")
+    ctx.omap_set(
+        {name: json.dumps(
+            {"ino": inp["ino"], "type": inp["type"]}
+        ).encode()}
+    )
     return {}
 
 
 def _dir_unlink(ctx, inp):
-    entries = _dir_load(ctx)
-    name = inp["name"]
-    if name not in entries:
-        raise ClsError("ENOENT", f"no entry {name!r}")
-    if inp.get("must_be") and entries[name]["type"] != inp["must_be"]:
-        raise ClsError("EINVAL", f"{name!r} is {entries[name]['type']}")
-    removed = entries.pop(name)
-    _dir_store(ctx, entries)
-    return {"removed": removed}
+    name = inp["name"].encode()
+    raw = ctx.omap_get_val(name)
+    if raw is None:
+        raise ClsError("ENOENT", f"no entry {inp['name']!r}")
+    entry = json.loads(raw)
+    if inp.get("must_be") and entry["type"] != inp["must_be"]:
+        raise ClsError("EINVAL", f"{inp['name']!r} is {entry['type']}")
+    ctx.omap_rm([name])
+    return {"removed": entry}
 
 
 def _dir_list(ctx, inp):
-    return {"entries": _dir_load(ctx)}
+    return {
+        "entries": {
+            k.decode(): json.loads(v)
+            for k, v in ctx.omap_get_vals().items()
+        }
+    }
 
 
 def _ino_alloc(ctx, inp):
@@ -89,7 +93,7 @@ class FileSystem:
 
     async def mkfs(self) -> None:
         """Create the root directory + inode table (ceph fs new)."""
-        await self.ioctx.write_full(_dir_obj(ROOT_INO), b"{}")
+        await self.ioctx.write_full(_dir_obj(ROOT_INO), b"")
         await self.ioctx.write_full("fs.inotable", str(ROOT_INO).encode())
 
     async def _alloc_ino(self) -> int:

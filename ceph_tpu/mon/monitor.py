@@ -120,6 +120,8 @@ class Monitor(Dispatcher):
         self._failure_reports: dict[int, set[str]] = {}
         #: reports received while leaderless, flushed post-election
         self._stashed_reports: list[tuple[str, dict]] = []
+        #: pool -> highest snap id handed out but possibly uncommitted
+        self._pending_snap_seq: dict[int, int] = {}
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
 
@@ -339,6 +341,7 @@ class Monitor(Dispatcher):
         factor = self.config.get("mon_lease_ack_timeout_factor")
         loop = asyncio.get_event_loop()
         self._lease_acks = {r: loop.time() for r in range(self.monmap.size)}
+        missed_rounds = 0
         while self.is_leader and not self._stopped:
             self._bcast(
                 "px_lease",
@@ -350,7 +353,10 @@ class Monitor(Dispatcher):
                 return  # deposed mid-sleep: the new reign is not ours to judge
             # a leader partitioned from its quorum must step down rather
             # than keep proposing against a reign it no longer leads
-            # (lease_ack_timeout in the reference forces a bootstrap)
+            # (lease_ack_timeout in the reference forces a bootstrap).
+            # Two consecutive failed rounds are required: a single stalled
+            # event-loop step can delay every ack past the window without
+            # any partition (all daemons share one loop in tests).
             fresh = sum(
                 1 for r in range(self.monmap.size)
                 if r != self.rank
@@ -358,8 +364,12 @@ class Monitor(Dispatcher):
                 <= interval * factor
             )
             if self.monmap.size > 1 and fresh + 1 < self.monmap.majority:
-                self.start_election()
-                return
+                missed_rounds += 1
+                if missed_rounds >= 2:
+                    self.start_election()
+                    return
+            else:
+                missed_rounds = 0
 
     async def _lease_watchdog(self) -> None:
         interval = self.config.get("mon_lease")
@@ -783,12 +793,21 @@ class Monitor(Dispatcher):
 
     def _flush_stashed_reports(self) -> None:
         stash, self._stashed_reports = self._stashed_reports, []
+
+        async def run_shielded(handler, p):
+            try:
+                await handler(None, p)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # proposal churn: the reporter re-reports
+
         for msg_type, p in stash:
             if self.is_leader:
                 handler = getattr(self, f"_h_{msg_type}", None)
                 if handler is not None:
                     self._tasks.append(
-                        asyncio.create_task(handler(None, p))
+                        asyncio.create_task(run_shielded(handler, p))
                     )
             elif self.leader_rank is not None:
                 self._send(self.leader_rank, msg_type, p)
@@ -800,7 +819,9 @@ class Monitor(Dispatcher):
         target = p["target"]
         if self.osdmap.is_down(target):
             return
-        reporter = p.get("reporter", conn.peer_name)
+        reporter = p.get("reporter") or (
+            conn.peer_name if conn is not None else self.name
+        )
         self._failure_reports.setdefault(target, set()).add(reporter)
         need = self.config.get("mon_osd_min_down_reporters")
         if len(self._failure_reports[target]) >= need:
@@ -971,6 +992,42 @@ class Monitor(Dispatcher):
                 )
             )
             return {"applied": len(new_items), "removed": len(old_items)}
+        if cmd == "osd pool selfmanaged-snap create":
+            # allocate the next snap id for the pool (the OSDMonitor leg
+            # of rados_ioctx_selfmanaged_snap_create): committed through
+            # Paxos so every client/OSD sees a consistent snap_seq.
+            # Concurrent creates must not read the same committed seq —
+            # a leader-local pending high-water covers ids whose commit
+            # is still in flight (stale pendings after churn only skip
+            # ids, never reuse them).
+            pool = self.osdmap.pools.get(args["pool_id"])
+            if pool is None:
+                raise ValueError(f"no pool {args['pool_id']}")
+            pid = args["pool_id"]
+            snapid = max(
+                pool.snap_seq, self._pending_snap_seq.get(pid, 0)
+            ) + 1
+            self._pending_snap_seq[pid] = snapid
+            await self._propose_osdmap(
+                Incremental(
+                    epoch=self.osdmap.epoch + 1,
+                    new_pool_snap_seq={args["pool_id"]: snapid},
+                )
+            )
+            return {"snapid": snapid}
+        if cmd == "osd pool selfmanaged-snap rm":
+            pool = self.osdmap.pools.get(args["pool_id"])
+            if pool is None:
+                raise ValueError(f"no pool {args['pool_id']}")
+            await self._propose_osdmap(
+                Incremental(
+                    epoch=self.osdmap.epoch + 1,
+                    new_removed_snaps={
+                        args["pool_id"]: [args["snapid"]]
+                    },
+                )
+            )
+            return {}
         if cmd == "status":
             return {
                 "epoch": self.osdmap.epoch,

@@ -105,25 +105,32 @@ async def read_frame(reader, session_key: bytes | None = None) -> Frame:
 
 @dataclass
 class Message:
-    """The typed message envelope (ceph_msg_header essentials)."""
+    """The typed message envelope (ceph_msg_header essentials).
+
+    Two segments, like the reference's multi-segment frames
+    (src/msg/async/frames_v2.h: header segment + data segment): `data`
+    carries the small structured header (JSON here), `raw` carries bulk
+    object bytes verbatim — never hex-inflated into the header."""
 
     type: str  #: e.g. "osd_op", "osd_map", "ping" — src/messages/ analogue
     tid: int = 0  #: client transaction id (resend correlation)
     seq: int = 0  #: per-connection sequence (lossless resend/dedup)
     epoch: int = 0  #: sender's map epoch (stale-op fencing)
     data: bytes = b""
+    raw: bytes = b""  #: bulk data segment (bufferlist payload analogue)
 
     def encode(self) -> bytes:
         return (
             Encoder()
             .struct(
-                1,
+                2,
                 1,
                 lambda b: b.string(self.type)
                 .u64(self.tid)
                 .u64(self.seq)
                 .u64(self.epoch)
-                .blob(self.data),
+                .blob(self.data)
+                .blob(self.raw),
             )
             .bytes()
         )
@@ -137,6 +144,7 @@ class Message:
                 seq=b.u64(),
                 epoch=b.u64(),
                 data=b.blob(),
+                raw=b.blob() if version >= 2 else b"",
             )
 
         return Decoder(raw).struct(1, body)

@@ -148,7 +148,9 @@ class _InjectingStream:
 
     async def send(self, frame: Frame, session_key: bytes | None) -> None:
         await self._maybe_inject()
-        self.writer.write(frame.encode(session_key))
+        encoded = frame.encode(session_key)
+        self._m.bytes_sent += len(encoded)
+        self.writer.write(encoded)
         await self.writer.drain()
 
     async def recv(self, session_key: bytes | None) -> Frame:
@@ -443,6 +445,8 @@ class Messenger:
         #: low seqs as duplicates of the dead one's
         self.instance_nonce = int.from_bytes(os.urandom(8), "little")
         self.injected_failures = 0
+        #: total frame bytes written (the wire-inflation diagnostic)
+        self.bytes_sent = 0
 
     # -- lifecycle ------------------------------------------------------------
 

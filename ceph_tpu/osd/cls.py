@@ -81,6 +81,75 @@ class MethodContext:
         if self.user_attrs.pop(key, None) is not None:
             self.dirty = True
 
+    # -- omap (cls_cxx_map_get_vals / set_vals / remove_key) ------------------
+    #
+    # Methods see the object's real omap rows; mutations are tracked as an
+    # exact delta the OSD replicates (EC pools pass omap_supported=False
+    # and methods get EOPNOTSUPP, matching ECBackend's no-omap rule).
+
+    omap: dict = None  # set by the OSD before the call; bytes -> bytes
+    omap_supported: bool = True
+
+    def _require_omap(self) -> dict:
+        if not self.omap_supported:
+            raise ClsError("EOPNOTSUPP", "no omap on this pool")
+        if self.omap is None:
+            self.omap = {}
+        if not hasattr(self, "omap_sets"):
+            self.omap_sets: dict = {}
+            self.omap_rms: list = []
+            self.omap_cleared = False
+        return self.omap
+
+    def omap_get_vals(
+        self, after: bytes | None = None, max_return: int | None = None,
+        prefix: bytes = b"",
+    ) -> dict:
+        omap = self._require_omap()
+        keys = sorted(k for k in omap if k.startswith(prefix))
+        if after is not None:
+            keys = [k for k in keys if k > after]
+        if max_return is not None:
+            keys = keys[:max_return]
+        return {k: omap[k] for k in keys}
+
+    def omap_get_val(self, key: bytes):
+        return self._require_omap().get(key)
+
+    def omap_set(self, kv: dict) -> None:
+        if not self._writable:
+            raise ClsError("EPERM", "RD method attempted a write")
+        omap = self._require_omap()
+        omap.update(kv)
+        self.omap_sets.update(kv)
+        for k in kv:
+            if k in self.omap_rms:
+                self.omap_rms.remove(k)
+        self.dirty = True
+
+    def omap_rm(self, keys) -> None:
+        if not self._writable:
+            raise ClsError("EPERM", "RD method attempted a write")
+        omap = self._require_omap()
+        for k in keys:
+            omap.pop(k, None)
+            self.omap_sets.pop(k, None)
+            if k not in self.omap_rms:
+                self.omap_rms.append(k)
+        self.dirty = True
+
+    def omap_delta(self) -> dict | None:
+        """The replication payload (hex kv), or None when untouched."""
+        if not hasattr(self, "omap_sets"):
+            return None
+        if not (self.omap_sets or self.omap_rms or self.omap_cleared):
+            return None
+        return {
+            "sets": {k.hex(): v.hex() for k, v in self.omap_sets.items()},
+            "rms": [k.hex() for k in self.omap_rms],
+            "clear": self.omap_cleared,
+        }
+
 
 class ClassHandler:
     """(class, method) registry (ClassHandler in src/osd/ClassHandler.h)."""

@@ -54,6 +54,12 @@ from ceph_tpu.mon.client import MonClient
 from ceph_tpu.osd.cls import ClsError, MethodContext, default_handler
 from ceph_tpu.osd.ecutil import HashInfo
 from ceph_tpu.osd.objectstore import KStore, StoreError, Transaction
+from ceph_tpu.osd.ops import (
+    ObjectState,
+    OpError,
+    execute_ops,
+    is_mutating,
+)
 from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE
 
 _NONE = CRUSH_ITEM_NONE
@@ -65,6 +71,32 @@ def pg_coll(pool: int, ps: int) -> str:
 
 def shard_name(name: str, shard: int | None) -> str:
     return name if shard is None else f"{name}.s{shard}"
+
+
+#: reserved xattr carrying the head's SnapSet (osd_types.h SnapSet:
+#: seq + clone list + clone sizes); reserved names are invisible to
+#: client getxattrs and travel with the object through every write,
+#: push, and scrub path because they live in the ordinary xattr blob
+SNAPSET_XATTR = "\x01ss"
+
+
+def snap_store_name(name: str, snapid: int) -> str:
+    """Storage name of a clone object (hobject_t's snap field folded into
+    the key, like shard ids are)."""
+    return f"{name}\x1f{snapid:016x}"
+
+
+def snapdir_name(name: str) -> str:
+    """When the head is deleted but clones survive, the SnapSet parks on
+    this object (the reference's CEPH_SNAPDIR virtual object)."""
+    return f"{name}\x1fsnapdir"
+
+
+def load_snapset(xattrs: dict) -> dict:
+    raw = xattrs.get(SNAPSET_XATTR)
+    if not raw:
+        return {"seq": 0, "clones": [], "sizes": {}}
+    return json.loads(raw)
 
 
 class PG:
@@ -90,9 +122,16 @@ class PG:
         # + json-decoding the whole omap on every write
         self._last_update = 0
         self._inventory: dict[str, dict] = {}
+        #: reqid -> version: client-op dup detection across primary
+        #: failover (the reference scans the pg log for the reqid,
+        #: PrimaryLogPG::check_in_progress_op); entries replicate so a new
+        #: primary inherits the set
+        self._reqids: dict[str, int] = {}
         for e in self._scan_log():
             self._last_update = max(self._last_update, e["version"])
             self._inventory[e["name"]] = e
+            if e.get("reqid"):
+                self._reqids[e["reqid"]] = e["version"]
         #: a primary serves client IO only once peering for the current
         #: interval finished (PeeringState: Peering -> Active); until then
         #: ops bounce with a retryable error, so a revived primary can
@@ -138,6 +177,8 @@ class PG:
         cur = self._inventory.get(entry["name"])
         if cur is None or entry["version"] > cur["version"]:
             self._inventory[entry["name"]] = entry
+        if entry.get("reqid"):
+            self._reqids[entry["reqid"]] = entry["version"]
 
     def latest_objects(self) -> dict[str, dict]:
         """name -> newest log entry (the recovery inventory)."""
@@ -193,7 +234,11 @@ class OSDService(Dispatcher):
         self._tids = iter(range(1, 1 << 62))
         self._waiters: dict[int, asyncio.Future] = {}
         self._hb_last: dict[int, float] = {}
-        self._reported: set[int] = set()
+        #: peer -> last failure-report time; reports repeat every grace
+        #: interval while the peer stays silent and up-in-map (a one-shot
+        #: report can be lost to mon leadership churn, and the mon counts
+        #: distinct reporters, not report instances, so repeats are safe)
+        self._reported: dict[int, float] = {}
         #: (pool, ps, name) -> [(conn, watcher, cookie)] watch sessions
         self._watchers: dict[tuple, list] = {}
         self._notify_waiters: dict[tuple, asyncio.Future] = {}
@@ -218,6 +263,7 @@ class OSDService(Dispatcher):
         self._op_shards = [_OpShard() for _ in range(4)]
         self._tasks: list[asyncio.Task] = []
         self._ephemeral: set[asyncio.Task] = set()
+        self._next_reboot = 0.0
         self._stopped = False
         self.mon.on_map_change(self._note_map)
         self._map_dirty = asyncio.Event()
@@ -321,9 +367,12 @@ class OSDService(Dispatcher):
         return self.messenger.connect(tuple(addr), Policy.lossless_client())
 
     async def _peer_call(
-        self, osd: int, msg_type: str, payload: dict, timeout: float = 10.0
+        self, osd: int, msg_type: str, payload: dict,
+        timeout: float = 10.0, raw: bytes = b"",
     ) -> dict:
-        """Request/response to a peer OSD (sub-op + ack)."""
+        """Request/response to a peer OSD (sub-op + ack). Bulk bytes ride
+        the raw frame segment, never hex-in-JSON (frames_v2 multi-segment
+        shape); the reply's raw segment surfaces as reply["_raw"]."""
         tid = next(self._tids)
         payload = dict(payload)
         payload["tid"] = tid
@@ -334,25 +383,28 @@ class OSDService(Dispatcher):
             self._osd_conn(osd).send_message(
                 Message(type=msg_type, tid=tid,
                         epoch=self.osdmap.epoch,
-                        data=json.dumps(payload).encode())
+                        data=json.dumps(payload).encode(), raw=raw)
             )
             return await asyncio.wait_for(fut, timeout)
         finally:
             self._waiters.pop(tid, None)
 
-    def _reply_peer(self, conn, tid: int, payload: dict) -> None:
+    def _reply_peer(
+        self, conn, tid: int, payload: dict, raw: bytes = b""
+    ) -> None:
         payload = dict(payload)
         payload["tid"] = tid
         conn.send_message(
             Message(type="sub_reply", tid=tid,
                     epoch=self.osdmap.epoch,
-                    data=json.dumps(payload).encode())
+                    data=json.dumps(payload).encode(), raw=raw)
         )
 
     # -- dispatch -------------------------------------------------------------
 
     async def ms_dispatch(self, conn, msg: Message) -> None:
         p = json.loads(msg.data) if msg.data else {}
+        p["_raw"] = msg.raw  # the bulk data segment, bytes verbatim
         if msg.type == "sub_reply":
             fut = self._waiters.get(p.get("tid"))
             if fut is not None and not fut.done():
@@ -373,33 +425,58 @@ class OSDService(Dispatcher):
         return peers
 
     async def _heartbeat_loop(self) -> None:
+        """Periodic concurrent pings + a separate deadline scan (the
+        reference's tick-driven MOSDPing send vs heartbeat_check split,
+        OSD.cc:4547/4746): a ping RPC gets the full grace to come home, so
+        a momentarily busy event loop never fakes peer silence, and one
+        dead peer never stalls pings to the others."""
         interval = self.config.get("osd_heartbeat_interval")
         grace = self.config.get("osd_heartbeat_grace")
         loop = asyncio.get_event_loop()
+
+        async def ping(peer: int) -> None:
+            try:
+                await self._peer_call(peer, "osd_ping", {}, timeout=grace)
+                self._hb_last[peer] = loop.time()
+                self._reported.pop(peer, None)
+            except (asyncio.TimeoutError, RuntimeError):
+                pass  # the deadline scan decides what silence means
+
+        prev_iter = loop.time()
         while not self._stopped:
-            for peer in self._hb_peers():
+            if loop.time() - prev_iter > interval * 3:
+                # OUR loop stalled (jit compile, GC, CPU burst): peers'
+                # apparent silence is our own fault — forgive it rather
+                # than report healthy daemons (HeartbeatMap's is_healthy
+                # self-check role)
+                for peer in list(self._hb_last):
+                    self._hb_last[peer] = max(
+                        self._hb_last[peer], loop.time() - interval
+                    )
+            prev_iter = loop.time()
+            peers = self._hb_peers()
+            for peer in peers:
                 if self.osdmap.is_down(peer):
                     self._hb_last.pop(peer, None)
-                    self._reported.discard(peer)
+                    self._reported.pop(peer, None)
                     continue
                 self._hb_last.setdefault(peer, loop.time())
-                try:
-                    await self._peer_call(
-                        peer, "osd_ping", {}, timeout=interval
-                    )
-                    self._hb_last[peer] = loop.time()
-                    self._reported.discard(peer)
-                except (asyncio.TimeoutError, RuntimeError):
-                    silent = loop.time() - self._hb_last.get(
-                        peer, loop.time()
-                    )
-                    if silent > grace and peer not in self._reported:
-                        if (d := self.dlog.dout(1)) is not None:
-                            d(f"peer osd.{peer} silent {silent:.1f}s: "
-                              f"reporting failure")
-                        self.mon.report_failure(peer)
-                        self._reported.add(peer)
-                        self.perf.inc("heartbeat_failures")
+                self._spawn(ping(peer))
+            for peer in list(self._hb_last):
+                if peer not in peers or self.osdmap.is_down(peer):
+                    continue
+                silent = loop.time() - self._hb_last[peer]
+                last_report = self._reported.get(peer)
+                if silent > grace and (
+                    last_report is None
+                    or loop.time() - last_report > grace
+                ):
+                    if (d := self.dlog.dout(1)) is not None:
+                        d(f"peer osd.{peer} silent {silent:.1f}s: "
+                          f"reporting failure")
+                    self.mon.report_failure(peer)
+                    self._reported[peer] = loop.time()
+                    self.perf.inc("heartbeat_failures")
             await asyncio.sleep(interval)
 
     async def _h_osd_ping(self, conn, p) -> None:
@@ -424,6 +501,30 @@ class OSDService(Dispatcher):
 
     async def _handle_map_change(self) -> None:
         m = self.osdmap
+        # alive but marked down (a false failure report, or mon churn ate
+        # our boot): re-boot, the reference's OSD::start_boot-on-mark-down
+        # behavior — without this a spurious down mark is permanent
+        loop = asyncio.get_event_loop()
+        if (
+            self.id >= m.max_osd
+            or not m.osd_up[self.id]
+            or m.osd_addrs.get(self.id) != tuple(self.messenger.my_addr)
+        ):
+            if loop.time() >= self._next_reboot:
+                self._next_reboot = loop.time() + 1.0
+                self.mon.send_boot(
+                    self.id, tuple(self.messenger.my_addr),
+                    location=self.crush_location,
+                )
+
+            async def renudge():
+                # the boot can be lost to mon churn; keep retrying until
+                # a committed map shows us up again
+                await asyncio.sleep(1.1)
+                self._map_dirty.set()
+
+            self._spawn(renudge())
+            return
         mine: set[tuple[int, int]] = set()
         for pool_id, pool in m.pools.items():
             for ps in range(pool.pg_num):
@@ -467,6 +568,63 @@ class OSDService(Dispatcher):
                 self._map_dirty.set()
 
             self._spawn(nudge())
+        self._spawn(self._trim_removed_snaps())
+
+    async def _trim_removed_snaps(self) -> None:
+        """SnapTrimmer: drop clones whose snap was deleted from the pool
+        (PrimaryLogPG's SnapTrimmer machinery; removed_snaps is the
+        OSDMap-carried work queue). Primaries trim their own PGs; the
+        deletes replicate like any delete."""
+        for (pool_id, ps), pg in list(self.pgs.items()):
+            pool = self.osdmap.pools.get(pool_id)
+            if pool is None or not pool.removed_snaps or not pg.active:
+                continue
+            acting, primary = self.acting_of(pool_id, ps)
+            if primary != self.id:
+                continue
+            removed = set(pool.removed_snaps)
+            for sname, entry in list(pg.latest_objects().items()):
+                if entry["kind"] != "modify":
+                    continue
+                if "\x1f" in sname and not sname.endswith("snapdir"):
+                    continue  # clones are trimmed via their snapset owner
+                name = (
+                    sname[: -len("\x1fsnapdir")]
+                    if sname.endswith("\x1fsnapdir") else sname
+                )
+                is_snapdir = sname != name
+                ss = load_snapset(self._head_xattrs(pg, acting, sname))
+                doomed = [c for c in ss["clones"] if c in removed]
+                if not doomed:
+                    continue
+                try:
+                    async with pg.lock:
+                        for c in doomed:
+                            await self._primary_delete(
+                                pg, acting, snap_store_name(name, c)
+                            )
+                        ss["clones"] = [
+                            c for c in ss["clones"] if c not in removed
+                        ]
+                        for c in doomed:
+                            ss["sizes"].pop(str(c), None)
+                        if is_snapdir and not ss["clones"]:
+                            # last clone gone: the snapdir evaporates
+                            await self._primary_delete(pg, acting, sname)
+                        else:
+                            await self._primary_ops(
+                                pg, acting, sname,
+                                [{"op": "setxattr",
+                                  "name": SNAPSET_XATTR,
+                                  "value": json.dumps(
+                                      ss
+                                  ).encode().hex()}],
+                                [], None,
+                            )
+                except (asyncio.CancelledError,):
+                    raise
+                except Exception:
+                    continue  # next map change retries
 
     async def _peer_and_recover(self, pg: PG, acting: list[int]) -> bool:
         """GetInfo -> GetLog -> GetMissing -> push, one pass. True only
@@ -525,11 +683,30 @@ class OSDService(Dispatcher):
                 if got is None:
                     return False  # retry the whole tail next pass
                 data, attrs = got
-                txn.write(pg.coll, want, data, attrs=attrs)
+                self._write_fetched(txn, pg.coll, want, data, attrs)
             pg.append_log(txn, e)
             self.store.queue_transaction(txn)
             self.perf.inc("recovery_pulls")
         return True
+
+    def _write_fetched(
+        self, txn: Transaction, coll: str, sname: str, data: bytes,
+        attrs: dict,
+    ) -> None:
+        """Store a recovered copy/shard, applying the _omap rider as real
+        omap rows (replacing any stale local ones)."""
+        attrs = dict(attrs)
+        omap_hex = attrs.pop("_omap", None)
+        txn.write(coll, sname, data, attrs=attrs)
+        if omap_hex:
+            existing = self.store.omap_get(coll, sname)
+            if existing:
+                txn.omap_rmkeys(coll, sname, list(existing))
+            txn.omap_setkeys(
+                coll, sname,
+                {bytes.fromhex(k): bytes.fromhex(v)
+                 for k, v in omap_hex.items()},
+            )
 
     def _my_shard(self, pg: PG, acting: list[int]) -> int | None:
         if self.codec(pg.pool) is None:
@@ -572,7 +749,10 @@ class OSDService(Dispatcher):
         return out
 
     async def _fetch_copy(self, pg: PG, sname: str, ver: int, candidates):
-        """First current-version (data, attrs) among candidates, or None."""
+        """First current-version (data, attrs) among candidates, or None.
+        attrs may carry an "_omap" rider: the object's user omap travels
+        with its data during recovery (hex kv; applied, never stored as an
+        attr)."""
         for osd in candidates:
             if osd == self.id:
                 try:
@@ -581,6 +761,12 @@ class OSDService(Dispatcher):
                 except StoreError:
                     continue
                 if attrs.get("ver") == ver:
+                    omap = self.store.omap_get(pg.coll, sname)
+                    if omap:
+                        attrs = dict(attrs)
+                        attrs["_omap"] = {
+                            k.hex(): v.hex() for k, v in omap.items()
+                        }
                     return data, attrs
                 continue
             try:
@@ -592,7 +778,7 @@ class OSDService(Dispatcher):
             except (asyncio.TimeoutError, RuntimeError):
                 continue
             if rep.get("ok"):
-                return bytes.fromhex(rep["data"]), _attrs_from(rep)
+                return rep["_raw"], _attrs_from(rep)
         return None
 
     async def _rebuild_shard(
@@ -663,11 +849,12 @@ class OSDService(Dispatcher):
             shard = pos if ec is not None else None
             for e in pg.log_entries(since):
                 latest = inventory.get(e["name"])
+                raw = b""
                 if latest is None or latest["version"] != e["version"]:
                     # superseded entry: the newest one will carry the data
-                    payload = {"entry": e, "data": None}
+                    payload = {"entry": e, "has_data": False}
                 elif e["kind"] == "delete":
-                    payload = {"entry": e, "data": None}
+                    payload = {"entry": e, "has_data": False}
                 else:
                     got = await self._object_for_push(
                         pg, e, shard, acting
@@ -675,10 +862,10 @@ class OSDService(Dispatcher):
                     if got is None:
                         complete = False  # sources unavailable right now
                         continue
-                    data, attrs = got
+                    raw, attrs = got
                     payload = {
                         "entry": e,
-                        "data": data.hex(),
+                        "has_data": True,
                         "attrs": _attrs_to(attrs),
                     }
                 try:
@@ -686,7 +873,7 @@ class OSDService(Dispatcher):
                         osd, "obj_push",
                         {"pgid": [pg.pool, pg.ps],
                          "shard": shard, **payload},
-                        timeout=5.0,
+                        timeout=5.0, raw=raw,
                     )
                     self.perf.inc("recovery_pushes")
                 except (asyncio.TimeoutError, RuntimeError):
@@ -739,9 +926,15 @@ class OSDService(Dispatcher):
         if p.get("ver") is not None and attrs.get("ver") != p["ver"]:
             self._reply_peer(conn, p["tid"], {"ok": False, "stale": True})
             return
+        attrs_out = _attrs_to(attrs)
+        omap = self.store.omap_get(p["coll"], p["name"])
+        if omap:
+            attrs_out["_omap"] = {
+                k.hex(): v.hex() for k, v in omap.items()
+            }
         self._reply_peer(
             conn, p["tid"],
-            {"ok": True, "data": data.hex(), "attrs": _attrs_to(attrs)},
+            {"ok": True, "attrs": attrs_out}, raw=data,
         )
 
     async def _h_obj_push(self, conn, p) -> None:
@@ -751,12 +944,11 @@ class OSDService(Dispatcher):
         txn = Transaction()
         if e["version"] > pg.last_update:
             pg.append_log(txn, e)
-        if p.get("data") is not None:
-            txn.write(
-                pg.coll,
+        if p.get("has_data"):
+            self._write_fetched(
+                txn, pg.coll,
                 shard_name(e["name"], p.get("shard")),
-                bytes.fromhex(p["data"]),
-                attrs=_attrs_from(p),
+                p["_raw"], _attrs_from(p),
             )
         elif e["kind"] == "delete":
             txn.remove(pg.coll, shard_name(e["name"], p.get("shard")))
@@ -773,11 +965,17 @@ class OSDService(Dispatcher):
                 txn = Transaction()
                 if e["kind"] == "delete":
                     txn.remove(pg.coll, e["name"])
+                elif e["kind"] == "clone":
+                    self._local_clone(txn, pg, e["src"], e["name"])
                 else:
                     txn.write(
-                        pg.coll, e["name"], bytes.fromhex(p["data"]),
+                        pg.coll, e["name"], p["_raw"],
                         attrs=_attrs_from(p),
                     )
+                    if p.get("omap_delta"):
+                        self._omap_delta_txn(
+                            txn, pg.coll, e["name"], p["omap_delta"]
+                        )
                 pg.append_log(txn, e)
                 self.store.queue_transaction(txn)
                 self.perf.inc("subop_w")
@@ -794,11 +992,17 @@ class OSDService(Dispatcher):
                     txn.remove(
                         pg.coll, shard_name(e["name"], p["shard"])
                     )
+                elif e["kind"] == "clone":
+                    self._local_clone(
+                        txn, pg,
+                        shard_name(e["src"], p["shard"]),
+                        shard_name(e["name"], p["shard"]),
+                    )
                 else:
                     txn.write(
                         pg.coll,
                         shard_name(e["name"], p["shard"]),
-                        bytes.fromhex(p["data"]),
+                        p["_raw"],
                         attrs=_attrs_from(p),
                     )
                 pg.append_log(txn, e)
@@ -825,7 +1029,7 @@ class OSDService(Dispatcher):
         ]
         shard.queue.enqueue(
             63,  # osd_client_op_priority
-            max(1, len(p.get("data", "")) // 8192),
+            max(1, len(p["_raw"]) // 4096),
             (conn, p),
             klass=conn.peer_name,
         )
@@ -872,23 +1076,45 @@ class OSDService(Dispatcher):
                 raise RuntimeError(
                     f"pg {pool_id}.{ps} is peering"
                 )  # retryable: no errno, the client resends
-            if p["op"] == "write":
-                async with pg.lock:
-                    await self._primary_write(
-                        pg, acting, name, bytes.fromhex(p["data"])
+            reply_raw = b""
+            if p["op"] in ("ops", "write", "delete"):
+                if p["op"] == "ops":
+                    ops, datas, off = p["ops"], [], 0
+                    for ln in p.get("data_lens", []):
+                        datas.append(p["_raw"][off: off + ln])
+                        off += ln
+                elif p["op"] == "write":
+                    ops, datas = [{"op": "write_full"}], [p["_raw"]]
+                else:
+                    ops, datas = [{"op": "delete"}], []
+                # instance nonce distinguishes a restarted client whose
+                # fresh tid counter would otherwise collide with its old
+                # reqids (osd_reqid_t carries the client instance too)
+                reqid = (
+                    f"{conn.peer_name}.{conn.peer_nonce}:{p['tid']}"
+                )
+                if is_mutating(ops):
+                    async with pg.lock:
+                        op_results, reply_raw = await self._primary_ops(
+                            pg, acting, name, ops, datas, reqid,
+                            snapc=p.get("snapc"),
+                        )
+                    self.perf.inc("op_w")
+                else:
+                    op_results, reply_raw = await self._primary_ops(
+                        pg, acting, name, ops, datas, None,
+                        snapid=p.get("snapid"),
                     )
-                self.perf.inc("op_w")
-                result = {}
-            elif p["op"] == "delete":
-                async with pg.lock:
-                    await self._primary_delete(pg, acting, name)
-                result = {}
+                    self.perf.inc("op_r")
+                result = {"results": op_results}
             elif p["op"] == "read":
-                result = {
-                    "data": (
-                        await self._primary_read(pg, acting, name)
-                    ).hex()
-                }
+                rname = name
+                if p.get("snapid") is not None:
+                    rname = self._resolve_snap(
+                        pg, acting, name, p["snapid"]
+                    )
+                reply_raw = await self._primary_read(pg, acting, rname)
+                result = {}
                 self.perf.inc("op_r")
             elif p["op"] == "stat":
                 result = self._primary_stat(pg, name)
@@ -909,17 +1135,19 @@ class OSDService(Dispatcher):
             else:
                 raise RuntimeError(f"unknown op {p['op']!r}")
             reply = {"tid": p["tid"], "ok": True, **result}
-        except (StoreError, ClsError) as e:
+        except (StoreError, ClsError, OpError) as e:
             # permanent, client-visible errno (ENOENT/EBUSY/...): the
             # client surfaces these instead of retrying
             reply = {"tid": p["tid"], "ok": False, "error": str(e),
                      "errno": e.code}
+            reply_raw = b""
         except Exception as e:
             reply = {"tid": p["tid"], "ok": False, "error": str(e)}
+            reply_raw = b""
         conn.send_message(
             Message(type="osd_op_reply", tid=p["tid"],
                     epoch=self.osdmap.epoch,
-                    data=json.dumps(reply).encode())
+                    data=json.dumps(reply).encode(), raw=reply_raw)
         )
 
     def _obj_version(self, pg: PG, name: str) -> int:
@@ -943,13 +1171,463 @@ class OSDService(Dispatcher):
                 f"below min_size {pool.min_size}"
             )
 
+    async def _sub_op_persist(
+        self, pg: PG, osd: int, mtype: str, payload: dict, raw: bytes = b""
+    ) -> None:
+        """Send a sub-op and retry until it acks, the target leaves the
+        map, or the interval changes under us. Within one interval every
+        acting member therefore applies every entry IN ORDER — the
+        invariant that lets op-vector sub-ops mutate replica state
+        incrementally (a skipped entry would diverge a replica silently).
+        The reference gets the same guarantee from ordered lossless
+        sessions plus peering on connection loss."""
+        start_acting, start_primary = self.acting_of(pg.pool, pg.ps)
+        while True:
+            if self.osdmap.is_down(osd):
+                return  # peering will resync it when it returns
+            acting, primary = self.acting_of(pg.pool, pg.ps)
+            if primary != self.id or osd not in acting:
+                raise RuntimeError(
+                    f"pg {pg.pool}.{pg.ps} interval changed mid-write"
+                )
+            try:
+                rep = await self._peer_call(
+                    osd, mtype, payload, timeout=2.0, raw=raw
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                await asyncio.sleep(0.05)
+                continue  # down-mark or ack resolves the wait
+            if rep.get("ok"):
+                return
+            await asyncio.sleep(0.05)
+
+    # -- the object context (do_osd_ops execution) ----------------------------
+
+    def _load_state_local(self, pg: PG, name: str) -> ObjectState:
+        """ObjectState from the local store (replicated pools; also used
+        by replicas applying op vectors)."""
+        entry = pg.latest_objects().get(name)
+        exists = entry is not None and entry["kind"] != "delete"
+        state = ObjectState(exists=exists)
+        if exists:
+            try:
+                state.data = bytearray(self.store.read(pg.coll, name))
+            except StoreError:
+                state.data = bytearray()
+            attrs = self.store.getattrs(pg.coll, name)
+            blob = attrs.get("xattr")
+            if blob:
+                state.xattrs = {
+                    k: bytes.fromhex(v)
+                    for k, v in json.loads(blob).items()
+                }
+            state.omap = self.store.omap_get(pg.coll, name) or None
+        return state
+
+    def _persist_state_txn(
+        self, pg: PG, name: str, state: ObjectState, obj_ver: int,
+        keep_user: bytes | None = None,
+    ) -> Transaction:
+        """Compile the mutated state into a store transaction (replicated
+        object layout: data row + ver/xattr attrs + omap delta)."""
+        txn = Transaction()
+        if state.deleted:
+            txn.remove(pg.coll, name)
+            return txn
+        attrs: dict = {"ver": obj_ver}
+        if state.xattrs:
+            attrs["xattr"] = json.dumps(
+                {k: v.hex() for k, v in state.xattrs.items()},
+                sort_keys=True,
+            ).encode()
+        if keep_user is not None:
+            attrs["user"] = keep_user
+        txn.write(pg.coll, name, bytes(state.data), attrs=attrs)
+        if state.omap_cleared:
+            existing = self.store.omap_get(pg.coll, name)
+            if existing:
+                txn.omap_rmkeys(pg.coll, name, list(existing))
+        if state.omap_rms:
+            txn.omap_rmkeys(pg.coll, name, state.omap_rms)
+        if state.omap_sets:
+            txn.omap_setkeys(pg.coll, name, state.omap_sets)
+        return txn
+
+    async def _primary_ops(
+        self, pg: PG, acting: list[int], name: str, ops: list[dict],
+        datas: list[bytes], reqid: str | None,
+        snapc: dict | None = None, snapid: int | None = None,
+    ) -> tuple[list[dict], bytes]:
+        """Execute a client op vector (execute_ctx -> do_osd_ops ->
+        issue_repop): run against the object context, and when it mutated,
+        log one entry and replicate — replicated pools ship the op vector
+        for deterministic re-execution, EC pools re-encode the final
+        object and ship whole shards (full-stripe RMW overwrite).
+
+        `snapc` (writes) triggers clone-on-first-write-after-snap
+        (make_writeable); `snapid` (reads) redirects the context to the
+        clone covering that snap."""
+        if reqid is not None and reqid in pg._reqids:
+            # duplicate of an already-committed op (client resend after a
+            # lost reply / primary failover): never re-execute a mutation
+            return [], b""
+        ec = self.codec(pg.pool)
+        mutating = is_mutating(ops)
+        if mutating and snapid is not None:
+            raise OpError("EINVAL", "cannot write at a snapshot")
+        if mutating:
+            self._check_min_size(pg, acting)
+        if snapid is not None:
+            name = self._resolve_snap(pg, acting, name, snapid)
+        if ec is None:
+            state = self._load_state_local(pg, name)
+        else:
+            # EC persistence rewrites whole shards from state.data, so
+            # ANY mutation needs the prior data decoded (the RMW read
+            # leg) — unless the vector's first op replaces or removes the
+            # object outright (ECBackend skips reads for aligned
+            # full-stripe writes for the same reason)
+            if mutating:
+                need_data = ops[0]["op"] not in ("write_full", "delete")
+            else:
+                need_data = any(
+                    op["op"] in ("read", "stat") for op in ops
+                )
+            state = await self._load_state_ec(
+                pg, acting, name, need_data=need_data
+            )
+        pre_snapset = load_snapset(state.xattrs)
+        if mutating and snapc:
+            if not state.exists:
+                # recreate after delete: adopt the snapdir's SnapSet so
+                # older clones stay linked to the new head
+                sd = load_snapset(
+                    self._head_xattrs(pg, acting, snapdir_name(name))
+                )
+                if sd["clones"]:
+                    state.xattrs[SNAPSET_XATTR] = json.dumps(sd).encode()
+            new_ss = await self._make_writeable(
+                pg, acting, name, state, snapc
+            )
+            if new_ss is not None:
+                # the SnapSet update replicates as a real op in the
+                # vector, so every replica's head carries it too
+                ops = [
+                    {"op": "setxattr", "name": SNAPSET_XATTR,
+                     "value": json.dumps(new_ss).encode().hex()}
+                ] + list(ops)
+                pre_snapset = new_ss
+        results, reads = execute_ops(state, ops, datas)
+        if not mutating:
+            return results, b"".join(reads)
+        entry = {
+            "version": pg.last_update + 1,
+            "name": name,
+            "obj_ver": self._obj_version(pg, name) + 1,
+            "kind": "delete" if state.deleted else "modify",
+        }
+        if reqid is not None:
+            entry["reqid"] = reqid
+        if state.deleted and pre_snapset["clones"]:
+            # the head is going away but clones remain: park the SnapSet
+            # on the snapdir object (find_object_context's CEPH_SNAPDIR)
+            await self._primary_ops(
+                pg, acting, snapdir_name(name),
+                [{"op": "setxattr", "name": SNAPSET_XATTR,
+                  "value": json.dumps(pre_snapset).encode().hex()}],
+                [], None,
+            )
+            entry["version"] = pg.last_update + 1
+        if ec is None:
+            user = None
+            try:
+                user = self.store.getattrs(pg.coll, name).get("user")
+            except StoreError:
+                pass
+            txn = self._persist_state_txn(
+                pg, name, state, entry["obj_ver"], keep_user=user
+            )
+            pg.append_log(txn, entry)
+            self.store.queue_transaction(txn)
+            waits = [
+                self._sub_op_persist(
+                    pg, osd, "rep_ops",
+                    {"pgid": [pg.pool, pg.ps], "entry": entry,
+                     "ops": ops,
+                     "data_lens": [len(d) for d in datas]},
+                    raw=b"".join(datas),
+                )
+                for osd in acting
+                if osd not in (self.id, _NONE)
+                and not self.osdmap.is_down(osd)
+            ]
+            if waits:
+                await asyncio.gather(*waits)
+        elif state.deleted:
+            await self._fan_ec_delete(pg, acting, entry)
+        else:
+            # preserve the cls "user" attr across data writes, like the
+            # replicated branch's keep_user (a client append must not
+            # erase a held cls lock)
+            local = shard_name(name, self._my_shard(pg, acting))
+            try:
+                user = self.store.getattrs(pg.coll, local).get("user")
+            except StoreError:
+                user = None
+            await self._fan_ec_write(
+                pg, acting, name, bytes(state.data), entry,
+                xattrs=state.xattrs, user_blob=user,
+            )
+        return results, b"".join(reads)
+
+    def _head_xattrs(self, pg: PG, acting: list[int], name: str) -> dict:
+        """The head object's xattr blob (local copy or our shard)."""
+        ec = self.codec(pg.pool)
+        sname = shard_name(
+            name, self._my_shard(pg, acting) if ec is not None else None
+        )
+        try:
+            blob = self.store.getattrs(pg.coll, sname).get("xattr")
+        except StoreError:
+            blob = None
+        if not blob:
+            return {}
+        return {
+            k: bytes.fromhex(v) for k, v in json.loads(blob).items()
+        }
+
+    def _resolve_snap(
+        self, pg: PG, acting: list[int], name: str, snapid: int
+    ) -> str:
+        """Which object serves a read at `snapid`: the oldest clone whose
+        id >= snapid, else the head (SnapSet resolution,
+        PrimaryLogPG::find_object_context's snapdir walk)."""
+        ss = load_snapset(self._head_xattrs(pg, acting, name))
+        if not ss["clones"]:
+            sd = load_snapset(
+                self._head_xattrs(pg, acting, snapdir_name(name))
+            )
+            if sd["clones"]:
+                ss = sd  # deleted head: the SnapSet parked on snapdir
+        covering = [c for c in sorted(ss["clones"]) if c >= snapid]
+        if not covering:
+            if ss["seq"] >= snapid:
+                # the head was first written AFTER this snap (else that
+                # write would have cloned): the object did not exist at
+                # snap time
+                raise StoreError(
+                    "ENOENT", f"{name!r} did not exist at snap {snapid}"
+                )
+            return name  # head unchanged since the snap: it IS the state
+        return snap_store_name(name, covering[0])
+
+    async def _make_writeable(
+        self, pg: PG, acting: list[int], name: str, state: ObjectState,
+        snapc: dict,
+    ) -> None:
+        """Clone-on-first-write-after-snap (PrimaryLogPG::make_writeable,
+        src/osd/PrimaryLogPG.cc:6500+): when the write's snap context is
+        newer than the head's SnapSet, every acting member copies its
+        LOCAL head (whole object, or its own EC shard — no re-encode) to
+        the clone object before the mutation lands."""
+        ss = load_snapset(state.xattrs)
+        seq = int(snapc.get("seq", 0))
+        if seq <= ss["seq"]:
+            return None
+        if state.exists:
+            cloneid = seq
+            entry = {
+                "version": pg.last_update + 1,
+                "name": snap_store_name(name, cloneid),
+                "obj_ver": self._obj_version(pg, name),
+                "kind": "clone",
+                "src": name,
+            }
+            ec = self.codec(pg.pool)
+            waits = []
+            for pos, osd in enumerate(acting):
+                if osd == _NONE or self.osdmap.is_down(osd):
+                    continue
+                shard = pos if ec is not None else None
+                if osd == self.id:
+                    txn = Transaction()
+                    self._local_clone(
+                        txn, pg,
+                        shard_name(name, shard),
+                        shard_name(entry["name"], shard),
+                    )
+                    pg.append_log(txn, entry)
+                    self.store.queue_transaction(txn)
+                    continue
+                mtype = "ec_sub_write" if ec is not None else "rep_write"
+                waits.append(
+                    self._sub_op_persist(
+                        pg, osd, mtype,
+                        {"pgid": [pg.pool, pg.ps], "shard": shard,
+                         "entry": entry},
+                    )
+                )
+            if waits:
+                await asyncio.gather(*waits)
+            ss["clones"].append(cloneid)
+            ss["sizes"][str(cloneid)] = len(state.data)
+        ss["seq"] = seq
+        return ss
+
+    def _local_clone(
+        self, txn: Transaction, pg: PG, src: str, dst: str
+    ) -> None:
+        """Copy our local copy/shard (data + attrs + omap) to the clone's
+        storage name — clone creation never crosses the wire."""
+        try:
+            data = self.store.read(pg.coll, src)
+            attrs = self.store.getattrs(pg.coll, src)
+        except StoreError:
+            return  # nothing local to clone (recovery will fill it)
+        txn.write(pg.coll, dst, data, attrs=attrs)
+        omap = self.store.omap_get(pg.coll, src)
+        if omap:
+            txn.omap_setkeys(pg.coll, dst, omap)
+
+    async def _load_state_ec(
+        self, pg: PG, acting: list[int], name: str, need_data: bool = True
+    ) -> ObjectState:
+        """EC object context: decode the current object (the RMW read leg,
+        ECBackend::start_rmw's reads), xattrs off our shard's attrs."""
+        entry = pg.latest_objects().get(name)
+        exists = entry is not None and entry["kind"] != "delete"
+        state = ObjectState(exists=exists, omap_supported=False)
+        if exists:
+            if need_data:
+                state.data = bytearray(
+                    await self._primary_read(pg, acting, name)
+                )
+            state.xattrs = self._head_xattrs(pg, acting, name)
+        return state
+
+    async def _fan_ec_write(
+        self, pg: PG, acting: list[int], name: str, data: bytes,
+        entry: dict, xattrs: dict[str, bytes] | None = None,
+        user_blob: bytes | None = None,
+    ) -> None:
+        """Encode and ship whole shards to every acting position
+        (ECBackend sub-write fan-out)."""
+        ec = self.codec(pg.pool)
+        encoded = ec.encode(range(ec.get_chunk_count()), data)
+        hinfo = HashInfo.from_shards(encoded, ec.get_chunk_count())
+        attrs = {"ver": entry["obj_ver"], "hinfo": hinfo,
+                 "size": len(data)}
+        if xattrs:
+            attrs["xattr"] = json.dumps(
+                {k: v.hex() for k, v in xattrs.items()}, sort_keys=True
+            ).encode()
+        if user_blob is not None:
+            attrs["user"] = user_blob
+        waits = []
+        for pos, osd in enumerate(acting):
+            if osd == _NONE or self.osdmap.is_down(osd):
+                continue  # degraded write: that shard stays missing
+            if osd == self.id:
+                txn = Transaction().write(
+                    pg.coll, shard_name(name, pos), encoded[pos],
+                    attrs=attrs,
+                )
+                pg.append_log(txn, entry)
+                self.store.queue_transaction(txn)
+                continue
+            waits.append(
+                self._sub_op_persist(
+                    pg, osd, "ec_sub_write",
+                    {"pgid": [pg.pool, pg.ps], "shard": pos,
+                     "entry": entry, "attrs": _attrs_to(attrs)},
+                    raw=encoded[pos],
+                )
+            )
+        if waits:
+            await asyncio.gather(*waits)
+
+    async def _fan_ec_delete(
+        self, pg: PG, acting: list[int], entry: dict
+    ) -> None:
+        waits = []
+        for pos, osd in enumerate(acting):
+            if osd == _NONE or self.osdmap.is_down(osd):
+                continue
+            if osd == self.id:
+                txn = Transaction().remove(
+                    pg.coll, shard_name(entry["name"], pos)
+                )
+                pg.append_log(txn, entry)
+                self.store.queue_transaction(txn)
+                continue
+            waits.append(
+                self._sub_op_persist(
+                    pg, osd, "ec_sub_write",
+                    {"pgid": [pg.pool, pg.ps], "shard": pos,
+                     "entry": entry},
+                )
+            )
+        if waits:
+            await asyncio.gather(*waits)
+
+    async def _h_rep_ops(self, conn, p) -> None:
+        """Replica-side op-vector application (the sub-op carries the ops,
+        the reference carries the compiled transaction — both re-apply
+        deterministically; _sub_op_persist guarantees in-order arrival)."""
+        pg = self._pg_of(p["pgid"])
+        e = p["entry"]
+        async with pg.lock:
+            if e["version"] > pg.last_update:
+                datas, off = [], 0
+                for ln in p.get("data_lens", []):
+                    datas.append(p["_raw"][off: off + ln])
+                    off += ln
+                state = self._load_state_local(pg, e["name"])
+                try:
+                    execute_ops(state, p["ops"], datas)
+                except OpError:
+                    pass  # primary already validated; state is what counts
+                user = None
+                try:
+                    user = self.store.getattrs(
+                        pg.coll, e["name"]
+                    ).get("user")
+                except StoreError:
+                    pass
+                txn = self._persist_state_txn(
+                    pg, e["name"], state, e["obj_ver"], keep_user=user
+                )
+                pg.append_log(txn, e)
+                self.store.queue_transaction(txn)
+                self.perf.inc("subop_w")
+        self._reply_peer(conn, p["tid"], {"ok": True})
+
+    def _omap_delta_txn(
+        self, txn: Transaction, coll: str, name: str, delta: dict
+    ) -> None:
+        if delta.get("clear"):
+            existing = self.store.omap_get(coll, name)
+            if existing:
+                txn.omap_rmkeys(coll, name, list(existing))
+        if delta.get("rms"):
+            txn.omap_rmkeys(
+                coll, name, [bytes.fromhex(k) for k in delta["rms"]]
+            )
+        if delta.get("sets"):
+            txn.omap_setkeys(
+                coll, name,
+                {bytes.fromhex(k): bytes.fromhex(v)
+                 for k, v in delta["sets"].items()},
+            )
+
     async def _primary_write(
         self, pg: PG, acting: list[int], name: str, data: bytes,
-        user_attrs: dict | None = None,
+        user_attrs: dict | None = None, omap_delta: dict | None = None,
     ) -> None:
         """Full-object write fan-out. `user_attrs` (cls xattrs) ride along
         as a json blob on every replica/shard; a plain client write_full
-        resets them, matching its replace-the-object semantics."""
+        resets them, matching its replace-the-object semantics.
+        `omap_delta` (cls omap mutations) replicates exactly."""
         entry = {
             "version": pg.last_update + 1,
             "name": name,
@@ -966,15 +1644,28 @@ class OSDService(Dispatcher):
             attrs = {"ver": entry["obj_ver"]}
             if user_blob is not None:
                 attrs["user"] = user_blob
+            else:
+                # a plain write_full replaces the object, but cls writes
+                # and client data writes must not clobber each other's
+                # orthogonal attrs
+                try:
+                    old = self.store.getattrs(pg.coll, name)
+                    if old.get("xattr"):
+                        attrs["xattr"] = old["xattr"]
+                except StoreError:
+                    pass
             txn = Transaction().write(pg.coll, name, data, attrs=attrs)
+            if omap_delta:
+                self._omap_delta_txn(txn, pg.coll, name, omap_delta)
             pg.append_log(txn, entry)
             self.store.queue_transaction(txn)
+            payload = {"pgid": [pg.pool, pg.ps], "entry": entry,
+                       "attrs": _attrs_to(attrs)}
+            if omap_delta:
+                payload["omap_delta"] = omap_delta
             waits = [
-                self._peer_call(
-                    osd, "rep_write",
-                    {"pgid": [pg.pool, pg.ps], "entry": entry,
-                     "data": data.hex(), "attrs": _attrs_to(attrs)},
-                )
+                self._sub_op_persist(pg, osd, "rep_write", payload,
+                                     raw=data)
                 for osd in acting
                 if osd not in (self.id, _NONE)
                 and not self.osdmap.is_down(osd)
@@ -982,34 +1673,9 @@ class OSDService(Dispatcher):
             if waits:
                 await asyncio.gather(*waits)
             return
-        encoded = ec.encode(range(ec.get_chunk_count()), data)
-        hinfo = HashInfo.from_shards(encoded, ec.get_chunk_count())
-        attrs = {"ver": entry["obj_ver"], "hinfo": hinfo,
-                 "size": len(data)}
-        if user_blob is not None:
-            attrs["user"] = user_blob
-        waits = []
-        for pos, osd in enumerate(acting):
-            if osd == _NONE or self.osdmap.is_down(osd):
-                continue  # degraded write: that shard stays missing
-            if osd == self.id:
-                txn = Transaction().write(
-                    pg.coll, shard_name(name, pos), encoded[pos],
-                    attrs=attrs,
-                )
-                pg.append_log(txn, entry)
-                self.store.queue_transaction(txn)
-                continue
-            waits.append(
-                self._peer_call(
-                    osd, "ec_sub_write",
-                    {"pgid": [pg.pool, pg.ps], "shard": pos,
-                     "entry": entry, "data": encoded[pos].hex(),
-                     "attrs": _attrs_to(attrs)},
-                )
-            )
-        if waits:
-            await asyncio.gather(*waits)
+        await self._fan_ec_write(
+            pg, acting, name, data, entry, user_blob=user_blob
+        )
 
     async def _primary_delete(
         self, pg: PG, acting: list[int], name: str
@@ -1022,26 +1688,21 @@ class OSDService(Dispatcher):
         }
         self._check_min_size(pg, acting)
         ec = self.codec(pg.pool)
-        waits = []
-        for pos, osd in enumerate(acting):
-            if osd == _NONE or self.osdmap.is_down(osd):
-                continue
-            shard = pos if ec is not None else None
-            if osd == self.id:
-                txn = Transaction().remove(
-                    pg.coll, shard_name(name, shard)
-                )
-                pg.append_log(txn, entry)
-                self.store.queue_transaction(txn)
-                continue
-            mtype = "ec_sub_write" if ec is not None else "rep_write"
-            waits.append(
-                self._peer_call(
-                    osd, mtype,
-                    {"pgid": [pg.pool, pg.ps], "shard": shard,
-                     "entry": entry, "data": None},
-                )
+        if ec is not None:
+            await self._fan_ec_delete(pg, acting, entry)
+            return
+        txn = Transaction().remove(pg.coll, name)
+        pg.append_log(txn, entry)
+        self.store.queue_transaction(txn)
+        waits = [
+            self._sub_op_persist(
+                pg, osd, "rep_write",
+                {"pgid": [pg.pool, pg.ps], "entry": entry},
             )
+            for osd in acting
+            if osd not in (self.id, _NONE)
+            and not self.osdmap.is_down(osd)
+        ]
         if waits:
             await asyncio.gather(*waits)
 
@@ -1111,7 +1772,7 @@ class OSDService(Dispatcher):
                         continue
                     failed = s
                     break
-                chunks[s] = bytes.fromhex(rep["data"])
+                chunks[s] = rep["_raw"]
                 if size is None:
                     size = _attrs_from(rep).get("size")
             if failed is None:
@@ -1129,7 +1790,25 @@ class OSDService(Dispatcher):
         entry = pg.latest_objects().get(name)
         if entry is None or entry["kind"] == "delete":
             raise StoreError("ENOENT", f"no such object {name!r}")
-        return {"obj_ver": entry["obj_ver"], "pg_version": entry["version"]}
+        out = {"obj_ver": entry["obj_ver"],
+               "pg_version": entry["version"]}
+        # size without shipping data: local length (replicated) or the
+        # size attr stamped on our shard (EC) — never a decode read
+        ec = self.codec(pg.pool)
+        acting, _ = self.acting_of(pg.pool, pg.ps)
+        sname = shard_name(
+            name, self._my_shard(pg, acting) if ec is not None else None
+        )
+        try:
+            if ec is None:
+                out["size"] = len(self.store.read(pg.coll, sname))
+            else:
+                size = self.store.getattrs(pg.coll, sname).get("size")
+                if size is not None:
+                    out["size"] = size
+        except StoreError:
+            pass  # mid-recovery: the client's operate fallback covers it
+        return out
 
     async def _primary_call(
         self, pg: PG, acting: list[int], name: str, p: dict
@@ -1154,10 +1833,15 @@ class OSDService(Dispatcher):
                 blob = None
             if blob:
                 user_attrs = json.loads(blob)
+        ec = self.codec(pg.pool)
         ctx = MethodContext(
             data=data,
             user_attrs=user_attrs,
             version=entry["obj_ver"] if exists else 0,
+            omap=(
+                self.store.omap_get(pg.coll, name) if ec is None else None
+            ),
+            omap_supported=ec is None,
         )
         result = self.cls.call(p["cls"], p["method"], ctx, p.get("input"))
         if ctx.dirty:
@@ -1165,6 +1849,7 @@ class OSDService(Dispatcher):
                 pg, acting, name,
                 ctx.data if ctx.data is not None else b"",
                 user_attrs=ctx.user_attrs,
+                omap_delta=ctx.omap_delta(),
             )
         return {"result": result}
 
@@ -1314,7 +1999,7 @@ class OSDService(Dispatcher):
             return "unreachable"
         if not rep.get("ok"):
             return "missing"
-        return bytes.fromhex(rep["data"]), _attrs_from(rep)
+        return rep["_raw"], _attrs_from(rep)
 
     async def _scrub(self, pool_id: int, deep: bool) -> dict:
         """Primary-driven consistency check over this OSD's primary PGs in
@@ -1482,18 +2167,19 @@ class OSDService(Dispatcher):
                 continue
             try:
                 if bad_osd == self.id:
-                    txn = Transaction().write(
-                        pg.coll, shard_name(err["name"], shard), data,
-                        attrs=attrs,
+                    txn = Transaction()
+                    self._write_fetched(
+                        txn, pg.coll, shard_name(err["name"], shard),
+                        data, attrs,
                     )
                     self.store.queue_transaction(txn)
                 else:
                     await self._peer_call(
                         bad_osd, "obj_push",
                         {"pgid": [pid, ps], "shard": shard,
-                         "entry": entry, "data": data.hex(),
+                         "entry": entry, "has_data": True,
                          "attrs": _attrs_to(attrs)},
-                        timeout=5.0,
+                        timeout=5.0, raw=data,
                     )
                 repaired += 1
             except (asyncio.TimeoutError, RuntimeError):

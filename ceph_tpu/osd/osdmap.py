@@ -477,7 +477,7 @@ def _dec_pg(d) -> tuple:
 
 def _enc_pool(e, p: PgPool) -> None:
     e.struct(
-        1,
+        2,
         1,
         lambda b: b.u32(p.pg_num)
         .u32(p.pgp_num)
@@ -486,13 +486,15 @@ def _enc_pool(e, p: PgPool) -> None:
         .u8(p.type)
         .u32(p.crush_rule)
         .u64(p.flags)
-        .string(p.erasure_code_profile),
+        .string(p.erasure_code_profile)
+        .u64(p.snap_seq)
+        .list(sorted(p.removed_snaps), lambda ee, s: ee.u64(s)),
     )
 
 
 def _dec_pool(d) -> PgPool:
     def body(b, version):
-        return PgPool(
+        p = PgPool(
             pg_num=b.u32(),
             pgp_num=b.u32(),
             size=b.u32(),
@@ -502,8 +504,12 @@ def _dec_pool(d) -> PgPool:
             flags=b.u64(),
             erasure_code_profile=b.string(),
         )
+        if version >= 2:
+            p.snap_seq = b.u64()
+            p.removed_snaps = b.list(lambda dd: dd.u64())
+        return p
 
-    return d.struct(1, body)
+    return d.struct(2, body)
 
 
 def _enc_profile(e, prof: dict) -> None:
@@ -550,6 +556,10 @@ class Incremental:
     new_primary_temp: dict = _field(default_factory=dict)
     #: osd -> (host, port) announced at boot
     new_osd_addrs: dict = _field(default_factory=dict)
+    #: pool -> new snap_seq (selfmanaged_snap_create commits)
+    new_pool_snap_seq: dict = _field(default_factory=dict)
+    #: pool -> snap ids to append to removed_snaps (snap deletion)
+    new_removed_snaps: dict = _field(default_factory=dict)
 
     def encode(self) -> bytes:
         def body(b):
@@ -586,8 +596,14 @@ class Incremental:
                       lambda e, v: e.s32(v))
             b.mapping(self.new_osd_addrs, lambda e, k: e.u32(k),
                       lambda e, v: e.string(v[0]).u32(v[1]))
+            b.mapping(self.new_pool_snap_seq, lambda e, k: e.u64(k),
+                      lambda e, v: e.u64(v))
+            b.mapping(
+                self.new_removed_snaps, lambda e, k: e.u64(k),
+                lambda e, v: e.list(sorted(v), lambda ee, s: ee.u64(s)),
+            )
 
-        return _Encoder().struct(1, 1, body).bytes()
+        return _Encoder().struct(2, 1, body).bytes()
 
     @staticmethod
     def decode(raw: bytes) -> "Incremental":
@@ -625,9 +641,17 @@ class Incremental:
             inc.new_osd_addrs = b.mapping(
                 lambda d: d.u32(), lambda d: (d.string(), d.u32())
             )
+            if version >= 2:
+                inc.new_pool_snap_seq = b.mapping(
+                    lambda d: d.u64(), lambda d: d.u64()
+                )
+                inc.new_removed_snaps = b.mapping(
+                    lambda d: d.u64(),
+                    lambda d: d.list(lambda dd: dd.u64()),
+                )
             return inc
 
-        return _Decoder(raw).struct(1, body)
+        return _Decoder(raw).struct(2, body)
 
 
 def apply_incremental(self, inc: Incremental) -> None:
@@ -697,6 +721,14 @@ def apply_incremental(self, inc: Incremental) -> None:
             self.primary_temp.pop(pg, None)
     for osd, addr in inc.new_osd_addrs.items():
         self.osd_addrs[osd] = tuple(addr)
+    for pid, seq in inc.new_pool_snap_seq.items():
+        if pid in self.pools:
+            self.pools[pid].snap_seq = max(self.pools[pid].snap_seq, seq)
+    for pid, snaps in inc.new_removed_snaps.items():
+        if pid in self.pools:
+            cur = set(self.pools[pid].removed_snaps)
+            cur.update(snaps)
+            self.pools[pid].removed_snaps = sorted(cur)
     self.epoch = inc.epoch
 
 

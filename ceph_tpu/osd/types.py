@@ -53,6 +53,12 @@ class PgPool:
     # erasure profile name, carried for the data path (pg_pool_t stores the
     # profile name; the mon holds the name -> profile map)
     erasure_code_profile: str = ""
+    #: self-managed snapshot allocator high-water (pg_pool_t::snap_seq);
+    #: selfmanaged_snap_create returns snap_seq+1 committed via the mon
+    snap_seq: int = 0
+    #: deleted snap ids (pg_pool_t::removed_snaps interval_set, as a flat
+    #: list at mini scale); OSDs trim clones covered only by removed snaps
+    removed_snaps: list = field(default_factory=list)
 
     def __post_init__(self):
         if not self.pgp_num:

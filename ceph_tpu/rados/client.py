@@ -68,6 +68,7 @@ class Objecter(Dispatcher):
     async def ms_dispatch(self, conn, msg: Message) -> None:
         if msg.type in ("osd_op_reply", "osd_admin_reply"):
             p = json.loads(msg.data)
+            p["_raw"] = msg.raw  # bulk read payload (raw frame segment)
             fut = self._waiters.get(p.get("tid"))
             if fut is not None and not fut.done():
                 fut.set_result(p)
@@ -151,6 +152,10 @@ class Objecter(Dispatcher):
     ) -> dict:
         deadline = asyncio.get_event_loop().time() + timeout
         last_error = "timed out"
+        # ONE tid for the op's whole lifetime: resends after a lost reply
+        # must carry the same reqid or the OSD's dup detection can never
+        # recognize them and non-idempotent ops would double-apply
+        tid = next(self._tids)
         while asyncio.get_event_loop().time() < deadline:
             try:
                 primary = self._calc_target(pool_id, name)
@@ -161,12 +166,9 @@ class Objecter(Dispatcher):
                 last_error = str(e)
                 await self._refresh_map()
                 continue
-            tid = next(self._tids)
             payload = {"tid": tid, "pool": pool_id, "name": name, "op": op}
             if extra:
                 payload.update(extra)
-            if data is not None:
-                payload["data"] = data.hex()
             fut = asyncio.get_event_loop().create_future()
             self._waiters[tid] = fut
             try:
@@ -175,7 +177,8 @@ class Objecter(Dispatcher):
                 ).send_message(
                     Message(type="osd_op", tid=tid,
                             epoch=self.osdmap.epoch,
-                            data=json.dumps(payload).encode())
+                            data=json.dumps(payload).encode(),
+                            raw=data or b"")
                 )
                 reply = await asyncio.wait_for(fut, timeout=3.0)
             except asyncio.TimeoutError:
@@ -215,19 +218,170 @@ class IoCtx:
     def __init__(self, objecter: Objecter, pool_id: int):
         self.objecter = objecter
         self.pool_id = pool_id
+        #: selfmanaged snap context applied to writes
+        #: (rados_ioctx_selfmanaged_snap_set_write_ctx)
+        self.snapc: dict | None = None
+        #: snap id applied to reads (rados_ioctx_snap_set_read)
+        self.read_snap: int | None = None
+
+    # -- selfmanaged snapshots ------------------------------------------------
+
+    def set_selfmanaged_snap_context(self, seq: int, snaps) -> None:
+        self.snapc = {"seq": seq, "snaps": sorted(snaps, reverse=True)}
+
+    def snap_set_read(self, snapid: int | None) -> None:
+        self.read_snap = snapid
+
+    async def selfmanaged_snap_create(self) -> int:
+        r = await self.objecter.mon.command(
+            "osd pool selfmanaged-snap create", {"pool_id": self.pool_id}
+        )
+        return r["snapid"]
+
+    async def selfmanaged_snap_remove(self, snapid: int) -> None:
+        await self.objecter.mon.command(
+            "osd pool selfmanaged-snap rm",
+            {"pool_id": self.pool_id, "snapid": snapid},
+        )
+
+    # -- op vectors (ObjectOperation / operate) -------------------------------
+
+    async def operate(
+        self, name: str, ops: list[dict], datas: list[bytes] = (),
+    ) -> list[dict]:
+        """Execute an op vector atomically at the primary
+        (rados_write_op/read_op operate). Data-consuming ops take their
+        payload from `datas` in op order; read results come back in each
+        op's result dict ("data" for reads)."""
+        extra = {"ops": ops, "data_lens": [len(d) for d in datas]}
+        if self.snapc is not None:
+            extra["snapc"] = self.snapc
+        if self.read_snap is not None:
+            extra["snapid"] = self.read_snap
+        rep = await self.objecter.op_submit(
+            self.pool_id, name, "ops",
+            data=b"".join(datas),
+            extra=extra,
+        )
+        results = rep.get("results", [])
+        raw, off = rep["_raw"], 0
+        for res in results:
+            if "data_len" in res:
+                res["data"] = raw[off: off + res["data_len"]]
+                off += res["data_len"]
+        return results
+
+    # -- data ops -------------------------------------------------------------
 
     async def write_full(self, name: str, data: bytes) -> None:
-        await self.objecter.op_submit(self.pool_id, name, "write", data)
+        extra = {"snapc": self.snapc} if self.snapc is not None else None
+        await self.objecter.op_submit(
+            self.pool_id, name, "write", data, extra=extra
+        )
 
-    async def read(self, name: str) -> bytes:
-        rep = await self.objecter.op_submit(self.pool_id, name, "read")
-        return bytes.fromhex(rep["data"])
+    async def write(self, name: str, data: bytes, off: int = 0) -> None:
+        await self.operate(
+            name, [{"op": "write", "off": off}], [data]
+        )
+
+    async def append(self, name: str, data: bytes) -> None:
+        await self.operate(name, [{"op": "append"}], [data])
+
+    async def truncate(self, name: str, size: int) -> None:
+        await self.operate(name, [{"op": "truncate", "size": size}])
+
+    async def zero(self, name: str, off: int, length: int) -> None:
+        await self.operate(
+            name, [{"op": "zero", "off": off, "len": length}]
+        )
+
+    async def read(
+        self, name: str, off: int = 0, length: int | None = None,
+        snapid: int | None = None,
+    ) -> bytes:
+        snap = snapid if snapid is not None else self.read_snap
+        if off == 0 and length is None:
+            extra = {"snapid": snap} if snap is not None else None
+            rep = await self.objecter.op_submit(
+                self.pool_id, name, "read", extra=extra
+            )
+            return rep["_raw"]
+        op = {"op": "read", "off": off}
+        if length is not None:
+            op["length"] = length
+        saved = self.read_snap
+        if snapid is not None:
+            self.read_snap = snapid
+        try:
+            res = await self.operate(name, [op])
+        finally:
+            self.read_snap = saved
+        return res[0]["data"]
 
     async def remove(self, name: str) -> None:
-        await self.objecter.op_submit(self.pool_id, name, "delete")
+        extra = {"snapc": self.snapc} if self.snapc is not None else None
+        await self.objecter.op_submit(
+            self.pool_id, name, "delete", extra=extra
+        )
 
     async def stat(self, name: str) -> dict:
-        return await self.objecter.op_submit(self.pool_id, name, "stat")
+        st = await self.objecter.op_submit(self.pool_id, name, "stat")
+        if "size" not in st:
+            res = await self.operate(name, [{"op": "stat"}])
+            st["size"] = res[0]["size"]
+        return st
+
+    # -- omap (omap_get_vals / omap_set, librados.h) --------------------------
+
+    async def omap_set(self, name: str, kv: dict[bytes, bytes]) -> None:
+        await self.operate(
+            name,
+            [{"op": "omap_set",
+              "kv": {k.hex(): v.hex() for k, v in kv.items()}}],
+        )
+
+    async def omap_get(
+        self, name: str, after: bytes | None = None,
+        max_return: int | None = None,
+    ) -> dict[bytes, bytes]:
+        op = {"op": "omap_get"}
+        if after is not None:
+            op["after"] = after.hex()
+        if max_return is not None:
+            op["max_return"] = max_return
+        res = await self.operate(name, [op])
+        return {
+            bytes.fromhex(k): bytes.fromhex(v)
+            for k, v in res[0]["kv"].items()
+        }
+
+    async def omap_rm(self, name: str, keys) -> None:
+        await self.operate(
+            name, [{"op": "omap_rm", "keys": [k.hex() for k in keys]}]
+        )
+
+    async def omap_clear(self, name: str) -> None:
+        await self.operate(name, [{"op": "omap_clear"}])
+
+    # -- xattrs ---------------------------------------------------------------
+
+    async def setxattr(self, name: str, key: str, value: bytes) -> None:
+        await self.operate(
+            name, [{"op": "setxattr", "name": key, "value": value.hex()}]
+        )
+
+    async def getxattr(self, name: str, key: str) -> bytes:
+        res = await self.operate(name, [{"op": "getxattr", "name": key}])
+        return bytes.fromhex(res[0]["value"])
+
+    async def rmxattr(self, name: str, key: str) -> None:
+        await self.operate(name, [{"op": "rmxattr", "name": key}])
+
+    async def getxattrs(self, name: str) -> dict[str, bytes]:
+        res = await self.operate(name, [{"op": "getxattrs"}])
+        return {
+            k: bytes.fromhex(v) for k, v in res[0]["xattrs"].items()
+        }
 
     async def exec(self, name: str, cls: str, method: str,
                    inp: dict | None = None) -> dict:

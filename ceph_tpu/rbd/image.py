@@ -1,8 +1,8 @@
-"""Image: create/open/read/write/resize on a striped object layout.
+"""Image: create/open/read/write/resize/snapshots on a striped layout.
 
 Layout parity with the reference (src/librbd/ImageCtx + ObjectMap):
 
-  header   "rbd_header.<name>"   json {size, order} — image metadata
+  header   "rbd_header.<name>"   json {size, order, snaps} — metadata
   data     "rbd_data.<name>.<objectno:016x>" — 2^order bytes each, sparse
 
 `read` returns zeros for unwritten ranges (the reference reads an absent
@@ -10,6 +10,12 @@ object as a hole via the object map / ENOENT); `write` loads, patches, and
 rewrites only the touched objects; `resize` truncates or extends, removing
 data objects wholly beyond the new size (ObjectMap-guided trim,
 librbd::Operations::resize).
+
+Snapshots ride RADOS self-managed snaps (librbd::Operations::snap_create,
+src/librbd/Operations.cc): the image allocates a pool snap id, records it
+in the header, and every data write carries the snap context, so object
+clones happen server-side on first-write-after-snap. `snap_rollback`
+copies each object's at-snap state back over the head.
 """
 
 from __future__ import annotations
@@ -26,11 +32,24 @@ class ImageNotFound(RadosError):
 
 
 class Image:
-    def __init__(self, ioctx: IoCtx, name: str, size: int, order: int):
-        self.ioctx = ioctx
+    def __init__(self, ioctx: IoCtx, name: str, size: int, order: int,
+                 snaps: dict | None = None):
+        # a private IoCtx: the snap context is per-image state and must
+        # not leak onto other users of the caller's pool handle
+        self.ioctx = IoCtx(ioctx.objecter, ioctx.pool_id)
         self.name = name
         self.size = size
         self.order = order
+        #: snap name -> {"id": snapid, "size": image size at snap}
+        self.snaps: dict = snaps or {}
+        self._apply_snapc()
+
+    def _apply_snapc(self) -> None:
+        ids = sorted((s["id"] for s in self.snaps.values()), reverse=True)
+        if ids:
+            self.ioctx.set_selfmanaged_snap_context(ids[0], ids)
+        else:
+            self.ioctx.snapc = None
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -63,13 +82,20 @@ class Image:
             header = json.loads(await ioctx.read(cls._header_name(name)))
         except ObjectNotFound as e:
             raise ImageNotFound(f"no image {name!r}") from e
-        return cls(ioctx, name, header["size"], header["order"])
+        return cls(ioctx, name, header["size"], header["order"],
+                   snaps=header.get("snaps"))
 
     async def _save_header(self) -> None:
-        await self.ioctx.write_full(
-            self._header_name(self.name),
-            json.dumps({"size": self.size, "order": self.order}).encode(),
-        )
+        # the header itself is never snapshotted: strip the snapc
+        saved, self.ioctx.snapc = self.ioctx.snapc, None
+        try:
+            await self.ioctx.write_full(
+                self._header_name(self.name),
+                json.dumps({"size": self.size, "order": self.order,
+                            "snaps": self.snaps}).encode(),
+            )
+        finally:
+            self.ioctx.snapc = saved
 
     async def remove(self) -> None:
         objsize = 1 << self.order
@@ -104,15 +130,31 @@ class Image:
                 f"{self.size}"
             )
 
-    async def read(self, off: int, length: int) -> bytes:
-        self._check_span(off, length)
+    async def read(
+        self, off: int, length: int, snap_name: str | None = None
+    ) -> bytes:
+        snapid = None
+        size = self.size
+        if snap_name is not None:
+            meta = self.snaps.get(snap_name)
+            if meta is None:
+                raise RadosError(f"no snap {snap_name!r}")
+            snapid = meta["id"]
+            size = meta["size"]
+        if off < 0 or length < 0 or off + length > size:
+            raise RadosError(
+                f"span [{off}, {off + length}) outside image of size "
+                f"{size}"
+            )
         out = bytearray(length)
         objsize = 1 << self.order
         for objectno, obj_off, obj_len, buf_off in self._extents(
             off, length
         ):
             try:
-                data = await self.ioctx.read(self._data_name(objectno))
+                data = await self.ioctx.read(
+                    self._data_name(objectno), snapid=snapid
+                )
             except ObjectNotFound:
                 continue  # hole: stays zero
             if len(data) < objsize:
@@ -121,6 +163,57 @@ class Image:
                 obj_off: obj_off + obj_len
             ]
         return bytes(out)
+
+    # -- snapshots (librbd::Operations::snap_* family) ------------------------
+
+    async def snap_create(self, snap_name: str) -> int:
+        if snap_name in self.snaps:
+            raise RadosError(f"snap {snap_name!r} exists")
+        snapid = await self.ioctx.selfmanaged_snap_create()
+        self.snaps[snap_name] = {"id": snapid, "size": self.size}
+        self._apply_snapc()
+        await self._save_header()
+        return snapid
+
+    async def snap_remove(self, snap_name: str) -> None:
+        meta = self.snaps.pop(snap_name, None)
+        if meta is None:
+            raise RadosError(f"no snap {snap_name!r}")
+        self._apply_snapc()
+        await self._save_header()
+        # pool-level removal queues the OSD-side clone trim
+        await self.ioctx.selfmanaged_snap_remove(meta["id"])
+
+    async def snap_rollback(self, snap_name: str) -> None:
+        """Copy every object's at-snap state back over the head
+        (Operations.cc snap_rollback); the rollback writes carry the
+        current snap context so they are themselves snapshottable."""
+        meta = self.snaps.get(snap_name)
+        if meta is None:
+            raise RadosError(f"no snap {snap_name!r}")
+        snapid, snap_size = meta["id"], meta["size"]
+        objsize = 1 << self.order
+        cur_objects = (self.size + objsize - 1) // objsize
+        snap_objects = (snap_size + objsize - 1) // objsize
+        for objectno in range(max(cur_objects, snap_objects)):
+            try:
+                data = await self.ioctx.read(
+                    self._data_name(objectno), snapid=snapid
+                )
+                await self.ioctx.write_full(
+                    self._data_name(objectno), data
+                )
+            except ObjectNotFound:
+                # hole (or did not exist) at snap time: drop the head
+                try:
+                    await self.ioctx.remove(self._data_name(objectno))
+                except ObjectNotFound:
+                    pass
+        self.size = snap_size
+        await self._save_header()
+
+    def snap_list(self) -> dict:
+        return dict(self.snaps)
 
     async def write(self, off: int, data: bytes) -> None:
         self._check_span(off, len(data))

@@ -2,10 +2,11 @@
 
 Layout (mirroring RGW's bucket-index design, src/cls/rgw/cls_rgw.cc):
 
-  ".bucket.index.<bucket>"   index object; entries live in its content as
-                             a sorted json map key -> {size, etag, mtime}
-                             mutated ONLY by rgw_index cls methods, so
-                             concurrent gateways update it atomically
+  ".bucket.index.<bucket>"   index object; entries are REAL omap rows
+                             key -> json {size, etag} mutated ONLY by
+                             rgw_index cls methods (cls_cxx_map_*), so
+                             concurrent gateways update atomically and a
+                             million-entry bucket never rewrites a blob
   "<bucket>/<key>"           the object data
 
 List is served by the index class with (prefix, marker, max) pagination —
@@ -23,50 +24,47 @@ from ceph_tpu.rados.client import ObjectNotFound, RadosError
 
 # -- the rgw_index object class (runs inside the primary OSD) -----------------
 
-def _load_index(ctx) -> dict:
-    return json.loads(ctx.read().decode()) if ctx.exists() else {}
-
-
-def _store_index(ctx, index: dict) -> None:
-    ctx.write(json.dumps(index, sort_keys=True).encode())
-
-
 def _index_insert(ctx, inp):
-    index = _load_index(ctx)
-    index[inp["key"]] = inp["meta"]
-    _store_index(ctx, index)
-    return {"count": len(index)}
+    ctx.omap_set(
+        {inp["key"].encode(): json.dumps(inp["meta"]).encode()}
+    )
+    return {}
 
 
 def _index_remove(ctx, inp):
-    index = _load_index(ctx)
-    if inp["key"] not in index:
+    if ctx.omap_get_val(inp["key"].encode()) is None:
         raise ClsError("ENOENT", f"no index entry {inp['key']!r}")
-    del index[inp["key"]]
-    _store_index(ctx, index)
-    return {"count": len(index)}
+    ctx.omap_rm([inp["key"].encode()])
+    return {}
 
 
 def _index_list(ctx, inp):
-    """(prefix, marker, max_entries) pagination (cls_rgw list_op)."""
-    index = _load_index(ctx)
-    prefix = inp.get("prefix", "")
-    marker = inp.get("marker", "")
+    """(prefix, marker, max_entries) pagination (cls_rgw list_op) over
+    the omap rows — ranged key iteration, not a blob scan."""
+    prefix = inp.get("prefix", "").encode()
+    marker = inp.get("marker", "").encode()
     max_entries = int(inp.get("max_entries", 1000))
-    keys = sorted(
-        k for k in index if k.startswith(prefix) and k > marker
+    page = ctx.omap_get_vals(
+        after=marker if marker else None,
+        max_return=max_entries,
+        prefix=prefix,
     )
-    page = keys[:max_entries]
+    more = ctx.omap_get_vals(
+        after=max(page) if page else (marker or None),
+        max_return=1,
+        prefix=prefix,
+    )
     return {
-        "entries": {k: index[k] for k in page},
-        "truncated": len(keys) > len(page),
-        "next_marker": page[-1] if page else marker,
+        "entries": {
+            k.decode(): json.loads(v) for k, v in page.items()
+        },
+        "truncated": bool(more),
+        "next_marker": max(page).decode() if page else inp.get("marker", ""),
     }
 
 
 def _index_stat(ctx, inp):
-    index = _load_index(ctx)
-    return {"count": len(index)}
+    return {"count": len(ctx.omap_get_vals())}
 
 
 def register_rgw_classes(osd_service) -> None:
@@ -85,8 +83,14 @@ class GatewayError(RadosError):
 
 
 class ObjectGateway:
-    def __init__(self, ioctx):
+    """`index_ioctx` defaults to the data pool but must point at a
+    replicated pool when data lives on EC (bucket indexes are omap, and
+    EC pools hold no omap — the reference's index_pool vs data_pool
+    placement split for exactly this reason)."""
+
+    def __init__(self, ioctx, index_ioctx=None):
         self.ioctx = ioctx
+        self.index_ioctx = index_ioctx if index_ioctx is not None else ioctx
 
     @staticmethod
     def _index_obj(bucket: str) -> str:
@@ -98,15 +102,15 @@ class ObjectGateway:
 
     async def create_bucket(self, bucket: str) -> None:
         try:
-            await self.ioctx.stat(self._index_obj(bucket))
+            await self.index_ioctx.stat(self._index_obj(bucket))
             raise GatewayError(f"bucket {bucket!r} exists")
         except ObjectNotFound:
             pass
-        await self.ioctx.write_full(self._index_obj(bucket), b"{}")
+        await self.index_ioctx.write_full(self._index_obj(bucket), b"")
 
     async def bucket_exists(self, bucket: str) -> bool:
         try:
-            await self.ioctx.stat(self._index_obj(bucket))
+            await self.index_ioctx.stat(self._index_obj(bucket))
             return True
         except ObjectNotFound:
             return False
@@ -118,7 +122,7 @@ class ObjectGateway:
             raise GatewayError(f"no bucket {bucket!r}")
         etag = f"{ceph_crc32c(0xFFFFFFFF, data):08x}"
         await self.ioctx.write_full(self._data_obj(bucket, key), data)
-        await self.ioctx.exec(
+        await self.index_ioctx.exec(
             self._index_obj(bucket), "rgw_index", "insert",
             {"key": key, "meta": {"size": len(data), "etag": etag}},
         )
@@ -128,7 +132,7 @@ class ObjectGateway:
         return await self.ioctx.read(self._data_obj(bucket, key))
 
     async def head_object(self, bucket: str, key: str) -> dict:
-        listing = await self.ioctx.exec(
+        listing = await self.index_ioctx.exec(
             self._index_obj(bucket), "rgw_index", "list",
             {"prefix": key, "max_entries": 1},
         )
@@ -138,7 +142,7 @@ class ObjectGateway:
         return meta
 
     async def delete_object(self, bucket: str, key: str) -> None:
-        await self.ioctx.exec(
+        await self.index_ioctx.exec(
             self._index_obj(bucket), "rgw_index", "remove", {"key": key}
         )
         await self.ioctx.remove(self._data_obj(bucket, key))
@@ -150,16 +154,16 @@ class ObjectGateway:
         marker: str = "",
         max_entries: int = 1000,
     ) -> dict:
-        return await self.ioctx.exec(
+        return await self.index_ioctx.exec(
             self._index_obj(bucket), "rgw_index", "list",
             {"prefix": prefix, "marker": marker,
              "max_entries": max_entries},
         )
 
     async def delete_bucket(self, bucket: str) -> None:
-        stat = await self.ioctx.exec(
+        stat = await self.index_ioctx.exec(
             self._index_obj(bucket), "rgw_index", "stat", {}
         )
         if stat["count"]:
             raise GatewayError(f"bucket {bucket!r} not empty")
-        await self.ioctx.remove(self._index_obj(bucket))
+        await self.index_ioctx.remove(self._index_obj(bucket))

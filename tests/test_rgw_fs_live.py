@@ -27,7 +27,8 @@ def test_object_gateway_bucket_semantics():
         rados = Rados("client.rgw", cluster.monmap, config=cluster.cfg)
         await rados.connect()
         await cluster.create_pools(rados)
-        gw = ObjectGateway(rados.io_ctx(EC_POOL))
+        gw = ObjectGateway(rados.io_ctx(EC_POOL),
+                           index_ioctx=rados.io_ctx(REP_POOL))
 
         await gw.create_bucket("photos")
         with pytest.raises(GatewayError, match="exists"):
@@ -77,7 +78,8 @@ def test_object_gateway_bucket_semantics():
         await gw.create_bucket("race")
         rados2 = Rados("client.rgw2", cluster.monmap, config=cluster.cfg)
         await rados2.connect()
-        gw2 = ObjectGateway(rados2.io_ctx(EC_POOL))
+        gw2 = ObjectGateway(rados2.io_ctx(EC_POOL),
+                            index_ioctx=rados2.io_ctx(REP_POOL))
         await asyncio.gather(
             *(gw.put_object("race", f"a{i}", b"1") for i in range(5)),
             *(gw2.put_object("race", f"b{i}", b"2") for i in range(5)),

@@ -1,0 +1,183 @@
+"""Self-managed snapshots: clone-on-write, read-at-snap, snap removal +
+trim — on BOTH pool types (PrimaryLogPG::make_writeable / SnapSet /
+SnapTrimmer; librados selfmanaged snap API)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados.client import ObjectNotFound, Rados
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster, wait_until
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+async def _cluster():
+    cluster = Cluster()
+    await cluster.start()
+    rados = Rados("client.snap", cluster.monmap, config=cluster.cfg)
+    await rados.connect()
+    await cluster.create_pools(rados)
+    return cluster, rados
+
+
+def _snap_objects(cluster, pool_id):
+    """Count clone objects (storage names with the snap separator)."""
+    total = 0
+    for osd in cluster.osds.values():
+        for coll in osd.store.list_collections():
+            if coll.startswith(f"pg_{pool_id}_"):
+                total += sum(
+                    1 for o in osd.store.list_objects(coll)
+                    if "\x1f" in o
+                )
+    return total
+
+
+def test_snapshot_read_at_snap_both_pools():
+    async def main():
+        cluster, rados = await _cluster()
+        for pool in (REP_POOL, EC_POOL):
+            io = rados.io_ctx(pool)
+            await io.write_full("obj", b"version-1")
+
+            snap1 = await io.selfmanaged_snap_create()
+            io.set_selfmanaged_snap_context(snap1, [snap1])
+            await io.write_full("obj", b"version-2 bytes")
+
+            snap2 = await io.selfmanaged_snap_create()
+            io.set_selfmanaged_snap_context(snap2, [snap2, snap1])
+            await io.write("obj", b"PATCH", off=0)
+
+            # head sees the latest; snaps see their frozen pasts
+            assert await io.read("obj") == b"PATCHon-2 bytes"
+            assert await io.read("obj", snapid=snap1) == b"version-1"
+            assert await io.read("obj", snapid=snap2) == (
+                b"version-2 bytes"
+            )
+            io.set_selfmanaged_snap_context(0, [])
+            io.snapc = None
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_snapshot_survives_delete_and_trim():
+    async def main():
+        cluster, rados = await _cluster()
+        io = rados.io_ctx(REP_POOL)
+        await io.write_full("doc", b"precious")
+        snap = await io.selfmanaged_snap_create()
+        io.set_selfmanaged_snap_context(snap, [snap])
+        # delete under the snap context preserves the clone
+        await io.remove("doc")
+        with pytest.raises(ObjectNotFound):
+            await io.read("doc")
+        assert await io.read("doc", snapid=snap) == b"precious"
+        assert _snap_objects(cluster, REP_POOL) > 0
+
+        # removing the snap triggers trim: clones disappear
+        await io.selfmanaged_snap_remove(snap)
+        await wait_until(
+            lambda: _snap_objects(cluster, REP_POOL) == 0, timeout=30
+        )
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_snapshot_trim_ec_pool():
+    async def main():
+        cluster, rados = await _cluster()
+        io = rados.io_ctx(EC_POOL)
+        base = bytes(range(256)) * 16
+        await io.write_full("eobj", base)
+        snap = await io.selfmanaged_snap_create()
+        io.set_selfmanaged_snap_context(snap, [snap])
+        await io.write_full("eobj", b"new content")
+        assert await io.read("eobj", snapid=snap) == base
+        assert _snap_objects(cluster, EC_POOL) > 0
+        await io.selfmanaged_snap_remove(snap)
+        await wait_until(
+            lambda: _snap_objects(cluster, EC_POOL) == 0, timeout=30
+        )
+        # head unaffected by the trim
+        assert await io.read("eobj") == b"new content"
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_rbd_snapshot_create_rollback_remove():
+    """rbd snap_create / read-at-snap / rollback / remove on an EC data
+    pool (librbd::Operations snap family over selfmanaged snaps)."""
+    from ceph_tpu.rbd import Image
+
+    async def main():
+        cluster, rados = await _cluster()
+        io = rados.io_ctx(EC_POOL)
+        img = await Image.create(io, "snapvol", size=16 * 1024, order=12)
+        await img.write(0, b"\x11" * 16 * 1024)
+
+        await img.snap_create("s1")
+        await img.write(4096, b"\x22" * 4096)
+        assert (await img.read(4096, 4096)) == b"\x22" * 4096
+        assert (await img.read(4096, 4096, snap_name="s1")) == (
+            b"\x11" * 4096
+        )
+
+        # reopening sees the snap (it lives in the header)
+        img2 = await Image.open(io, "snapvol")
+        assert "s1" in img2.snap_list()
+        assert (await img2.read(4096, 4096, snap_name="s1")) == (
+            b"\x11" * 4096
+        )
+
+        # rollback restores at-snap content on the head
+        await img2.snap_rollback("s1")
+        assert (await img2.read(4096, 4096)) == b"\x11" * 4096
+
+        await img2.snap_remove("s1")
+        assert "s1" not in img2.snap_list()
+        await wait_until(
+            lambda: _snap_objects(cluster, EC_POOL) == 0, timeout=30
+        )
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_snapshots_replicate_through_failover():
+    """Clones exist on every acting member: primary death must not lose
+    the snapshot history."""
+
+    async def main():
+        cluster, rados = await _cluster()
+        io = rados.io_ctx(REP_POOL)
+        await io.write_full("hist", b"old-state")
+        snap = await io.selfmanaged_snap_create()
+        io.set_selfmanaged_snap_context(snap, [snap])
+        await io.write_full("hist", b"new-state")
+
+        osd0 = next(iter(cluster.osds.values()))
+        ps = osd0.object_pg(REP_POOL, "hist")
+        acting, primary = osd0.acting_of(REP_POOL, ps)
+        await cluster.kill_osd(primary)
+        await wait_until(
+            lambda: all(
+                o.osdmap.is_down(primary) for o in cluster.osds.values()
+            ),
+            timeout=30,
+        )
+        assert await io.read("hist") == b"new-state"
+        assert await io.read("hist", snapid=snap) == b"old-state"
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
