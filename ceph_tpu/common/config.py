@@ -120,6 +120,9 @@ SCHEMA: dict[str, Option] = {
              "PGs per new pool"),
         _opt("osd_recovery_max_active", TYPE_UINT, LEVEL_ADVANCED, 3,
              "concurrent recovery ops per OSD"),
+        _opt("osd_ec_batch_window", TYPE_FLOAT, LEVEL_ADVANCED, 0.002,
+             "seconds the first EC op of a batch waits so concurrent "
+             "objects share one planar device launch"),
         _opt("osd_heartbeat_grace", TYPE_UINT, LEVEL_ADVANCED, 20,
              "seconds before an unresponsive OSD is reported down"),
         _opt("osd_heartbeat_interval", TYPE_FLOAT, LEVEL_ADVANCED, 6.0,

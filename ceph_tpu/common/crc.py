@@ -36,8 +36,45 @@ def _make_table() -> np.ndarray:
 _TABLE = _make_table()
 
 
+def _load_native():
+    """C slicing-by-8 via ctypes (ceph_tpu/native/crc32c.c): the frame
+    checksum and shard hashes are per-byte hot paths that a Python loop
+    turns into the daemon's top CPU sink. Falls back to numpy silently
+    (same bits either way; parity pinned in tests)."""
+    try:
+        import ctypes
+        import os
+
+        from ceph_tpu.native.build import build_shared
+
+        so = build_shared(
+            "crc32c",
+            os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)
+                )),
+                "native", "crc32c.c",
+            ),
+        )
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        fn = lib.ceph_crc32c_native
+        fn.restype = ctypes.c_uint32
+        fn.argtypes = [ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+        return fn
+    except Exception:
+        return None
+
+
+_NATIVE = _load_native()
+
+
 def ceph_crc32c(seed: int, data: bytes | np.ndarray) -> int:
     """crc32c(seed, data) with ceph's conventions (no final xor)."""
+    if _NATIVE is not None:
+        raw = bytes(data)
+        return int(_NATIVE(seed & 0xFFFFFFFF, raw, len(raw)))
     crc = np.uint32(seed & 0xFFFFFFFF)
     buf = np.frombuffer(bytes(data), dtype=np.uint8)
     t = _TABLE

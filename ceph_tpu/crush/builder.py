@@ -220,6 +220,8 @@ def bucket_add_item(
     if item >= 0 and map.max_devices <= item:
         map.max_devices = item + 1
     _adjust_ancestor_weights(map, bucket_id, weight)
+    if map.class_bucket:
+        populate_classes(map)  # shadows must track the real hierarchy
 
 
 def _adjust_ancestor_weights(map: CrushMap, child: int, delta: int) -> None:
@@ -233,3 +235,108 @@ def _adjust_ancestor_weights(map: CrushMap, child: int, delta: int) -> None:
                     "ancestor reweight supports straw2 buckets only"
                 )
             _adjust_ancestor_weights(map, bid, delta)
+
+
+def populate_classes(map: CrushMap) -> None:
+    """Build per-class shadow hierarchies (CrushWrapper::populate_classes /
+    device_class_clone, src/crush/CrushWrapper.cc): for every (bucket,
+    device class) pair, a shadow bucket holding only that class's devices
+    (and the shadow clones of child buckets). A classed rule step
+    (`step take root class ssd`) then TAKEs the shadow id and the mapper —
+    scalar or TPU — needs no class awareness at all: shadows are ordinary
+    buckets.
+
+    Rebuilds from scratch (idempotent): callers re-run it after any
+    hierarchy or class change, the way the reference rebuilds shadows on
+    rebuild_roots.
+    """
+    for sid in set(map.class_bucket.values()):
+        map.buckets.pop(sid, None)
+        map.item_names.pop(sid, None)
+    map.class_bucket = {}
+    classes = sorted(set(map.device_classes.values()))
+    if not classes:
+        return
+
+    # children-first order so a shadow can reference its child shadows
+    order: list[int] = []
+    seen: set[int] = set()
+
+    def visit(bid: int) -> None:
+        if bid in seen:
+            return
+        seen.add(bid)
+        for item in map.buckets[bid].items:
+            if item < 0 and item in map.buckets:
+                visit(item)
+        order.append(bid)
+
+    for bid in sorted(map.buckets, reverse=True):
+        visit(bid)
+
+    next_id = min(map.buckets, default=-1) - 1
+    for cls in classes:
+        for bid in order:
+            b = map.buckets[bid]
+            kept_items: list[int] = []
+            kept_weights: list[int] = []
+            for pos, item in enumerate(b.items):
+                if item >= 0:
+                    if map.device_classes.get(item) == cls:
+                        kept_items.append(item)
+                        kept_weights.append(
+                            b.item_weights[pos]
+                            if pos < len(b.item_weights)
+                            else b.item_weight
+                        )
+                else:
+                    sid = map.class_bucket.get((item, cls))
+                    if sid is not None and map.buckets[sid].items:
+                        kept_items.append(sid)
+                        kept_weights.append(map.buckets[sid].weight)
+            shadow = make_bucket(
+                map, next_id, b.alg, b.type, kept_items, kept_weights,
+                hash=b.hash,
+            )
+            map.class_bucket[(bid, cls)] = shadow.id
+            base = map.item_names.get(bid, f"bucket{-bid}")
+            map.item_names[shadow.id] = f"{base}~{cls}"
+            next_id -= 1
+
+
+def reweight_subtree(
+    map: CrushMap, root_id: int, weight: int
+) -> int:
+    """Set every device under `root_id` to `weight` (16.16) and rebuild
+    bucket weights bottom-up (CrushWrapper::adjust_subtree_weightset /
+    `ceph osd crush reweight-subtree` semantics). Returns the number of
+    devices touched. Straw2 only, like the other mutators here."""
+    touched = 0
+
+    def rebuild(bid: int) -> int:
+        nonlocal touched
+        b = map.buckets[bid]
+        if b.alg != BucketAlg.STRAW2:
+            raise ValueError("reweight_subtree supports straw2 buckets only")
+        total = 0
+        for pos, item in enumerate(b.items):
+            if item >= 0:
+                b.item_weights[pos] = weight
+                touched += 1
+            else:
+                b.item_weights[pos] = rebuild(item)
+            total += b.item_weights[pos]
+        b.weight = total
+        return total
+
+    new = rebuild(root_id)
+    for bid, parent in map.buckets.items():
+        if root_id in parent.items:
+            idx = parent.items.index(root_id)
+            delta = new - parent.item_weights[idx]
+            parent.item_weights[idx] = new
+            parent.weight += delta
+            _adjust_ancestor_weights(map, bid, delta)
+    if map.class_bucket:
+        populate_classes(map)  # shadows must track the real weights
+    return touched

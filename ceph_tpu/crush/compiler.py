@@ -22,9 +22,11 @@ Comments run from '#' to end of line. Weights are parsed as float32 *
 weights, `# do not change unnecessarily` annotations, tunables only when they
 differ from the legacy defaults, DFS bucket ordering, and choose_args blocks.
 
-Device classes are parsed (and round-tripped) but class-filtered TAKE steps
-("step take root class ssd") are rejected: the shadow-hierarchy machinery
-(CrushWrapper::populate_classes) is not implemented yet.
+Device classes: class-filtered TAKE steps ("step take root class ssd")
+compile against per-class shadow hierarchies built lazily on first use
+(builder.populate_classes, mirroring CrushWrapper::populate_classes);
+shadow buckets are derived state — decompile never emits them and instead
+reverse-maps shadow TAKE targets back to `take <bucket> class <c>`.
 """
 
 from __future__ import annotations
@@ -268,14 +270,33 @@ class _Parser:
             op = self.next()
             if op == "take":
                 item_name = self.next()
-                if self.peek() == "class":
-                    raise CompileError(
-                        "class-filtered take steps (shadow hierarchies) are "
-                        "not supported yet"
-                    )
                 if item_name not in self.names:
                     raise CompileError(f"take: unknown item {item_name!r}")
-                steps.append(RuleStep(RuleOp.TAKE, self.names[item_name]))
+                target = self.names[item_name]
+                if self.peek() == "class":
+                    self.next()
+                    cls = self.next()
+                    if cls not in set(self.cmap.device_classes.values()):
+                        raise CompileError(
+                            f"take: unknown device class {cls!r}"
+                        )
+                    # shadow hierarchies are derived state: build them on
+                    # first classed take (all buckets are parsed by now —
+                    # rules follow buckets in the grammar)
+                    if (target, cls) not in self.cmap.class_bucket:
+                        from ceph_tpu.crush.builder import (
+                            populate_classes,
+                        )
+
+                        populate_classes(self.cmap)
+                    shadow = self.cmap.class_bucket.get((target, cls))
+                    if shadow is None:
+                        raise CompileError(
+                            f"take {item_name!r} class {cls!r}: classed "
+                            f"take needs a bucket, not a device"
+                        )
+                    target = shadow
+                steps.append(RuleStep(RuleOp.TAKE, target))
             elif op == "emit":
                 steps.append(RuleStep(RuleOp.EMIT))
             elif op in ("choose", "chooseleaf"):
@@ -413,9 +434,15 @@ def decompile_crushmap(cmap: CrushMap) -> str:
 
     out.append("\n# buckets\n")
     done: set[int] = set()
+    # shadow (per-class clone) buckets are derived state: never emitted,
+    # recompile rebuilds them from the classed take steps
+    shadow_ids = set(cmap.class_bucket.values())
+    shadow_to_class = {
+        sid: (orig, cls) for (orig, cls), sid in cmap.class_bucket.items()
+    }
 
     def emit_bucket(bid: int) -> None:
-        if bid in done or bid not in cmap.buckets:
+        if bid in done or bid not in cmap.buckets or bid in shadow_ids:
             return
         done.add(bid)
         b = cmap.buckets[bid]
@@ -473,7 +500,16 @@ def decompile_crushmap(cmap: CrushMap) -> str:
         out.append(f"\tmax_size {rule.max_size}\n")
         for step in rule.steps:
             if step.op == RuleOp.TAKE:
-                out.append(f"\tstep take {_item_name(cmap, step.arg1)}\n")
+                if step.arg1 in shadow_to_class:
+                    orig, cls = shadow_to_class[step.arg1]
+                    out.append(
+                        f"\tstep take {_item_name(cmap, orig)} "
+                        f"class {cls}\n"
+                    )
+                else:
+                    out.append(
+                        f"\tstep take {_item_name(cmap, step.arg1)}\n"
+                    )
             elif step.op == RuleOp.EMIT:
                 out.append("\tstep emit\n")
             elif step.op in (

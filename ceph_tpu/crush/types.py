@@ -142,9 +142,11 @@ class CrushMap:
     type_names: dict[int, str] = field(default_factory=dict)
     item_names: dict[int, str] = field(default_factory=dict)
     rule_names: dict[int, str] = field(default_factory=dict)
-    # device id -> class name (CrushWrapper class_map; informational until
-    # shadow hierarchies are implemented)
+    # device id -> class name (CrushWrapper class_map)
     device_classes: dict[int, str] = field(default_factory=dict)
+    # (original bucket id, class name) -> shadow bucket id
+    # (CrushWrapper::class_bucket; filled by builder.populate_classes)
+    class_bucket: dict = field(default_factory=dict)
     # every named choose_args map from the text grammar (choose_args <id>);
     # `choose_args` above is the active one the mapper consumes
     choose_args_maps: dict[int, dict[int, ChooseArg]] = field(

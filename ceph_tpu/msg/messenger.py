@@ -38,6 +38,7 @@ import hashlib
 import hmac as hmac_mod
 import os
 import random
+import socket as socket_mod
 from dataclasses import dataclass, field
 
 from ceph_tpu.common.encoding import Decoder, Encoder
@@ -129,6 +130,17 @@ class _InjectingStream:
         self.reader = reader
         self.writer = writer
         self._m = messenger
+        # request/response sub-ops die under Nagle + delayed-ACK
+        # (~200 ms per round trip); the reference sets TCP_NODELAY on
+        # every messenger socket too (AsyncConnection)
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            try:
+                sock.setsockopt(
+                    socket_mod.IPPROTO_TCP, socket_mod.TCP_NODELAY, 1
+                )
+            except OSError:
+                pass
 
     async def _maybe_inject(self) -> None:
         # Always yield once per frame: a burst of writes whose drain()

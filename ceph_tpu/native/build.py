@@ -27,6 +27,28 @@ def plugin_path(name: str, directory: str | None = None) -> str:
     )
 
 
+def build_shared(name: str, source: str) -> str | None:
+    """Compile a standalone helper .so (crc32c etc.); returns the path or
+    None without a toolchain. Same rebuild-on-mtime rule as plugins."""
+    cc = shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
+    if cc is None:
+        return None
+    out = os.path.join(NATIVE_DIR, f"lib{name}.so")
+    if (
+        os.path.exists(out)
+        and os.path.getmtime(out) >= os.path.getmtime(source)
+    ):
+        return out
+    try:
+        subprocess.run(
+            [cc, "-O3", "-shared", "-fPIC", "-o", out, source],
+            check=True, capture_output=True, text=True,
+        )
+    except subprocess.CalledProcessError:
+        return None
+    return out
+
+
 def build_plugin(
     name: str = "native",
     source: str | None = None,

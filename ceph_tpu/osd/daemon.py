@@ -213,6 +213,13 @@ class OSDService(Dispatcher):
         )
         self.pgs: dict[tuple[int, int], PG] = {}
         self.cls = default_handler()  # in-OSD object classes (src/cls)
+        # coalesces concurrent EC encodes/decodes into planar TPU
+        # launches (the batch window is the write-path latency bound)
+        from ceph_tpu.osd.encode_service import EncodeService
+
+        self.encode_service = EncodeService(
+            window=self.config.get("osd_ec_batch_window")
+        )
         # per-daemon perf counters, dumped via the admin surface the way
         # `ceph daemon osd.N perf dump` reads the admin socket
         from ceph_tpu.common.perf_counters import PerfCountersCollection
@@ -264,6 +271,8 @@ class OSDService(Dispatcher):
         self._tasks: list[asyncio.Task] = []
         self._ephemeral: set[asyncio.Task] = set()
         self._next_reboot = 0.0
+        self._acting_cache: dict[tuple[int, int], tuple] = {}
+        self._acting_cache_epoch = -1
         self._stopped = False
         self.mon.on_map_change(self._note_map)
         self._map_dirty = asyncio.Event()
@@ -349,10 +358,21 @@ class OSDService(Dispatcher):
         return self._codecs[pool_id]
 
     def acting_of(self, pool_id: int, ps: int) -> tuple[list[int], int]:
-        _up, _upp, acting, primary = self.osdmap.pg_to_up_acting_osds(
-            pool_id, ps
-        )
-        return acting, primary
+        """Per-epoch memoized placement: heartbeats and per-op targeting
+        would otherwise re-run the scalar CRUSH mapper thousands of times
+        per second for identical answers (the OSDMapMapping cache role)."""
+        m = self.osdmap
+        if self._acting_cache_epoch != m.epoch:
+            self._acting_cache_epoch = m.epoch
+            self._acting_cache = {}
+        hit = self._acting_cache.get((pool_id, ps))
+        if hit is None:
+            _up, _upp, acting, primary = m.pg_to_up_acting_osds(
+                pool_id, ps
+            )
+            hit = (acting, primary)
+            self._acting_cache[(pool_id, ps)] = hit
+        return hit
 
     def object_pg(self, pool_id: int, name: str) -> int:
         from ceph_tpu.common.hash import ceph_str_hash_rjenkins
@@ -806,7 +826,8 @@ class OSDService(Dispatcher):
                 break
         if len(chunks) < ec.get_data_chunk_count():
             return None
-        return ec.decode({shard}, chunks)[shard], attrs
+        decoded = await self.encode_service.decode(ec, {shard}, chunks)
+        return decoded[shard], attrs
 
     async def _pull_object(
         self, pg: PG, name: str, shard: int | None, acting: list[int], entry
@@ -1094,10 +1115,25 @@ class OSDService(Dispatcher):
                     f"{conn.peer_name}.{conn.peer_nonce}:{p['tid']}"
                 )
                 if is_mutating(ops):
+                    # full-object EC writes encode BEFORE the PG lock:
+                    # concurrent writes overlap here and the batch
+                    # service packs them into one planar launch, while
+                    # version assignment + fan-out stay serialized
+                    pre_encoded = None
+                    ec = self.codec(pool_id)
+                    if (
+                        ec is not None
+                        and ops[0]["op"] == "write_full"
+                        and len(ops) == 1
+                    ):
+                        pre_encoded = await self.encode_service.encode(
+                            ec, datas[0]
+                        )
                     async with pg.lock:
                         op_results, reply_raw = await self._primary_ops(
                             pg, acting, name, ops, datas, reqid,
                             snapc=p.get("snapc"),
+                            pre_encoded=pre_encoded,
                         )
                     self.perf.inc("op_w")
                 else:
@@ -1257,6 +1293,7 @@ class OSDService(Dispatcher):
         self, pg: PG, acting: list[int], name: str, ops: list[dict],
         datas: list[bytes], reqid: str | None,
         snapc: dict | None = None, snapid: int | None = None,
+        pre_encoded: dict[int, bytes] | None = None,
     ) -> tuple[list[dict], bytes]:
         """Execute a client op vector (execute_ctx -> do_osd_ops ->
         issue_repop): run against the object context, and when it mutated,
@@ -1377,6 +1414,7 @@ class OSDService(Dispatcher):
             await self._fan_ec_write(
                 pg, acting, name, bytes(state.data), entry,
                 xattrs=state.xattrs, user_blob=user,
+                pre_encoded=pre_encoded,
             )
         return results, b"".join(reads)
 
@@ -1509,11 +1547,16 @@ class OSDService(Dispatcher):
         self, pg: PG, acting: list[int], name: str, data: bytes,
         entry: dict, xattrs: dict[str, bytes] | None = None,
         user_blob: bytes | None = None,
+        pre_encoded: dict[int, bytes] | None = None,
     ) -> None:
         """Encode and ship whole shards to every acting position
-        (ECBackend sub-write fan-out)."""
+        (ECBackend sub-write fan-out). `pre_encoded` carries shards
+        already produced by the batch service outside the PG lock."""
         ec = self.codec(pg.pool)
-        encoded = ec.encode(range(ec.get_chunk_count()), data)
+        if pre_encoded is not None:
+            encoded = pre_encoded
+        else:
+            encoded = await self.encode_service.encode(ec, data)
         hinfo = HashInfo.from_shards(encoded, ec.get_chunk_count())
         attrs = {"ver": entry["obj_ver"], "hinfo": hinfo,
                  "size": len(data)}
@@ -1779,7 +1822,9 @@ class OSDService(Dispatcher):
                 break
             del available[failed]
             chunks.pop(failed, None)
-        decoded = ec.decode(want, {s: chunks[s] for s in minimum})
+        decoded = await self.encode_service.decode(
+            ec, want, {s: chunks[s] for s in minimum}
+        )
         out = b"".join(
             decoded[ec.chunk_index(i)]
             for i in range(ec.get_data_chunk_count())
@@ -1957,6 +2002,8 @@ class OSDService(Dispatcher):
                         1 for pg in self.pgs.values() if pg.active
                     ),
                     "collections": len(self.store.list_collections()),
+                    "ec_launches": self.encode_service.launches,
+                    "ec_objects": self.encode_service.objects,
                 }
             elif cmd == "log dump":
                 result = {"entries": self.logs.dump_recent()}
@@ -2151,7 +2198,9 @@ class OSDService(Dispatcher):
             if ec is not None:
                 if len(chunks) < ec.get_data_chunk_count():
                     continue
-                data = ec.decode({shard}, chunks)[shard]
+                data = (
+                    await self.encode_service.decode(ec, {shard}, chunks)
+                )[shard]
             elif chunks:
                 # replicated: the digest-majority copy wins (ties -> the
                 # lowest acting position, like be_select_auth_object)

@@ -1,0 +1,230 @@
+"""EncodeService: coalesce concurrent per-object EC work into planar
+TPU launches.
+
+SURVEY §7 calls packing "stripes from many concurrent objects" into one
+launch the real performance design problem: a 4 KiB object write encodes
+512 B chunks — far too small to feed the MXU — but N concurrent writes
+stacked end-to-end along the chunk axis are one wide (k, N·chunk/4) planar
+`encode_words` call on the fused Pallas kernel (ceph_tpu.ops.gf_pallas).
+The reference's analogue is ECBackend's op pipelining (start_rmw batches
+in-flight ops, ECBackend.cc:1830) feeding ISA-L's wide SIMD units.
+
+Mechanics: the first enqueue arms a latency-bound flush (the batch
+window); everything that arrives while the window is open — concurrent
+client ops on the 4 op shards, recovery decodes, scrub rebuilds — rides
+the same launch. `launches`/`objects` counters let tests assert the
+coalescing actually happened (objects >> launches under concurrency).
+
+Codecs without the planar API (clay/lrc/shec compositions) fall back to
+their per-object paths transparently.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+
+from ceph_tpu.ops import gf_pallas as gp
+from ceph_tpu.ops.gf import gf_matmul
+
+
+def _bucket_pad(words: np.ndarray) -> tuple[np.ndarray, int]:
+    """Pad the planar width up to a power-of-2 bucket so batches of
+    varying composition reuse a handful of compiled kernels instead of
+    jitting per width (zero columns encode to zero parity; sliced off)."""
+    w = words.shape[-1]
+    bucket = max(256, 1 << (w - 1).bit_length())
+    if bucket == w:
+        return words, w
+    padded = np.zeros((*words.shape[:-1], bucket), dtype=words.dtype)
+    padded[..., :w] = words
+    return padded, w
+
+
+class EncodeService:
+    def __init__(self, window: float = 0.002, max_batch: int = 128):
+        #: seconds the first op of a batch waits for company
+        self.window = window
+        self.max_batch = max_batch
+        self._enc_q: dict[int, list] = {}
+        self._dec_q: dict[tuple, list] = {}
+        self._codecs: dict[int, object] = {}
+        #: armed window timers, cancelled on flush (a stale timer from a
+        #: max_batch-flushed batch would otherwise cut the NEXT window
+        #: short and erode coalescing under sustained load)
+        self._enc_timers: dict[int, object] = {}
+        self._dec_timers: dict[tuple, object] = {}
+        #: device launches / objects served — the coalescing evidence
+        self.launches = 0
+        self.objects = 0
+
+    # -- encode ---------------------------------------------------------------
+
+    async def encode(self, codec, data: bytes) -> dict[int, bytes]:
+        """All k+m chunks for one object, batched across callers."""
+        blocksize = codec.get_chunk_size(len(data))
+        if not hasattr(codec, "encode_words") or blocksize % 4:
+            self.launches += 1
+            self.objects += 1
+            return codec.encode(range(codec.get_chunk_count()), data)
+        key = id(codec)
+        self._codecs[key] = codec
+        fut = asyncio.get_event_loop().create_future()
+        q = self._enc_q.setdefault(key, [])
+        q.append((data, blocksize, fut))
+        if len(q) >= self.max_batch:
+            self._flush_encode(key)
+        elif len(q) == 1:
+            self._enc_timers[key] = asyncio.get_event_loop().call_later(
+                self.window, self._flush_encode, key
+            )
+        return await fut
+
+    def _flush_encode(self, key: int) -> None:
+        timer = self._enc_timers.pop(key, None)
+        if timer is not None:
+            timer.cancel()
+        q = self._enc_q.pop(key, [])
+        if not q:
+            return
+        codec = self._codecs[key]
+        k, n = codec.k, codec.get_chunk_count()
+        try:
+            # pack every object's chunk j end-to-end into planar row j
+            rows: list[list[np.ndarray]] = [[] for _ in range(k)]
+            for data, bs, _fut in q:
+                padded = np.zeros(k * bs, dtype=np.uint8)
+                padded[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+                for i in range(k):
+                    rows[i].append(padded[i * bs: (i + 1) * bs])
+            if gp.available():
+                words = np.stack(
+                    [np.concatenate(r).view(np.int32) for r in rows]
+                )
+                words, width = _bucket_pad(words)
+                parity = np.asarray(
+                    codec.encode_words(words)
+                )[:, :width].view(np.uint8)
+                parity = parity.reshape(codec.m, -1)
+            else:
+                # off-TPU: exact table-driven numpy planar path — no
+                # device, no jit-per-width (CPU test meshes would
+                # otherwise recompile for every batch composition)
+                planes = np.stack([np.concatenate(r) for r in rows])
+                parity_mat = codec._gen[codec.k:]
+                if getattr(codec, "_xor_ok", False):
+                    parity = np.bitwise_xor.reduce(
+                        planes, axis=0
+                    )[None]
+                else:
+                    parity = gf_matmul(parity_mat, planes)
+            self.launches += 1
+            self.objects += len(q)
+            off = 0
+            for j, (data, bs, fut) in enumerate(q):
+                chunks: dict[int, bytes] = {}
+                for logical in range(k):
+                    chunks[codec.chunk_index(logical)] = (
+                        rows[logical][j].tobytes()
+                    )
+                for logical in range(k, n):
+                    chunks[codec.chunk_index(logical)] = parity[
+                        logical - k, off: off + bs
+                    ].tobytes()
+                off += bs
+                if not fut.done():
+                    fut.set_result(chunks)
+        except Exception as e:
+            for _data, _bs, fut in q:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    # -- decode ---------------------------------------------------------------
+
+    async def decode(
+        self, codec, want_to_read, chunks: dict[int, bytes]
+    ) -> dict[int, bytes]:
+        """Batched degraded-read decode: objects sharing an erasure
+        signature (same survivors/targets) decode in one launch."""
+        want = set(want_to_read)
+        have = set(chunks)
+        if want <= have:
+            return {i: bytes(chunks[i]) for i in want}
+        blocksize = len(next(iter(chunks.values())))
+        if not hasattr(codec, "decode_words") or blocksize % 4:
+            self.launches += 1
+            self.objects += 1
+            return codec.decode(want, chunks)
+        present = tuple(
+            sorted(codec.logical_index(p) for p in have)
+        )[: codec.k]
+        targets = tuple(
+            sorted(codec.logical_index(p) for p in want - have)
+        )
+        key = (id(codec), present, targets)
+        self._codecs[id(codec)] = codec
+        fut = asyncio.get_event_loop().create_future()
+        q = self._dec_q.setdefault(key, [])
+        q.append((chunks, blocksize, want, fut))
+        if len(q) >= self.max_batch:
+            self._flush_decode(key)
+        elif len(q) == 1:
+            self._dec_timers[key] = asyncio.get_event_loop().call_later(
+                self.window, self._flush_decode, key
+            )
+        return await fut
+
+    def _flush_decode(self, key: tuple) -> None:
+        timer = self._dec_timers.pop(key, None)
+        if timer is not None:
+            timer.cancel()
+        q = self._dec_q.pop(key, None)
+        if not q:
+            return
+        codec_id, present, targets = key
+        codec = self._codecs[codec_id]
+        try:
+            rows: list[list[np.ndarray]] = [[] for _ in present]
+            for chunks, bs, _want, _fut in q:
+                for i, logical in enumerate(present):
+                    phys = codec.chunk_index(logical)
+                    rows[i].append(
+                        np.frombuffer(chunks[phys], dtype=np.uint8)
+                    )
+            if gp.available():
+                words = np.stack(
+                    [np.concatenate(r).view(np.int32) for r in rows]
+                )
+                words, width = _bucket_pad(words)
+                rebuilt = np.asarray(
+                    codec.decode_words(
+                        list(present), list(targets), words
+                    )
+                )[:, :width].view(np.uint8).reshape(len(targets), -1)
+            else:
+                from ceph_tpu.ec import matrices
+
+                planes = np.stack([np.concatenate(r) for r in rows])
+                dm = matrices.decode_matrix(
+                    codec._gen, codec.k, list(present), list(targets)
+                )
+                rebuilt = gf_matmul(dm, planes)
+            self.launches += 1
+            self.objects += len(q)
+            off = 0
+            for chunks, bs, want, fut in q:
+                out = {
+                    i: bytes(chunks[i]) for i in want if i in chunks
+                }
+                for t, logical in enumerate(targets):
+                    phys = codec.chunk_index(logical)
+                    if phys in want:
+                        out[phys] = rebuilt[t, off: off + bs].tobytes()
+                off += bs
+                if not fut.done():
+                    fut.set_result(out)
+        except Exception as e:
+            for _c, _b, _w, fut in q:
+                if not fut.done():
+                    fut.set_exception(e)

@@ -1,0 +1,200 @@
+"""CRUSH device classes: shadow hierarchies (populate_classes), classed
+take steps in the compiler, classed placement bit-exact across the scalar
+mapper, the TPU mapper, and the compiled reference C — plus
+reweight-subtree. Ref: CrushWrapper.cc populate_classes/device_class_clone,
+`step take <root> class <c>` in src/test/cli/crushtool fixtures."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import builder as cb
+from ceph_tpu.crush import jax_mapper as jm
+from ceph_tpu.crush import mapper as cm
+from ceph_tpu.crush.compiler import (
+    CompileError,
+    compile_crushmap,
+    decompile_crushmap,
+)
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+
+CLASSED_MAP = """\
+tunable choose_local_tries 0
+tunable choose_local_fallback_tries 0
+tunable choose_total_tries 50
+tunable chooseleaf_descend_once 1
+tunable chooseleaf_vary_r 1
+tunable chooseleaf_stable 1
+tunable straw_calc_version 1
+
+device 0 osd.0 class hdd
+device 1 osd.1 class ssd
+device 2 osd.2 class hdd
+device 3 osd.3 class ssd
+device 4 osd.4 class hdd
+device 5 osd.5 class ssd
+device 6 osd.6 class hdd
+device 7 osd.7 class ssd
+
+type 0 osd
+type 1 host
+type 10 root
+
+host host0 {
+\tid -2
+\talg straw2
+\thash 0
+\titem osd.0 weight 1.000
+\titem osd.1 weight 2.000
+}
+host host1 {
+\tid -3
+\talg straw2
+\thash 0
+\titem osd.2 weight 1.000
+\titem osd.3 weight 1.000
+}
+host host2 {
+\tid -4
+\talg straw2
+\thash 0
+\titem osd.4 weight 3.000
+\titem osd.5 weight 1.000
+}
+host host3 {
+\tid -5
+\talg straw2
+\thash 0
+\titem osd.6 weight 1.000
+\titem osd.7 weight 2.000
+}
+root default {
+\tid -1
+\talg straw2
+\thash 0
+\titem host0 weight 3.000
+\titem host1 weight 2.000
+\titem host2 weight 4.000
+\titem host3 weight 3.000
+}
+
+rule ssd_rule {
+\tid 0
+\ttype replicated
+\tmin_size 1
+\tmax_size 10
+\tstep take default class ssd
+\tstep chooseleaf firstn 0 type host
+\tstep emit
+}
+rule hdd_rule {
+\tid 1
+\ttype replicated
+\tmin_size 1
+\tmax_size 10
+\tstep take default class hdd
+\tstep chooseleaf firstn 0 type host
+\tstep emit
+}
+rule all_rule {
+\tid 2
+\ttype replicated
+\tmin_size 1
+\tmax_size 10
+\tstep take default
+\tstep chooseleaf firstn 0 type host
+\tstep emit
+}
+"""
+
+
+def test_classed_placement_selects_only_class_devices():
+    cmap = compile_crushmap(CLASSED_MAP)
+    weight = [0x10000] * 8
+    ssd = {1, 3, 5, 7}
+    hdd = {0, 2, 4, 6}
+    for x in range(200):
+        got = cm.do_rule(cmap, 0, x, weight, 3, cm.Workspace())
+        assert got and set(got) <= ssd, (x, got)
+        got = cm.do_rule(cmap, 1, x, weight, 3, cm.Workspace())
+        assert got and set(got) <= hdd, (x, got)
+
+
+def test_classed_round_trip_is_byte_stable():
+    cmap = compile_crushmap(CLASSED_MAP)
+    text = decompile_crushmap(cmap)
+    assert "step take default class ssd" in text
+    assert "~" not in text  # shadow buckets never leak into the text
+    again = decompile_crushmap(compile_crushmap(text))
+    assert text == again
+
+
+def test_classed_tpu_mapper_bit_exact_vs_scalar():
+    cmap = compile_crushmap(CLASSED_MAP)
+    weight = [0x10000] * 8
+    compiled = jm.compile_map(cmap)
+    for ruleno in (0, 1, 2):
+        got = np.asarray(
+            jm.map_rule(compiled, ruleno, np.arange(256), weight, 3)
+        )
+        for x in range(256):
+            want = cm.do_rule(
+                cmap, ruleno, x, weight, 3, cm.Workspace()
+            )
+            row = [v for v in got[x] if v != CRUSH_ITEM_NONE]
+            assert row == want, (ruleno, x, row, want)
+
+
+def test_classed_bit_exact_vs_reference_c():
+    from tests.crush_oracle import build_shim, oracle_do_rule
+
+    if build_shim() is None:
+        pytest.skip("reference C oracle unavailable")
+    cmap = compile_crushmap(CLASSED_MAP)
+    weight = [0x10000] * 8
+    xs = list(range(256))
+    for ruleno in (0, 1, 2):
+        want = oracle_do_rule(cmap, ruleno, xs, weight, 3)
+        for x in xs:
+            got = cm.do_rule(
+                cmap, ruleno, x, weight, 3, cm.Workspace()
+            )
+            assert got == want[x], (ruleno, x, got, want[x])
+
+
+def test_unknown_class_rejected():
+    bad = CLASSED_MAP.replace("class ssd\n\tstep chooseleaf",
+                              "class nvme\n\tstep chooseleaf")
+    with pytest.raises(CompileError, match="unknown device class"):
+        compile_crushmap(bad)
+
+
+def test_classed_take_on_device_rejected():
+    bad = CLASSED_MAP.replace(
+        "step take default class ssd", "step take osd.0 class ssd"
+    )
+    with pytest.raises(CompileError, match="not a device"):
+        compile_crushmap(bad)
+
+
+def test_mutators_rebuild_shadows():
+    cmap = compile_crushmap(CLASSED_MAP)
+    old_shadow = cmap.class_bucket[(-4, "hdd")]
+    cb.reweight_subtree(cmap, -4, 2 * 0x10000)
+    # shadows track the new weights (and ids stay stable for rules)
+    assert cmap.class_bucket[(-4, "hdd")] == old_shadow
+    shadow = cmap.buckets[cmap.class_bucket[(-4, "hdd")]]
+    assert shadow.item_weights == [2 * 0x10000]
+
+
+def test_reweight_subtree():
+    cmap = compile_crushmap(CLASSED_MAP)
+    n = cb.reweight_subtree(cmap, -4, 2 * 0x10000)  # host2's 2 devices
+    assert n == 2
+    host2 = cmap.buckets[-4]
+    assert host2.item_weights == [2 * 0x10000, 2 * 0x10000]
+    assert host2.weight == 4 * 0x10000
+    root = cmap.buckets[-1]
+    assert root.item_weights[root.items.index(-4)] == 4 * 0x10000
+    # map still functions after the reweight
+    got = cm.do_rule(cmap, 2, 7, [0x10000] * 8, 3, cm.Workspace())
+    assert len(got) == 3

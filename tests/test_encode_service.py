@@ -1,0 +1,90 @@
+"""The batch-encode service: concurrent EC object writes coalesce into
+few planar device launches (SURVEY §7 hard part #4 — pack many concurrent
+objects into one launch — wired into the LIVE daemons, not just bench)."""
+
+import asyncio
+
+import numpy as np
+
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+def test_batched_encode_matches_per_object():
+    """The planar batch path is bit-exact vs the per-object byte API."""
+    from ceph_tpu.ec.registry import factory
+    from ceph_tpu.osd.encode_service import EncodeService
+
+    async def main():
+        codec = factory("tpu", {"k": "3", "m": "2"})
+        svc = EncodeService(window=0.001)
+        rng = np.random.default_rng(7)
+        payloads = [
+            rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+            for n in (100, 4096, 777, 5000, 64)
+        ]
+        batched = await asyncio.gather(
+            *(svc.encode(codec, p) for p in payloads)
+        )
+        for p, got in zip(payloads, batched):
+            want = codec.encode(range(codec.get_chunk_count()), p)
+            assert got == want
+        assert svc.objects == len(payloads)
+        assert svc.launches < len(payloads), (
+            f"{svc.launches} launches for {svc.objects} objects"
+        )
+
+        # batched decode round-trips and coalesces too
+        erased = [{0, 3}, {0, 3}, {0, 3}]
+        outs = await asyncio.gather(*(
+            svc.decode(
+                codec, {0, 1, 2},
+                {i: c for i, c in batched[j].items() if i not in erased[j]},
+            )
+            for j in range(3)
+        ))
+        for j in range(3):
+            for i in (0, 1, 2):
+                assert outs[j][i] == batched[j][i]
+
+    run(main())
+
+
+def test_live_ec_writes_coalesce_into_few_launches():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        rados = Rados("client.bat", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        io = rados.io_ctx(EC_POOL)
+        await io.write_full("warm", b"w" * 4096)  # peering + jit warmup
+
+        before = {
+            i: (o.encode_service.launches, o.encode_service.objects)
+            for i, o in cluster.osds.items()
+        }
+        # 24 concurrent object writes across the pool's primaries
+        payloads = {f"obj-{i}": bytes([i]) * 8192 for i in range(24)}
+        await asyncio.gather(
+            *(io.write_full(k, v) for k, v in payloads.items())
+        )
+        launches = objects = 0
+        for i, o in cluster.osds.items():
+            launches += o.encode_service.launches - before[i][0]
+            objects += o.encode_service.objects - before[i][1]
+        assert objects >= 24
+        # without batching launches == objects; the service must coalesce
+        assert launches < objects, (
+            f"{launches} launches for {objects} encoded objects"
+        )
+        for k, v in payloads.items():
+            assert await io.read(k) == v
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
