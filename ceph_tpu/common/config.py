@@ -120,6 +120,13 @@ SCHEMA: dict[str, Option] = {
              "PGs per new pool"),
         _opt("osd_recovery_max_active", TYPE_UINT, LEVEL_ADVANCED, 3,
              "concurrent recovery ops per OSD"),
+        _opt("osd_op_queue", TYPE_STR, LEVEL_ADVANCED, "wpq",
+             "op scheduler inside each OSD op shard: wpq | mclock"),
+        _opt("osd_min_pg_log_entries", TYPE_UINT, LEVEL_ADVANCED, 500,
+             "log entries retained per PG; peers further behind than "
+             "this take a full backfill instead of log recovery"),
+        _opt("osd_max_backfills", TYPE_UINT, LEVEL_ADVANCED, 1,
+             "concurrent backfills one OSD will source (reservations)"),
         _opt("osd_ec_batch_window", TYPE_FLOAT, LEVEL_ADVANCED, 0.002,
              "seconds the first EC op of a batch waits so concurrent "
              "objects share one planar device launch"),
@@ -163,6 +170,9 @@ class Config:
     def __init__(self, schema: dict[str, Option] | None = None):
         self.schema = schema if schema is not None else SCHEMA
         self._file: dict[str, Any] = {}
+        #: mon centralized-config tier (ConfigMonitor): below the local
+        #: conf file, above compiled defaults — local settings win
+        self._mon: dict[str, Any] = {}
         self._runtime: dict[str, Any] = {}
         self._observers: dict[str, list[Callable[[str, Any], None]]] = {}
 
@@ -183,6 +193,8 @@ class Config:
             return opt.parse(env)
         if name in self._file:
             return self._file[name]
+        if name in self._mon:
+            return self._mon[name]
         return opt.default
 
     def source_of(self, name: str) -> str:
@@ -193,6 +205,8 @@ class Config:
             return "env"
         if name in self._file:
             return "file"
+        if name in self._mon:
+            return "mon"
         return "default"
 
     # -- writes -------------------------------------------------------------
@@ -212,6 +226,26 @@ class Config:
         """Conf-file tier (between defaults and env)."""
         for name, value in values.items():
             self._file[name] = self._opt(name).parse(value)
+
+    def apply_mon_values(self, values: dict[str, Any]) -> None:
+        """Replace the mon centralized-config tier (MonClient applies the
+        committed config map); observers fire for keys whose EFFECTIVE
+        value changed."""
+        before = {
+            name: self.get(name)
+            for name in set(self._mon) | set(values)
+            if name in self.schema
+        }
+        self._mon = {
+            name: self._opt(name).parse(v)
+            for name, v in values.items()
+            if name in self.schema
+        }
+        for name, old in before.items():
+            new = self.get(name)
+            if new != old:
+                for cb in self._observers.get(name, []):
+                    cb(name, new)
 
     def observe(self, name: str, cb: Callable[[str, Any], None]) -> None:
         self._opt(name)

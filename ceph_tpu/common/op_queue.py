@@ -144,13 +144,17 @@ class MClockQueue:
     def set_profile(self, cls: str, info: ClientInfo) -> None:
         self._profiles[cls] = info
 
-    def enqueue(self, cls: str, item) -> None:
+    def enqueue(self, cls: str, item, cost: int = 1) -> None:
         if cls not in self._profiles:
             raise KeyError(f"no profile for class {cls!r}")
         # arrival time rides with the op: dmclock clamps tags to ARRIVAL,
         # so a backlog that arrived long ago catches its reservation up
-        # within a tick, while fresh ops after idle start at now
-        self._queues.setdefault(cls, deque()).append((self.now, item))
+        # within a tick, while fresh ops after idle start at now. Cost
+        # scales the tag advance: an expensive op consumes more of its
+        # class's share (dmclock's cost parameter).
+        self._queues.setdefault(cls, deque()).append(
+            (self.now, max(1, cost), item)
+        )
 
     def __len__(self) -> int:
         return sum(len(q) for q in self._queues.values())
@@ -160,21 +164,21 @@ class MClockQueue:
         time (idle classes accumulate no credit; queued backlogs do catch
         up — the dmclock tag rule)."""
         info = self._profiles[cls]
-        arrival = self._queues[cls][0][0]
+        arrival, cost, _item = self._queues[cls][0]
         last = self._tags.get(cls, [0.0, 0.0, 0.0])
         r = (
-            max(last[0] + 1.0 / info.reservation, arrival)
+            max(last[0] + cost / info.reservation, arrival)
             if info.reservation
             else float("inf")
         )
         # weight 0 = reservation-only service (never competes in phase 2)
         w = (
-            max(last[1] + 1.0 / info.weight, arrival)
+            max(last[1] + cost / info.weight, arrival)
             if info.weight
             else float("inf")
         )
         lim = (
-            max(last[2] + 1.0 / info.limit, arrival)
+            max(last[2] + cost / info.limit, arrival)
             if info.limit
             else 0.0
         )
@@ -205,7 +209,7 @@ class MClockQueue:
         return self._take(cls, tags[cls], used_reservation=False)
 
     def _take(self, cls: str, tags, used_reservation: bool):
-        _arrival, item = self._queues[cls].popleft()
+        _arrival, _cost, item = self._queues[cls].popleft()
         last = self._tags.setdefault(cls, [0.0, 0.0, 0.0])
         r, w, lim = tags
         if used_reservation:
@@ -215,3 +219,42 @@ class MClockQueue:
         if self._profiles[cls].limit:
             last[2] = lim
         return cls, item
+
+
+class MClockOpQueue:
+    """WPQ-shaped adapter over MClockQueue for the OSD op shards.
+
+    The reference selects its op scheduler via `osd_op_queue`
+    (src/common/options.cc; wpq vs mclock_scheduler) — this is the
+    mclock side of that switch. Classes default to weight-1 profiles
+    (fair share); operators register richer profiles (reservation /
+    limit) per client class via set_profile."""
+
+    def __init__(self, default: ClientInfo | None = None):
+        self._q = MClockQueue()
+        self._default = default or ClientInfo(weight=1.0)
+
+    def set_profile(self, cls: str, info: ClientInfo) -> None:
+        self._q.set_profile(cls, info)
+
+    def enqueue(self, priority: int, cost: int, item, klass=None) -> None:
+        import time as _time
+
+        cls = str(klass) if klass is not None else "default"
+        if cls not in self._q._profiles:
+            self._q.set_profile(cls, self._default)
+        self._q.now = _time.monotonic()
+        self._q.enqueue(cls, item, cost=cost)
+
+    def enqueue_strict(self, item) -> None:
+        self.enqueue(255, 1, item, klass="strict")
+
+    def dequeue(self):
+        import time as _time
+
+        self._q.now = _time.monotonic()
+        got = self._q.dequeue()
+        return None if got is None else got[1]
+
+    def __len__(self) -> int:
+        return len(self._q)

@@ -10,6 +10,8 @@ launches), and commit the resulting pg_upmap_items through the mon's
 command path so every daemon and client re-targets on the next epoch.
 """
 
+from ceph_tpu.mgr.autoscaler import PgAutoscaler
 from ceph_tpu.mgr.balancer import BalancerModule
+from ceph_tpu.mgr.prometheus import PrometheusExporter
 
-__all__ = ["BalancerModule"]
+__all__ = ["BalancerModule", "PgAutoscaler", "PrometheusExporter"]

@@ -1,0 +1,73 @@
+"""PrometheusExporter: the cluster's metrics in Prometheus text format.
+
+The reference's mgr prometheus module (src/pybind/mgr/prometheus/
+module.py) scrapes every daemon's PerfCounters plus map-level state and
+serves /metrics. Same shape here: per-daemon `perf dump` over the admin
+surface + OSDMap gauges, rendered as `# TYPE` + labeled samples — a
+text-format dump any Prometheus scraper (or the `ceph prometheus` CLI)
+can consume.
+"""
+
+from __future__ import annotations
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() else "_" for c in name)
+
+
+class PrometheusExporter:
+    PREFIX = "ceph_tpu"
+
+    def __init__(self, objecter):
+        self.objecter = objecter
+
+    async def collect(self) -> str:
+        osdmap = self.objecter.osdmap
+        lines: list[str] = []
+
+        def gauge(name: str, value, labels: dict | None = None,
+                  mtype: str = "gauge") -> None:
+            full = f"{self.PREFIX}_{_sanitize(name)}"
+            if not any(line.startswith(f"# TYPE {full} ")
+                       for line in lines):
+                lines.append(f"# TYPE {full} {mtype}")
+            lab = ""
+            if labels:
+                inner = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(labels.items())
+                )
+                lab = "{" + inner + "}"
+            lines.append(f"{full}{lab} {value}")
+
+        # map-level gauges (the module's health/df family)
+        gauge("osdmap_epoch", osdmap.epoch)
+        gauge("osd_up", int(osdmap.max_osd - sum(
+            1 for o in range(osdmap.max_osd) if osdmap.is_down(o)
+        )))
+        gauge("osd_total", int(osdmap.max_osd))
+        gauge("pools", len(osdmap.pools))
+        for pid, pool in sorted(osdmap.pools.items()):
+            gauge("pool_pg_num", pool.pg_num, {"pool": pid})
+            gauge("pool_size", pool.size, {"pool": pid})
+
+        # per-daemon perf counters
+        for osd in range(osdmap.max_osd):
+            if osdmap.is_down(osd):
+                continue
+            try:
+                dump = await self.objecter.osd_admin(
+                    osd, "perf dump", timeout=10.0
+                )
+            except Exception:
+                continue
+            for logger, counters in sorted(dump.items()):
+                for key, value in sorted(counters.items()):
+                    v = value.get("value") if isinstance(
+                        value, dict
+                    ) else value
+                    if isinstance(v, (int, float)):
+                        gauge(
+                            f"daemon_{key}", v,
+                            {"daemon": logger}, mtype="counter",
+                        )
+        return "\n".join(lines) + "\n"

@@ -64,6 +64,11 @@ class MonClient(Dispatcher):
                 fut.set_result(p)
         elif msg.type == "osd_map":
             self._handle_map(json.loads(msg.data))
+        elif msg.type == "config_map":
+            # centralized config (ConfigMonitor subscription): lands in
+            # the Config's mon tier, below local file/env/overrides
+            p = json.loads(msg.data)
+            self.config.apply_mon_values(p.get("kv", {}))
         elif self._chained is not None:
             await self._chained.ms_dispatch(conn, msg)
 

@@ -113,6 +113,13 @@ class Monitor(Dispatcher):
         # osdmap service state
         self.osdmap = OSDMap.decode(initial_osdmap.encode())
         self._osdmap_base_epoch = self.osdmap.epoch
+        #: the centralized config service's kv (ConfigMonitor's store):
+        #: rebuilt deterministically from the committed paxos log
+        self.config_kv: dict[str, str] = {}
+        #: map epoch -> paxos version that produced it (services share
+        #: one paxos log, so the 1:1 version<->epoch shortcut is gone)
+        self._epoch_versions: dict[int, int] = {}
+        self._last_applied_service = ""
         self._replay_committed()
         #: peer_name -> (connection, from_epoch) map subscribers
         self._subs: dict[str, object] = {}
@@ -460,34 +467,43 @@ class Monitor(Dispatcher):
         self.last_committed = version
         self._pending = None
         self._apply_value(version, value)
-        self._publish_maps()
+        if self._last_applied_service == "config":
+            self._publish_config()
+        else:
+            self._publish_maps()
 
     def _apply_value(self, version: int, value: bytes) -> None:
-        """Deterministic application: the effective map epoch of the inc
-        committed as paxos version v is ALWAYS base+v, regardless of the
-        epoch the proposing handler guessed — two handlers racing to build
-        `epoch+1` incs would otherwise commit a value that every mon
-        silently skips, corrupting the version<->epoch mapping subscribers
-        rely on. Re-stamping is safe because every mon applies the same
-        commit sequence and computes the same result."""
+        """Deterministic application: the effective map epoch of an inc
+        is ALWAYS the current epoch + 1, regardless of the epoch the
+        proposing handler guessed — two handlers racing to build incs
+        would otherwise commit a value that every mon silently skips.
+        Re-stamping is safe because every mon applies the same commit
+        sequence and computes the same result."""
         d = Decoder(value)
         service = d.string()
         payload = d.blob()
+        self._last_applied_service = service
         if service == "osdmap":
             inc = Incremental.decode(payload)
-            inc.epoch = self._osdmap_base_epoch + version
-            if inc.epoch == self.osdmap.epoch + 1:
-                self.osdmap.apply_incremental(inc)
+            inc.epoch = self.osdmap.epoch + 1
+            self.osdmap.apply_incremental(inc)
+            self._epoch_versions[inc.epoch] = version
+        elif service == "config":
+            # {"set": {k: v}, "rm": [k]} — the ConfigMonitor delta
+            delta = json.loads(payload)
+            for k, v in delta.get("set", {}).items():
+                self.config_kv[k] = v
+            for k in delta.get("rm", []):
+                self.config_kv.pop(k, None)
 
     # -- map subscription / publication ---------------------------------------
 
     def _inc_for_epoch(self, epoch: int) -> bytes | None:
-        """Committed incremental bytes producing map `epoch`, if retained."""
-        # paxos version v produced map epoch base + v (1:1, osdmap-only
-        # mon); serve it re-stamped with its effective epoch, matching what
-        # _apply_value applied (the stored bytes may carry a stale guess)
-        v = epoch - self._osdmap_base_epoch
-        raw = self.db.get(_VALS, _vkey(v)) if v >= 1 else None
+        """Committed incremental bytes producing map `epoch`, if retained;
+        served re-stamped with its effective epoch, matching what
+        _apply_value applied (the stored bytes may carry a stale guess)."""
+        v = self._epoch_versions.get(epoch)
+        raw = self.db.get(_VALS, _vkey(v)) if v is not None else None
         if raw is None:
             return None
         d = Decoder(raw)
@@ -509,6 +525,13 @@ class Monitor(Dispatcher):
             incs.append(raw.hex())
             e += 1
         return {"incs": incs, "epoch": self.osdmap.epoch}
+
+    def _publish_config(self) -> None:
+        """Push the committed config map to every subscriber (the
+        ConfigMonitor's map distribution leg)."""
+        for peer, (conn, _from_epoch) in list(self._subs.items()):
+            if conn.is_connected:
+                self._send(conn, "config_map", {"kv": self.config_kv})
 
     def _publish_maps(self) -> None:
         for peer, (conn, from_epoch) in list(self._subs.items()):
@@ -759,6 +782,9 @@ class Monitor(Dispatcher):
     async def _h_sub(self, conn, p) -> None:
         self._subs[conn.peer_name] = (conn, p.get("from", 0))
         self._send(conn, "osd_map", self._map_payload(p.get("from", 0)))
+        # always sent, even when empty: a resubscriber must LEARN that
+        # central options were removed while it was away
+        self._send(conn, "config_map", {"kv": self.config_kv})
         self._subs[conn.peer_name] = (conn, self.osdmap.epoch)
 
     async def _h_mon_command(self, conn, p) -> None:
@@ -948,6 +974,29 @@ class Monitor(Dispatcher):
                 )
             )
             return {}
+        if cmd == "osd pool set":
+            # pg_num growth (the autoscaler's lever): commits the new
+            # pool geometry; OSDs split PGs on the map change
+            pool = self.osdmap.pools.get(args["pool_id"])
+            if pool is None:
+                raise ValueError(f"no pool {args['pool_id']}")
+            if args["name"] != "pg_num":
+                raise ValueError(f"unsupported pool option {args['name']}")
+            new_num = int(args["value"])
+            if new_num < pool.pg_num:
+                raise ValueError("pg_num can only grow")
+            import copy
+
+            newpool = copy.deepcopy(pool)
+            newpool.pg_num = new_num
+            newpool.pgp_num = new_num
+            await self._propose_osdmap(
+                Incremental(
+                    epoch=self.osdmap.epoch + 1,
+                    new_pools={args["pool_id"]: newpool},
+                )
+            )
+            return {"pg_num": new_num}
         if cmd == "osd down":
             await self._propose_osdmap(
                 Incremental(epoch=self.osdmap.epoch + 1,
@@ -992,6 +1041,33 @@ class Monitor(Dispatcher):
                 )
             )
             return {"applied": len(new_items), "removed": len(old_items)}
+        if cmd == "config set":
+            # validate against the typed schema before committing (the
+            # ConfigMonitor rejects unknown/ill-typed options the same way)
+            from ceph_tpu.common.config import SCHEMA
+
+            opt = SCHEMA.get(args["name"])
+            if opt is None:
+                raise ValueError(f"unknown option {args['name']!r}")
+            opt.parse(args["value"])
+            await self.propose(
+                "config",
+                json.dumps(
+                    {"set": {args["name"]: str(args["value"])}}
+                ).encode(),
+            )
+            return {}
+        if cmd == "config rm":
+            await self.propose(
+                "config", json.dumps({"rm": [args["name"]]}).encode()
+            )
+            return {}
+        if cmd == "config get":
+            if args["name"] not in self.config_kv:
+                raise ValueError(f"{args['name']!r} not set centrally")
+            return {"value": self.config_kv[args["name"]]}
+        if cmd == "config dump":
+            return {"kv": dict(self.config_kv)}
         if cmd == "osd pool selfmanaged-snap create":
             # allocate the next snap id for the pool (the OSDMonitor leg
             # of rados_ioctx_selfmanaged_snap_create): committed through
@@ -1063,7 +1139,11 @@ class Monitor(Dispatcher):
                 pg_num=args.get("pg_num",
                                 self.config.get("osd_pool_default_pg_num")),
                 size=k + m,
-                min_size=k,
+                # k+1, the reference's EC default: a write acked at
+                # exactly k live shards has zero redundancy the moment
+                # one of them is lost (OSDMonitor's
+                # osd_pool_default_min_size rule for EC pools)
+                min_size=k + 1 if m > 1 else k,
                 type=TYPE_ERASURE,
                 crush_rule=args["crush_rule"],
                 erasure_code_profile=profile_name,

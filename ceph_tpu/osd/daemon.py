@@ -100,7 +100,16 @@ def load_snapset(xattrs: dict) -> dict:
 
 
 class PG:
-    """Per-PG volatile state; durable state lives in the store."""
+    """Per-PG volatile state; durable state lives in the store.
+
+    Persistent layout in the pg-meta object's omap:
+      log/<v>    retained log entries (trimmed to osd_min_pg_log_entries,
+                 PGLog::trim)
+      obj/<name> the object inventory: name -> latest entry, INDEPENDENT
+                 of log retention — trimming the log never forgets what
+                 objects exist (the missing-set/backfill source of truth)
+      info       {last_update, log_tail, head:[epoch,version]}
+    """
 
     META = ".pgmeta"
 
@@ -117,21 +126,43 @@ class PG:
                     self.coll, self.META
                 )
             )
-        # in-memory mirror of the persisted log (loaded once, then kept in
-        # step by append_log): per-op paths read these instead of scanning
-        # + json-decoding the whole omap on every write
         self._last_update = 0
+        #: versions <= log_tail have been trimmed from the log
+        self._log_tail = 0
+        #: eversion of the newest entry: (epoch it was written in,
+        #: version) — the reference's eversion_t, what makes two reigns'
+        #: same-numbered entries distinguishable for divergence handling
+        self._head: tuple[int, int] = (0, 0)
         self._inventory: dict[str, dict] = {}
         #: reqid -> version: client-op dup detection across primary
         #: failover (the reference scans the pg log for the reqid,
         #: PrimaryLogPG::check_in_progress_op); entries replicate so a new
         #: primary inherits the set
         self._reqids: dict[str, int] = {}
-        for e in self._scan_log():
-            self._last_update = max(self._last_update, e["version"])
-            self._inventory[e["name"]] = e
-            if e.get("reqid"):
-                self._reqids[e["reqid"]] = e["version"]
+        #: reqids whose fan-out fully completed THIS primary's tenure: a
+        #: dup whose reqid is logged but not here means the original op
+        #: aborted mid-fan-out — it must be completed forward (full-state
+        #: re-push) before acking, or the ack would cover a write that
+        #: exists on too few members to survive the next failure
+        self._reqids_done: set[str] = set()
+        omap = store.omap_get(self.coll, self.META)
+        raw_info = omap.get(b"info")
+        if raw_info:
+            info = json.loads(raw_info)
+            self._last_update = info.get("last_update", 0)
+            self._log_tail = info.get("log_tail", 0)
+            self._head = tuple(info.get("head", (0, 0)))
+        for k, v in sorted(omap.items()):
+            if k.startswith(b"obj/"):
+                e = json.loads(v)
+                self._inventory[e["name"]] = e
+            elif k.startswith(b"log/"):
+                e = json.loads(v)
+                self._last_update = max(self._last_update, e["version"])
+                if e.get("reqid"):
+                    self._reqids[e["reqid"]] = e["version"]
+                if (e.get("epoch", 0), e["version"]) > self._head:
+                    self._head = (e.get("epoch", 0), e["version"])
         #: a primary serves client IO only once peering for the current
         #: interval finished (PeeringState: Peering -> Active); until then
         #: ops bounce with a retryable error, so a revived primary can
@@ -144,6 +175,14 @@ class PG:
     @property
     def last_update(self) -> int:
         return self._last_update
+
+    @property
+    def log_tail(self) -> int:
+        return self._log_tail
+
+    @property
+    def head(self) -> tuple[int, int]:
+        return self._head
 
     def _scan_log(self, from_version: int = 0) -> list[dict]:
         out = []
@@ -159,29 +198,89 @@ class PG:
     def log_entries(self, from_version: int = 0) -> list[dict]:
         return self._scan_log(from_version)
 
+    def entry_at(self, version: int) -> dict | None:
+        raw = self.service.store.omap_get(self.coll, self.META).get(
+            b"log/%016x" % version
+        )
+        return json.loads(raw) if raw else None
+
+    def _info_blob(self) -> bytes:
+        return json.dumps(
+            {"last_update": self._last_update,
+             "log_tail": self._log_tail,
+             "head": list(self._head)}
+        ).encode()
+
     def append_log(self, txn: Transaction, entry: dict) -> None:
         """Record `entry` in the transaction AND the in-memory mirror; the
         caller must queue_transaction(txn) before yielding control (all
-        call sites do, under the PG lock)."""
-        txn.omap_setkeys(
-            self.coll,
-            self.META,
-            {
-                b"log/%016x" % entry["version"]: json.dumps(entry).encode(),
-                b"info": json.dumps(
-                    {"last_update": entry["version"]}
-                ).encode(),
-            },
-        )
+        call sites do, under the PG lock). Trims the log to the configured
+        horizon (PGLog::trim) — the obj/ inventory keeps full knowledge."""
         self._last_update = max(self._last_update, entry["version"])
+        ev = (entry.get("epoch", 0), entry["version"])
+        if ev > self._head:
+            self._head = ev
+        rows = {
+            b"log/%016x" % entry["version"]: json.dumps(entry).encode(),
+            b"obj/" + entry["name"].encode(): (
+                json.dumps(entry).encode()
+            ),
+        }
+        max_entries = self.service.config.get("osd_min_pg_log_entries")
+        if self._last_update - self._log_tail > max_entries:
+            new_tail = self._last_update - max_entries
+            txn.omap_rmkeys(
+                self.coll, self.META,
+                [b"log/%016x" % v
+                 for v in range(self._log_tail + 1, new_tail + 1)],
+            )
+            self._log_tail = new_tail
+            # the dup-detection horizon tracks the trimmed log: reqids
+            # below the tail are forgotten in memory exactly as a
+            # restart reloading from the log would forget them
+            stale = [
+                r for r, v in self._reqids.items() if v <= new_tail
+            ]
+            for r in stale:
+                del self._reqids[r]
+                self._reqids_done.discard(r)
+        rows[b"info"] = self._info_blob()
+        txn.omap_setkeys(self.coll, self.META, rows)
         cur = self._inventory.get(entry["name"])
         if cur is None or entry["version"] > cur["version"]:
             self._inventory[entry["name"]] = entry
         if entry.get("reqid"):
             self._reqids[entry["reqid"]] = entry["version"]
 
+    def reset_log(
+        self, txn: Transaction, inventory: dict[str, dict],
+        head: tuple[int, int], tail: int,
+    ) -> None:
+        """Backfill epilogue: adopt the authority's object inventory and
+        restart the log fresh at its head (divergent local entries are
+        gone — their client ops were never fully acked and will re-execute
+        under new reqids on retry)."""
+        omap = self.service.store.omap_get(self.coll, self.META)
+        txn.omap_rmkeys(
+            self.coll, self.META,
+            [k for k in omap if k.startswith((b"log/", b"obj/"))],
+        )
+        self._inventory = {}
+        self._reqids = {}
+        rows = {}
+        for name, e in inventory.items():
+            rows[b"obj/" + name.encode()] = json.dumps(e).encode()
+            self._inventory[name] = e
+            if e.get("reqid"):
+                self._reqids[e["reqid"]] = e["version"]
+        self._last_update = head[1]
+        self._log_tail = tail
+        self._head = tuple(head)
+        rows[b"info"] = self._info_blob()
+        txn.omap_setkeys(self.coll, self.META, rows)
+
     def latest_objects(self) -> dict[str, dict]:
-        """name -> newest log entry (the recovery inventory)."""
+        """name -> newest entry (the recovery/backfill inventory)."""
         return self._inventory
 
 
@@ -259,12 +358,23 @@ class OSDService(Dispatcher):
 
         self.logs = LogRegistry(self.config)
         self.dlog = self.logs.get_logger("osd")
-        # sharded weighted op queue (ShardedOpWQ): workers start in start()
-        from ceph_tpu.common.op_queue import WeightedPriorityQueue
+        # sharded op queue (ShardedOpWQ): workers start in start(); the
+        # scheduler inside each shard is selected by osd_op_queue
+        # (wpq | mclock), the reference's op-queue switch
+        from ceph_tpu.common.op_queue import (
+            MClockOpQueue,
+            WeightedPriorityQueue,
+        )
+
+        queue_kind = self.config.get("osd_op_queue")
 
         class _OpShard:
             def __init__(self):
-                self.queue = WeightedPriorityQueue()
+                self.queue = (
+                    MClockOpQueue()
+                    if queue_kind == "mclock"
+                    else WeightedPriorityQueue()
+                )
                 self.kick = asyncio.Event()
 
         self._op_shards = [_OpShard() for _ in range(4)]
@@ -273,6 +383,11 @@ class OSDService(Dispatcher):
         self._next_reboot = 0.0
         self._acting_cache: dict[tuple[int, int], tuple] = {}
         self._acting_cache_epoch = -1
+        #: bounds concurrent backfills we source (osd_max_backfills /
+        #: the reservation sched_scrub-style throttle)
+        self._backfill_sem = asyncio.Semaphore(
+            self.config.get("osd_max_backfills")
+        )
         self._stopped = False
         self.mon.on_map_change(self._note_map)
         self._map_dirty = asyncio.Event()
@@ -545,6 +660,7 @@ class OSDService(Dispatcher):
 
             self._spawn(renudge())
             return
+        self._maybe_split_pools()
         mine: set[tuple[int, int]] = set()
         for pool_id, pool in m.pools.items():
             for ps in range(pool.pg_num):
@@ -646,54 +762,200 @@ class OSDService(Dispatcher):
                 except Exception:
                     continue  # next map change retries
 
+    # -- PG splitting (pool pg_num growth; PG::split_into) --------------------
+
+    _OSD_META = "osd_meta"
+
+    def _seen_pg_num(self, pool_id: int) -> int | None:
+        raw = self.store.omap_get(self._OSD_META, ".meta").get(
+            b"pgnum/%d" % pool_id
+        )
+        return int(raw) if raw else None
+
+    def _maybe_split_pools(self) -> None:
+        """Deterministic local split on pg_num growth: every member moves
+        the objects whose stable-mod home changed into the child PG's
+        collection with fresh child log entries; peering then reconciles
+        the child's acting set (which may differ from the parent's). The
+        watermark persists so a member that was down during the commit
+        still splits on revival."""
+        if not self.store.collection_exists(self._OSD_META):
+            self.store.queue_transaction(
+                Transaction().create_collection(self._OSD_META).touch(
+                    self._OSD_META, ".meta"
+                )
+            )
+        for pool_id, pool in self.osdmap.pools.items():
+            seen = self._seen_pg_num(pool_id)
+            if seen == pool.pg_num:
+                continue  # the common no-change case: no store traffic
+            if seen is not None and pool.pg_num > seen:
+                self._split_pool(pool_id, seen, pool.pg_num)
+            self.store.queue_transaction(
+                Transaction().omap_setkeys(
+                    self._OSD_META, ".meta",
+                    {b"pgnum/%d" % pool_id: str(pool.pg_num).encode()},
+                )
+            )
+
+    def _split_pool(self, pool_id: int, old_n: int, new_n: int) -> None:
+        from ceph_tpu.common.hash import ceph_str_hash_rjenkins
+
+        pool = self.osdmap.pools[pool_id]
+        for ps in range(old_n):
+            coll = pg_coll(pool_id, ps)
+            if not self.store.collection_exists(coll):
+                continue
+            parent = self._pg_of((pool_id, ps))
+            moves: dict[int, list[dict]] = {}
+            for name, entry in sorted(parent.latest_objects().items()):
+                newps = pool.raw_pg_to_pg(ceph_str_hash_rjenkins(name))
+                if newps != ps:
+                    moves.setdefault(newps, []).append(entry)
+            if not moves:
+                continue
+            # store names per logical name (plain, .sN shards)
+            by_logical: dict[str, list[str]] = {}
+            for sname in self.store.list_objects(coll):
+                if sname == parent.META:
+                    continue
+                logical = sname
+                base, sep, tail = sname.rpartition(".s")
+                if sep and tail.isdigit():
+                    logical = base
+                by_logical.setdefault(logical, []).append(sname)
+            moved_names = set()
+            for newps, entries in sorted(moves.items()):
+                child = self._pg_of((pool_id, newps))
+                txn = Transaction()
+                for e in sorted(entries, key=lambda x: x["name"]):
+                    moved_names.add(e["name"])
+                    for sname in by_logical.get(e["name"], []):
+                        try:
+                            data = self.store.read(coll, sname)
+                            attrs = self.store.getattrs(coll, sname)
+                        except StoreError:
+                            continue
+                        txn.write(child.coll, sname, data, attrs=attrs)
+                        omap = self.store.omap_get(coll, sname)
+                        if omap:
+                            txn.omap_setkeys(child.coll, sname, omap)
+                        txn.remove(coll, sname)
+                    child.append_log(
+                        txn,
+                        {**e, "version": child.last_update + 1,
+                         "epoch": self.osdmap.epoch},
+                    )
+                self.store.queue_transaction(txn)
+            # drop the moved names from the parent's inventory AND its
+            # retained log, or recovery would try to resurrect them
+            txn = Transaction()
+            rm_keys = [
+                b"obj/" + n.encode() for n in moved_names
+            ]
+            for le in parent.log_entries(0):
+                if le["name"] in moved_names:
+                    rm_keys.append(b"log/%016x" % le["version"])
+            txn.omap_rmkeys(coll, parent.META, rm_keys)
+            for n in moved_names:
+                parent._inventory.pop(n, None)
+            self.store.queue_transaction(txn)
+            if (d := self.dlog.dout(1)) is not None:
+                d(f"split pg {pool_id}.{ps}: moved "
+                  f"{len(moved_names)} objects across {len(moves)} "
+                  f"children (pg_num {old_n} -> {new_n})")
+
     async def _peer_and_recover(self, pg: PG, acting: list[int]) -> bool:
-        """GetInfo -> GetLog -> GetMissing -> push, one pass. True only
-        when the PG is known complete (safe to go active).
+        """GetInfo -> GetLog -> GetMissing -> push/backfill, one pass.
+        True only when the PG is known complete (safe to go active).
 
         Info is collected from acting members AND every other up OSD: a
         remap (cluster expansion, failed host) can hand the whole acting
-        set to newcomers, leaving the authoritative log only on strays."""
+        set to newcomers, leaving the authoritative log only on strays.
+
+        Authority is the max HEAD EVERSION (epoch, version) — the
+        reference's eversion ordering, which makes a new reign's entries
+        outrank a dead primary's divergent same-numbered tail. A member
+        whose log cannot be bridged (behind the tail, or divergent) gets
+        a full backfill instead of log recovery."""
         members = [o for o in acting if o != _NONE and o != self.id]
-        infos: dict[int, int] = {self.id: pg.last_update}
+        infos: dict[int, dict] = {
+            self.id: {"last_update": pg.last_update,
+                      "head": list(pg.head), "tail": pg.log_tail}
+        }
         for osd in set(members) | set(self._up_peers()):
             try:
                 rep = await self._peer_call(
                     osd, "pg_info", {"pgid": [pg.pool, pg.ps]},
                     timeout=2.0,
                 )
-                infos[osd] = rep["last_update"]
+                infos[osd] = rep
             except (asyncio.TimeoutError, RuntimeError):
                 continue
-        best_osd = max(infos, key=lambda o: (infos[o], o == self.id))
+        best_osd = max(
+            infos,
+            key=lambda o: (tuple(infos[o]["head"]), o == self.id),
+        )
         ok = True
-        if infos[best_osd] > pg.last_update:
-            ok = await self._pull_log_and_objects(pg, best_osd, acting)
+        if tuple(infos[best_osd]["head"]) > pg.head:
+            ok = await self._pull_from_authority(
+                pg, best_osd, infos[best_osd], acting
+            )
         member_infos = {
             o: v for o, v in infos.items() if o in members or o == self.id
         }
         pushed = await self._push_missing(pg, acting, member_infos)
         return ok and pushed
 
-    async def _pull_log_and_objects(
-        self, pg: PG, source: int, acting: list[int]
+    def _needs_backfill(self, pg: PG, info: dict) -> bool:
+        """Log recovery can bridge a peer only when its head is an
+        ancestor of ours: same entry at its head version, and within our
+        retained log (PGLog::merge_log's fallback-to-backfill rule)."""
+        head = tuple(info["head"])
+        if head == tuple(pg.head):
+            return False
+        if head == (0, 0):
+            # empty peer: log-bridgeable only if our log reaches back to 0
+            return pg.log_tail > 0
+        if head[1] > pg.last_update or head[1] <= pg.log_tail:
+            return True
+        mine = pg.entry_at(head[1])
+        return mine is None or (
+            mine.get("epoch", 0), mine["version"]
+        ) != head
+
+    async def _pull_from_authority(
+        self, pg: PG, source: int, source_info: dict, acting: list[int]
     ) -> bool:
-        """Adopt a more advanced holder's log (GetLog + pull). Aborts at
-        the first entry whose data is unreachable: appending later entries
-        past a gap would advance last_update and silently orphan the
-        skipped one forever."""
+        """Catch ourselves up from the authoritative holder: log pull when
+        bridgeable, else backfill ourselves from its inventory."""
         rep = await self._peer_call(
-            source, "pg_log", {"pgid": [pg.pool, pg.ps],
-                               "from": pg.last_update},
+            source, "pg_log",
+            {"pgid": [pg.pool, pg.ps], "from": pg.last_update,
+             "head": list(pg.head)},
         )
+        if rep.get("bridgeable"):
+            return await self._apply_log_entries(
+                pg, rep["entries"], acting
+            )
+        return await self._backfill_self(pg, source, acting)
+
+    async def _apply_log_entries(
+        self, pg: PG, entries: list[dict], acting: list[int]
+    ) -> bool:
+        """Adopt a more advanced holder's log tail (GetLog + pull). Aborts
+        at the first entry whose data is unreachable: appending later
+        entries past a gap would advance last_update and silently orphan
+        the skipped one forever."""
         my_shard = self._my_shard(pg, acting)
-        inventory: dict[str, dict] = {}
-        for e in rep["entries"]:
-            inventory[e["name"]] = e
-        for e in rep["entries"]:
+        newest: dict[str, dict] = {}
+        for e in entries:
+            newest[e["name"]] = e
+        for e in entries:
             txn = Transaction()
             if e["kind"] == "delete":
                 txn.remove(pg.coll, shard_name(e["name"], my_shard))
-            elif inventory[e["name"]]["version"] != e["version"]:
+            elif newest[e["name"]]["version"] != e["version"]:
                 pass  # superseded within this pull: newest entry has it
             else:
                 want = shard_name(e["name"], my_shard)
@@ -707,6 +969,60 @@ class OSDService(Dispatcher):
             pg.append_log(txn, e)
             self.store.queue_transaction(txn)
             self.perf.inc("recovery_pulls")
+        return True
+
+    def _local_logical_names(self, pg: PG) -> dict[str, str]:
+        """logical object name -> store name for our copies/shards."""
+        out = {}
+        for sname in self.store.list_objects(pg.coll):
+            if sname == pg.META:
+                continue
+            logical = sname
+            # strip a shard suffix (EC layout folds shard id in the key)
+            base, sep, tail = sname.rpartition(".s")
+            if sep and tail.isdigit():
+                logical = base
+            out[logical] = sname
+        return out
+
+    async def _backfill_self(
+        self, pg: PG, source: int, acting: list[int]
+    ) -> bool:
+        """Full resync FROM the authority: pull its whole inventory,
+        overwrite local objects, drop strays, adopt its log head
+        (recover_backfill in the pulling direction)."""
+        try:
+            rep = await self._peer_call(
+                source, "pg_inventory", {"pgid": [pg.pool, pg.ps]},
+                timeout=10.0,
+            )
+        except (asyncio.TimeoutError, RuntimeError):
+            return False
+        inventory = rep["inventory"]
+        my_shard = self._my_shard(pg, acting)
+        for name, e in sorted(inventory.items()):
+            if e["kind"] == "delete":
+                continue
+            got = await self._pull_object(
+                pg, name, my_shard, acting, e
+            )
+            if got is None:
+                return False
+            txn = Transaction()
+            self._write_fetched(
+                txn, pg.coll, shard_name(name, my_shard), got[0], got[1]
+            )
+            self.store.queue_transaction(txn)
+            self.perf.inc("recovery_pulls")
+        txn = Transaction()
+        for logical, sname in self._local_logical_names(pg).items():
+            e = inventory.get(logical)
+            if e is None or e["kind"] == "delete":
+                txn.remove(pg.coll, sname)
+        pg.reset_log(
+            txn, inventory, tuple(rep["head"]), rep["tail"]
+        )
+        self.store.queue_transaction(txn)
         return True
 
     def _write_fetched(
@@ -826,8 +1142,10 @@ class OSDService(Dispatcher):
                 break
         if len(chunks) < ec.get_data_chunk_count():
             return None
-        decoded = await self.encode_service.decode(ec, {shard}, chunks)
-        return decoded[shard], attrs
+        # serial recovery path: decode directly — routing through the
+        # batch service would pay the window per object with no chance
+        # of coalescing (one outstanding decode at a time)
+        return ec.decode({shard}, chunks)[shard], attrs
 
     async def _pull_object(
         self, pg: PG, name: str, shard: int | None, acting: list[int], entry
@@ -850,24 +1168,39 @@ class OSDService(Dispatcher):
         )
 
     async def _push_missing(
-        self, pg: PG, acting: list[int], infos: dict[int, int]
+        self, pg: PG, acting: list[int], infos: dict[int, dict]
     ) -> bool:
-        """Push log entries + object data to every laggard member; True
-        only when every member is known complete — the PG must not go
-        active on a partial recovery."""
+        """Push log entries + object data to every laggard member — or a
+        full backfill when its log can't be bridged; True only when every
+        member is known complete (the PG must not go active on a partial
+        recovery)."""
         inventory = pg.latest_objects()
         ec = self.codec(pg.pool)
         complete = True
         for pos, osd in enumerate(acting):
             if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
                 continue
-            since = infos.get(osd)
-            if since is None:
+            info = infos.get(osd)
+            if info is None:
                 complete = False  # unreachable member: state unknown
                 continue
-            if since >= pg.last_update:
+            if tuple(info["head"]) > tuple(pg.head):
+                # the member OUTRANKS us (we failed to pull from the
+                # authority this pass): never push — a backfill here
+                # would wipe the only copy of acked writes. Stay
+                # incomplete; the next pass pulls first.
+                complete = False
                 continue
             shard = pos if ec is not None else None
+            if self._needs_backfill(pg, info):
+                if not await self._backfill_member(
+                    pg, acting, osd, shard
+                ):
+                    complete = False
+                continue
+            since = info["last_update"]
+            if since >= pg.last_update:
+                continue
             for e in pg.log_entries(since):
                 latest = inventory.get(e["name"])
                 raw = b""
@@ -902,6 +1235,46 @@ class OSDService(Dispatcher):
                     break  # next pass retries this member
         return complete
 
+    async def _backfill_member(
+        self, pg: PG, acting: list[int], osd: int, shard: int | None
+    ) -> bool:
+        """Full resync TO a member whose log we can't bridge: push every
+        live object at its current version, then hand it our inventory +
+        head so it drops strays and restarts its log (recover_backfill +
+        the reservation throttle, PeeringState WaitRemoteBackfillReserved:
+        osd_max_backfills bounds concurrent backfills we source)."""
+        async with self._backfill_sem:
+            inventory = pg.latest_objects()
+            for name, e in sorted(inventory.items()):
+                if e["kind"] == "delete":
+                    continue
+                got = await self._object_for_push(pg, e, shard, acting)
+                if got is None:
+                    return False
+                data, attrs = got
+                try:
+                    await self._peer_call(
+                        osd, "obj_push",
+                        {"pgid": [pg.pool, pg.ps], "shard": shard,
+                         "entry": e, "has_data": True,
+                         "attrs": _attrs_to(attrs)},
+                        timeout=5.0, raw=data,
+                    )
+                    self.perf.inc("recovery_pushes")
+                except (asyncio.TimeoutError, RuntimeError):
+                    return False
+            try:
+                await self._peer_call(
+                    osd, "pg_backfill_done",
+                    {"pgid": [pg.pool, pg.ps],
+                     "inventory": inventory,
+                     "head": list(pg.head), "tail": pg.log_tail},
+                    timeout=10.0,
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                return False
+            return True
+
     async def _object_for_push(
         self, pg: PG, entry: dict, shard: int | None, acting: list[int]
     ):
@@ -926,15 +1299,62 @@ class OSDService(Dispatcher):
     async def _h_pg_info(self, conn, p) -> None:
         pg = self._pg_of(p["pgid"])
         self._reply_peer(
-            conn, p["tid"], {"last_update": pg.last_update}
+            conn, p["tid"],
+            {"last_update": pg.last_update, "head": list(pg.head),
+             "tail": pg.log_tail},
         )
 
     async def _h_pg_log(self, conn, p) -> None:
+        """Log tail for a puller; `bridgeable` is false when the puller's
+        head is not an ancestor of ours (divergent or behind our tail) —
+        it must backfill instead (merge_log's divergence rule)."""
+        pg = self._pg_of(p["pgid"])
+        frm = p.get("from", 0)
+        bridgeable = frm >= pg.log_tail
+        if bridgeable and p.get("head") is not None:
+            head = tuple(p["head"])
+            if head != (0, 0) and head != tuple(pg.head):
+                mine = pg.entry_at(head[1])
+                if mine is None or (
+                    mine.get("epoch", 0), mine["version"]
+                ) != head:
+                    bridgeable = False
+        self._reply_peer(
+            conn, p["tid"],
+            {"entries": pg.log_entries(frm) if bridgeable else [],
+             "bridgeable": bridgeable, "tail": pg.log_tail},
+        )
+
+    async def _h_pg_inventory(self, conn, p) -> None:
         pg = self._pg_of(p["pgid"])
         self._reply_peer(
             conn, p["tid"],
-            {"entries": pg.log_entries(p.get("from", 0))},
+            {"inventory": pg.latest_objects(), "head": list(pg.head),
+             "tail": pg.log_tail},
         )
+
+    async def _h_pg_backfill_done(self, conn, p) -> None:
+        """Backfill epilogue at the target: adopt the authority's
+        inventory/head, drop strays (objects it no longer has)."""
+        pg = self._pg_of(p["pgid"])
+        async with pg.lock:
+            if tuple(p["head"]) < pg.head:
+                # a stale reign's backfill must never wipe newer state
+                self._reply_peer(
+                    conn, p["tid"], {"ok": False, "stale": True}
+                )
+                return
+            inventory = p["inventory"]
+            txn = Transaction()
+            for logical, sname in self._local_logical_names(pg).items():
+                e = inventory.get(logical)
+                if e is None or e["kind"] == "delete":
+                    txn.remove(pg.coll, sname)
+            pg.reset_log(
+                txn, inventory, tuple(p["head"]), p["tail"]
+            )
+            self.store.queue_transaction(txn)
+        self._reply_peer(conn, p["tid"], {"ok": True})
 
     async def _h_obj_read(self, conn, p) -> None:
         """handle_sub_read: local read (+ version check when asked)."""
@@ -1060,6 +1480,12 @@ class OSDService(Dispatcher):
         while not self._stopped:
             item = shard.queue.dequeue()
             if item is None:
+                if len(shard.queue):
+                    # mclock limit throttling: ops exist but none are
+                    # eligible until the clock advances — poll, don't
+                    # sleep on the kick (no new op may ever arrive)
+                    await asyncio.sleep(0.005)
+                    continue
                 shard.kick.clear()
                 await shard.kick.wait()
                 continue
@@ -1305,8 +1731,14 @@ class OSDService(Dispatcher):
         (make_writeable); `snapid` (reads) redirects the context to the
         clone covering that snap."""
         if reqid is not None and reqid in pg._reqids:
-            # duplicate of an already-committed op (client resend after a
-            # lost reply / primary failover): never re-execute a mutation
+            # duplicate of an already-logged op (client resend after a
+            # lost reply / primary failover): never re-execute the
+            # mutation — but if the original aborted mid-fan-out, finish
+            # distributing its result first, or this ack would cover a
+            # write that lives on too few members
+            if reqid not in pg._reqids_done:
+                await self._complete_entry_forward(pg, acting, name)
+                pg._reqids_done.add(reqid)
             return [], b""
         ec = self.codec(pg.pool)
         mutating = is_mutating(ops)
@@ -1362,6 +1794,7 @@ class OSDService(Dispatcher):
             "name": name,
             "obj_ver": self._obj_version(pg, name) + 1,
             "kind": "delete" if state.deleted else "modify",
+            "epoch": self.osdmap.epoch,
         }
         if reqid is not None:
             entry["reqid"] = reqid
@@ -1416,6 +1849,8 @@ class OSDService(Dispatcher):
                 xattrs=state.xattrs, user_blob=user,
                 pre_encoded=pre_encoded,
             )
+        if reqid is not None:
+            pg._reqids_done.add(reqid)
         return results, b"".join(reads)
 
     def _head_xattrs(self, pg: PG, acting: list[int], name: str) -> dict:
@@ -1480,6 +1915,7 @@ class OSDService(Dispatcher):
                 "obj_ver": self._obj_version(pg, name),
                 "kind": "clone",
                 "src": name,
+                "epoch": self.osdmap.epoch,
             }
             ec = self.codec(pg.pool)
             waits = []
@@ -1526,6 +1962,43 @@ class OSDService(Dispatcher):
         omap = self.store.omap_get(pg.coll, src)
         if omap:
             txn.omap_setkeys(pg.coll, dst, omap)
+
+    async def _complete_entry_forward(
+        self, pg: PG, acting: list[int], name: str
+    ) -> None:
+        """Finish a partially-fanned entry by pushing the object's current
+        full state (idempotent: version-gated at receivers) to every live
+        acting member — the forward-completion half of the reference's
+        in-progress-op handling."""
+        entry = pg.latest_objects().get(name)
+        if entry is None:
+            return
+        ec = self.codec(pg.pool)
+        for pos, osd in enumerate(acting):
+            if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
+                continue
+            shard = pos if ec is not None else None
+            if entry["kind"] == "delete":
+                payload = {"entry": entry, "has_data": False}
+                raw = b""
+            else:
+                got = await self._object_for_push(
+                    pg, entry, shard, acting
+                )
+                if got is None:
+                    continue  # peering completes it when sources return
+                raw, attrs = got
+                payload = {"entry": entry, "has_data": True,
+                           "attrs": _attrs_to(attrs)}
+            try:
+                await self._peer_call(
+                    osd, "obj_push",
+                    {"pgid": [pg.pool, pg.ps], "shard": shard,
+                     **payload},
+                    timeout=5.0, raw=raw,
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                continue
 
     async def _load_state_ec(
         self, pg: PG, acting: list[int], name: str, need_data: bool = True
@@ -1676,6 +2149,7 @@ class OSDService(Dispatcher):
             "name": name,
             "obj_ver": self._obj_version(pg, name) + 1,
             "kind": "modify",
+            "epoch": self.osdmap.epoch,
         }
         user_blob = (
             json.dumps(user_attrs, sort_keys=True).encode()
@@ -1728,6 +2202,7 @@ class OSDService(Dispatcher):
             "name": name,
             "obj_ver": self._obj_version(pg, name) + 1,
             "kind": "delete",
+            "epoch": self.osdmap.epoch,
         }
         self._check_min_size(pg, acting)
         ec = self.codec(pg.pool)
@@ -2005,6 +2480,35 @@ class OSDService(Dispatcher):
                     "ec_launches": self.encode_service.launches,
                     "ec_objects": self.encode_service.objects,
                 }
+            elif cmd == "pool_stats":
+                # per-pool objects/bytes for PGs this OSD is primary of
+                # (the pg_stat_t aggregation the mgr's autoscaler reads)
+                stats: dict[int, dict] = {}
+                for (pool_id, ps), pg in self.pgs.items():
+                    acting, primary = self.acting_of(pool_id, ps)
+                    if primary != self.id:
+                        continue
+                    st = stats.setdefault(
+                        pool_id, {"objects": 0, "bytes": 0, "pgs": 0}
+                    )
+                    st["pgs"] += 1
+                    for name, entry in pg.latest_objects().items():
+                        if entry["kind"] == "delete":
+                            continue
+                        st["objects"] += 1
+                        try:
+                            sname = shard_name(
+                                name,
+                                self._my_shard(pg, acting),
+                            ) if self.codec(pool_id) is not None else name
+                            attrs = self.store.getattrs(pg.coll, sname)
+                            size = attrs.get("size")
+                            if size is None:
+                                size = len(self.store.read(pg.coll, sname))
+                            st["bytes"] += size
+                        except StoreError:
+                            pass
+                result = {str(k): v for k, v in stats.items()}
             elif cmd == "log dump":
                 result = {"entries": self.logs.dump_recent()}
             elif cmd == "dump_ops_in_flight":
@@ -2198,9 +2702,8 @@ class OSDService(Dispatcher):
             if ec is not None:
                 if len(chunks) < ec.get_data_chunk_count():
                     continue
-                data = (
-                    await self.encode_service.decode(ec, {shard}, chunks)
-                )[shard]
+                # serial repair loop: direct decode (see _rebuild_shard)
+                data = ec.decode({shard}, chunks)[shard]
             elif chunks:
                 # replicated: the digest-majority copy wins (ties -> the
                 # lowest acting position, like be_select_auth_object)

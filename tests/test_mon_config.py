@@ -1,0 +1,124 @@
+"""The centralized config service (ConfigMonitor): `config set` commits
+through Paxos, distributes to subscribed daemons' mon config tier, and
+survives interleaving with osdmap commits. Plus osd_op_queue=mclock:
+a live cluster whose op shards schedule with dmclock tags."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster, wait_until
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+def test_config_set_round_trips_to_daemons():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        rados = Rados("client.cfg", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+
+        # commit a central option; every daemon's config must reflect it
+        await rados.mon_command(
+            "config set",
+            {"name": "osd_recovery_max_active", "value": "7"},
+        )
+        got = await rados.mon_command(
+            "config get", {"name": "osd_recovery_max_active"}
+        )
+        assert got["value"] == "7"
+        await wait_until(
+            lambda: all(
+                o.config.get("osd_recovery_max_active") == 7
+                for o in cluster.osds.values()
+            ),
+            timeout=20,
+        )
+        for o in cluster.osds.values():
+            assert o.config.source_of("osd_recovery_max_active") == "mon"
+
+        # typed validation happens before commit
+        with pytest.raises(Exception):
+            await rados.mon_command(
+                "config set",
+                {"name": "no_such_option", "value": "1"},
+            )
+        with pytest.raises(Exception):
+            await rados.mon_command(
+                "config set",
+                {"name": "osd_recovery_max_active", "value": "-3"},
+            )
+
+        # the config log interleaves with osdmap commits without
+        # corrupting the epoch stream (subscribers keep advancing)
+        before = rados.objecter.osdmap.epoch
+        await rados.mon_command(
+            "config set", {"name": "mon_lease", "value": "0.1"}
+        )
+        io = rados.io_ctx(REP_POOL)
+        await io.write_full("after-config", b"x")
+        assert await io.read("after-config") == b"x"
+        assert rados.objecter.osdmap.epoch >= before
+
+        # rm clears the central tier
+        await rados.mon_command(
+            "config rm", {"name": "osd_recovery_max_active"}
+        )
+        await wait_until(
+            lambda: all(
+                o.config.source_of("osd_recovery_max_active")
+                == "default"
+                for o in cluster.osds.values()
+            ),
+            timeout=20,
+        )
+
+        # a freshly-booted daemon receives the committed config on
+        # subscribe (mon-tier values present before it serves)
+        await rados.mon_command(
+            "config set", {"name": "osd_max_backfills", "value": "2"}
+        )
+        new_osd = await cluster.start_osd(97)
+        await wait_until(
+            lambda: new_osd.config.get("osd_max_backfills") == 2,
+            timeout=20,
+        )
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_mclock_scheduled_live_io():
+    from tests.test_cluster_live import live_config
+
+    async def main():
+        cfg = live_config()
+        cfg.set("osd_op_queue", "mclock")
+        cluster = Cluster(cfg=cfg)
+        await cluster.start()
+        rados = Rados("client.mc", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        from ceph_tpu.common.op_queue import MClockOpQueue
+
+        for o in cluster.osds.values():
+            assert all(
+                isinstance(s.queue, MClockOpQueue) for s in o._op_shards
+            )
+        io = rados.io_ctx(EC_POOL)
+        payloads = {f"m{i}": bytes([i]) * 2048 for i in range(16)}
+        await asyncio.gather(
+            *(io.write_full(k, v) for k, v in payloads.items())
+        )
+        for k, v in payloads.items():
+            assert await io.read(k) == v
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())

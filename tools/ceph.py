@@ -97,9 +97,37 @@ async def _dispatch(rados, args) -> dict:
             )
         raise SystemExit(f"unknown osd subcommand {sub!r}")
 
+    if cmd == "config":
+        sub = args.rest[0]
+        if sub == "set":
+            return await rados.mon_command(
+                "config set",
+                {"name": args.rest[1], "value": args.rest[2]},
+            )
+        if sub == "get":
+            return await rados.mon_command(
+                "config get", {"name": args.rest[1]}
+            )
+        if sub == "rm":
+            return await rados.mon_command(
+                "config rm", {"name": args.rest[1]}
+            )
+        if sub == "dump":
+            return await rados.mon_command("config dump", {})
+        raise SystemExit(f"unknown config subcommand {sub!r}")
     if cmd == "pg" and args.rest[0] == "dump":
         return _pg_dump(rados.objecter.osdmap, args.pool)
 
+    if cmd == "prometheus":
+        from ceph_tpu.mgr import PrometheusExporter
+
+        text = await PrometheusExporter(rados.objecter).collect()
+        return {"metrics": text}
+    if cmd == "autoscaler":
+        from ceph_tpu.mgr import PgAutoscaler
+
+        apply = len(args.rest) > 0 and args.rest[0] == "apply"
+        return await PgAutoscaler(rados.objecter).run_once(apply=apply)
     if cmd == "balancer" and args.rest[0] == "run":
         from ceph_tpu.mgr import BalancerModule
 
