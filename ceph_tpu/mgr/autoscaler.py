@@ -58,10 +58,12 @@ class PgAutoscaler:
                 else 1.0 / max(1, len(osdmap.pools))
             )
             ideal = budget * share / max(1, pool.size)
-            # round to the nearest power of two, floor 8 (the module's
+            # round to the NEAREST power of two, floor 8 (the module's
             # nearest_power_of_two + min guard)
             p = 8
             while p * 2 <= ideal:
+                p *= 2
+            if ideal - p > p * 2 - ideal:
                 p *= 2
             entry = {
                 "current": pool.pg_num,

@@ -73,6 +73,16 @@ class MonClient(Dispatcher):
             await self._chained.ms_dispatch(conn, msg)
 
     async def ms_handle_reset(self, conn) -> None:
+        # losing our monitor session must not freeze the map stream: hunt
+        # to the next mon and resubscribe from where we are
+        # (MonClient::_reopen_session on session reset)
+        if conn.peer_name and conn.peer_name.startswith("mon."):
+            self.target_rank = (
+                self.target_rank + 1
+            ) % self.monmap.size
+            self.subscribe(
+                from_epoch=self.osdmap.epoch if self.osdmap else 0
+            )
         if self._chained is not None:
             await self._chained.ms_handle_reset(conn)
 
@@ -109,11 +119,28 @@ class MonClient(Dispatcher):
             )
         )
 
-    async def wait_for_map(self, timeout: float = 10.0) -> OSDMap:
+    async def wait_for_map(self, timeout: float = 15.0) -> OSDMap:
+        """Hunt across monitors until a map arrives (MonClient::_reopen_
+        session hunting): the configured target may be down — rotate and
+        resubscribe instead of timing out against one dead mon."""
         if self.osdmap is None:
             self.subscribe()
-        await asyncio.wait_for(self._map_event.wait(), timeout)
-        return self.osdmap
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while True:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                raise asyncio.TimeoutError("no monitor produced a map")
+            try:
+                await asyncio.wait_for(
+                    self._map_event.wait(), min(2.5, remaining)
+                )
+                return self.osdmap
+            except asyncio.TimeoutError:
+                self.target_rank = (
+                    self.target_rank + 1
+                ) % self.monmap.size
+                self.subscribe()
 
     # -- commands + reports ---------------------------------------------------
 

@@ -119,6 +119,11 @@ class Monitor(Dispatcher):
         #: map epoch -> paxos version that produced it (services share
         #: one paxos log, so the 1:1 version<->epoch shortcut is gone)
         self._epoch_versions: dict[int, int] = {}
+        #: (pool, ps) -> [(epoch, acting, primary)] acting-set intervals,
+        #: rebuilt deterministically at replay — the past_intervals
+        #: source peering consults so a stale quorum can never go active
+        #: without contacting a possibly-newer interval's member
+        self._acting_archive: dict[tuple, list] = {}
         self._last_applied_service = ""
         self._replay_committed()
         #: peer_name -> (connection, from_epoch) map subscribers
@@ -130,6 +135,7 @@ class Monitor(Dispatcher):
         #: pool -> highest snap id handed out but possibly uncommitted
         self._pending_snap_seq: dict[int, int] = {}
         self._tasks: list[asyncio.Task] = []
+        self._ephemeral: set[asyncio.Task] = set()
         self._stopped = False
 
     # -- persistence helpers --------------------------------------------------
@@ -193,9 +199,9 @@ class Monitor(Dispatcher):
             if extra is not None:
                 self._tasks.append(extra)
         self._election_task = self._lease_task = None
-        for t in self._tasks:
+        for t in list(self._tasks) + list(self._ephemeral):
             t.cancel()
-        for t in self._tasks:
+        for t in list(self._tasks) + list(self._ephemeral):
             try:
                 await t
             except (asyncio.CancelledError, Exception):
@@ -488,6 +494,7 @@ class Monitor(Dispatcher):
             inc.epoch = self.osdmap.epoch + 1
             self.osdmap.apply_incremental(inc)
             self._epoch_versions[inc.epoch] = version
+            self._archive_actings(inc)
         elif service == "config":
             # {"set": {k: v}, "rm": [k]} — the ConfigMonitor delta
             delta = json.loads(payload)
@@ -495,6 +502,47 @@ class Monitor(Dispatcher):
                 self.config_kv[k] = v
             for k in delta.get("rm", []):
                 self.config_kv.pop(k, None)
+
+    def _archive_actings(self, inc: Incremental) -> None:
+        """Append changed acting sets to the per-PG interval archive.
+        Only PGs the inc can affect are recomputed: osd/crush/pool-level
+        changes touch everything, pg_temp/upmap incs touch their named
+        PGs, and snap/addr-only incs touch nothing."""
+        osd_level = bool(
+            inc.new_up or inc.new_down or inc.new_weight
+            or inc.new_primary_affinity or inc.new_crush_text is not None
+            or inc.new_max_osd is not None or inc.new_pools
+            or inc.old_pools
+        )
+        if osd_level:
+            targets = [
+                (pid, ps)
+                for pid, pool in self.osdmap.pools.items()
+                for ps in range(pool.pg_num)
+            ]
+        else:
+            named = (
+                set(inc.new_pg_temp) | set(inc.new_primary_temp)
+                | set(inc.new_pg_upmap) | set(inc.old_pg_upmap)
+                | set(inc.new_pg_upmap_items)
+                | set(inc.old_pg_upmap_items)
+            )
+            targets = [tuple(pg) for pg in named]
+        for key in targets:
+            pid, ps = key
+            pool = self.osdmap.pools.get(pid)
+            if pool is None or ps >= pool.pg_num:
+                continue
+            _up, _upp, acting, primary = (
+                self.osdmap.pg_to_up_acting_osds(pid, ps)
+            )
+            arch = self._acting_archive.setdefault(key, [])
+            if (
+                not arch
+                or arch[-1][1] != acting
+                or arch[-1][2] != primary
+            ):
+                arch.append((self.osdmap.epoch, list(acting), primary))
 
     # -- map subscription / publication ---------------------------------------
 
@@ -547,10 +595,23 @@ class Monitor(Dispatcher):
 
     # -- dispatch -------------------------------------------------------------
 
+    #: handlers that may await a Paxos commit: they run as tasks, never
+    #: inline — a proposal-awaiting handler inside dispatch stalls every
+    #: later frame on that connection (command replies, subscriptions),
+    #: and a pg_temp flood turns that into seconds of starvation
+    _SLOW_HANDLERS = frozenset(
+        {"osd_failure", "osd_boot", "pg_temp", "mon_command"}
+    )
+
     async def ms_dispatch(self, conn, msg: Message) -> None:
         p = json.loads(msg.data) if msg.data else {}
         handler = getattr(self, f"_h_{msg.type}", None)
         if handler is None:
+            return
+        if msg.type in self._SLOW_HANDLERS:
+            task = asyncio.create_task(self._run_shielded(handler, conn, p))
+            self._ephemeral.add(task)
+            task.add_done_callback(self._ephemeral.discard)
             return
         try:
             await handler(conn, p)
@@ -560,6 +621,14 @@ class Monitor(Dispatcher):
             # a handler failure (e.g. an aborted proposal) must not tear
             # down the transport read loop it runs in
             pass
+
+    async def _run_shielded(self, handler, conn, p) -> None:
+        try:
+            await handler(conn, p)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass  # reporters retry; commands replied their error already
 
     async def ms_handle_accept(self, conn) -> None:
         # a reconnecting subscriber re-attaches at its old watermark and
@@ -1041,6 +1110,37 @@ class Monitor(Dispatcher):
                 )
             )
             return {"applied": len(new_items), "removed": len(old_items)}
+        if cmd == "pg history":
+            # acting-set intervals since `from` (+ the one spanning it):
+            # the past_intervals feed for peering's stale-quorum gate.
+            # Bulk: {"queries": {"pool.ps": from}} answers every PG a
+            # daemon hosts in ONE round trip — per-PG commands from every
+            # daemon on every epoch would swamp the mon.
+            def intervals_for(key, frm):
+                arch = self._acting_archive.get(key, [])
+                out = []
+                for i, (epoch, acting, primary) in enumerate(arch):
+                    end = (
+                        arch[i + 1][0] - 1
+                        if i + 1 < len(arch) else self.osdmap.epoch
+                    )
+                    if end >= frm:
+                        out.append([epoch, acting, primary])
+                return out
+
+            if "queries" in args:
+                return {
+                    "histories": {
+                        pgid: intervals_for(
+                            tuple(int(x) for x in pgid.split(".")), frm
+                        )
+                        for pgid, frm in args["queries"].items()
+                    }
+                }
+            key = (args["pgid"][0], args["pgid"][1])
+            return {
+                "intervals": intervals_for(key, args.get("from", 0))
+            }
         if cmd == "config set":
             # validate against the typed schema before committing (the
             # ConfigMonitor rejects unknown/ill-typed options the same way)
@@ -1124,7 +1224,34 @@ class Monitor(Dispatcher):
         )
 
         pool_id = args["pool_id"]
-        if pool_id in self.osdmap.pools:
+        existing = self.osdmap.pools.get(pool_id)
+        if existing is not None:
+            # idempotent for client retries: a create whose reply was
+            # lost re-arrives after the commit — the SAME geometry is a
+            # success, anything else is EEXIST (mon commands carry no
+            # reqids, so geometry equality is the dedup test)
+            want_type = (
+                TYPE_ERASURE
+                if args.get("erasure_code_profile") else TYPE_REPLICATED
+            )
+            want_pg_num = args.get(
+                "pg_num", self.config.get("osd_pool_default_pg_num")
+            )
+            same = (
+                existing.type == want_type
+                and existing.pg_num == want_pg_num
+            )
+            if want_type == TYPE_ERASURE:
+                same = same and (
+                    existing.erasure_code_profile
+                    == args.get("erasure_code_profile", "")
+                )
+            else:
+                same = same and existing.size == args.get(
+                    "size", self.config.get("osd_pool_default_size")
+                )
+            if same:
+                return {"pool_id": pool_id, "existed": True}
             raise ValueError(f"pool {pool_id} exists")
         profile_name = args.get("erasure_code_profile", "")
         if profile_name:

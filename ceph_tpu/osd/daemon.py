@@ -129,6 +129,10 @@ class PG:
         self._last_update = 0
         #: versions <= log_tail have been trimmed from the log
         self._log_tail = 0
+        #: last epoch this PG went active under us as primary
+        #: (last_epoch_started): the horizon past-interval checks reach
+        #: back to
+        self.les = 0
         #: eversion of the newest entry: (epoch it was written in,
         #: version) — the reference's eversion_t, what makes two reigns'
         #: same-numbered entries distinguishable for divergence handling
@@ -152,12 +156,18 @@ class PG:
             self._last_update = info.get("last_update", 0)
             self._log_tail = info.get("log_tail", 0)
             self._head = tuple(info.get("head", (0, 0)))
+            self.les = info.get("les", 0)
+        #: retained log mirror (bounded by osd_min_pg_log_entries):
+        #: version -> entry, so entry_at/log_entries never rescan the
+        #: whole pg-meta omap (which also holds the full inventory)
+        self._log: dict[int, dict] = {}
         for k, v in sorted(omap.items()):
             if k.startswith(b"obj/"):
                 e = json.loads(v)
                 self._inventory[e["name"]] = e
             elif k.startswith(b"log/"):
                 e = json.loads(v)
+                self._log[e["version"]] = e
                 self._last_update = max(self._last_update, e["version"])
                 if e.get("reqid"):
                     self._reqids[e["reqid"]] = e["version"]
@@ -169,6 +179,14 @@ class PG:
         #: never serve ENOENT for an object it simply hasn't learned yet
         self.active = False
         self.last_acting: list[int] | None = None
+        #: lock-taking sub-ops run through this per-PG queue instead of
+        #: the connection's dispatch loop — a handler awaiting pg.lock
+        #: inside dispatch would stall every later frame on that
+        #: connection, and lock-holders calling peers whose dispatch is
+        #: likewise stalled deadlock ACROSS daemons (the reference keeps
+        #: its messenger fast-dispatch non-blocking for the same reason)
+        self.subop_q: asyncio.Queue = asyncio.Queue()
+        self.subop_task: asyncio.Task | None = None
 
     # -- the persisted log ----------------------------------------------------
 
@@ -184,32 +202,30 @@ class PG:
     def head(self) -> tuple[int, int]:
         return self._head
 
-    def _scan_log(self, from_version: int = 0) -> list[dict]:
-        out = []
-        for k, v in sorted(
-            self.service.store.omap_get(self.coll, self.META).items()
-        ):
-            if k.startswith(b"log/"):
-                e = json.loads(v)
-                if e["version"] > from_version:
-                    out.append(e)
-        return out
-
     def log_entries(self, from_version: int = 0) -> list[dict]:
-        return self._scan_log(from_version)
+        return [
+            self._log[v] for v in sorted(self._log)
+            if v > from_version
+        ]
 
     def entry_at(self, version: int) -> dict | None:
-        raw = self.service.store.omap_get(self.coll, self.META).get(
-            b"log/%016x" % version
-        )
-        return json.loads(raw) if raw else None
+        return self._log.get(version)
 
     def _info_blob(self) -> bytes:
         return json.dumps(
             {"last_update": self._last_update,
              "log_tail": self._log_tail,
-             "head": list(self._head)}
+             "head": list(self._head),
+             "les": self.les}
         ).encode()
+
+    def set_les(self, epoch: int) -> None:
+        self.les = max(self.les, epoch)
+        self.service.store.queue_transaction(
+            Transaction().omap_setkeys(
+                self.coll, self.META, {b"info": self._info_blob()}
+            )
+        )
 
     def append_log(self, txn: Transaction, entry: dict) -> None:
         """Record `entry` in the transaction AND the in-memory mirror; the
@@ -220,6 +236,7 @@ class PG:
         ev = (entry.get("epoch", 0), entry["version"])
         if ev > self._head:
             self._head = ev
+        self._log[entry["version"]] = entry
         rows = {
             b"log/%016x" % entry["version"]: json.dumps(entry).encode(),
             b"obj/" + entry["name"].encode(): (
@@ -234,6 +251,8 @@ class PG:
                 [b"log/%016x" % v
                  for v in range(self._log_tail + 1, new_tail + 1)],
             )
+            for v in range(self._log_tail + 1, new_tail + 1):
+                self._log.pop(v, None)
             self._log_tail = new_tail
             # the dup-detection horizon tracks the trimmed log: reqids
             # below the tail are forgotten in memory exactly as a
@@ -267,6 +286,7 @@ class PG:
         )
         self._inventory = {}
         self._reqids = {}
+        self._log = {}
         rows = {}
         for name, e in inventory.items():
             rows[b"obj/" + name.encode()] = json.dumps(e).encode()
@@ -383,6 +403,8 @@ class OSDService(Dispatcher):
         self._next_reboot = 0.0
         self._acting_cache: dict[tuple[int, int], tuple] = {}
         self._acting_cache_epoch = -1
+        self._hist_cache: dict[tuple[int, int], list] = {}
+        self._hist_cache_epoch = -1
         #: bounds concurrent backfills we source (osd_max_backfills /
         #: the reservation sched_scrub-style throttle)
         self._backfill_sem = asyncio.Semaphore(
@@ -429,6 +451,7 @@ class OSDService(Dispatcher):
               f"epoch {self.osdmap.epoch}")
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._peering_loop()))
+        self._tasks.append(asyncio.create_task(self._resub_loop()))
         for shard in self._op_shards:
             self._tasks.append(
                 asyncio.create_task(self._op_shard_worker(shard))
@@ -559,6 +582,22 @@ class OSDService(Dispatcher):
             peers.update(o for o in acting if o != _NONE and o != self.id)
         return peers
 
+    async def _resub_loop(self) -> None:
+        """Periodic subscription refresh: a monitor that restarted loses
+        its subscriber table, and our lossless connection reconnects
+        SILENTLY — without this the daemon's map stream freezes forever
+        (MonClient::tick's renew_subs role). Idempotent and cheap: the
+        mon replies only the incrementals we lack."""
+        interval = max(1.0, self.config.get("mon_lease") * 2)
+        while not self._stopped:
+            await asyncio.sleep(interval)
+            try:
+                self.mon.subscribe(
+                    from_epoch=self.osdmap.epoch if self.osdmap else 0
+                )
+            except Exception:
+                pass
+
     async def _heartbeat_loop(self) -> None:
         """Periodic concurrent pings + a separate deadline scan (the
         reference's tick-driven MOSDPing send vs heartbeat_check split,
@@ -682,7 +721,15 @@ class OSDService(Dispatcher):
                 pg.last_acting = None
                 continue
             if pg.active and pg.last_acting == acting:
-                continue
+                # same acting as when we activated — but an interval may
+                # have come and GONE in between (member died and revived
+                # while our peering pass was busy elsewhere): if the
+                # interval archive shows nothing since activation, skip;
+                # otherwise re-peer, or a flapped member would silently
+                # keep missing every write from the gap interval
+                ivs = await self._pg_history(pg)
+                if ivs is None or all(iv[0] <= pg.les for iv in ivs):
+                    continue
             pg.active = False
             try:
                 async with pg.lock:
@@ -690,6 +737,7 @@ class OSDService(Dispatcher):
                 if complete:
                     pg.active = True
                     pg.last_acting = list(acting)
+                    pg.set_les(m.epoch)
                     if (d := self.dlog.dout(5)) is not None:
                         d(f"pg {pool_id}.{ps} active, acting {acting}")
                 else:
@@ -856,6 +904,7 @@ class OSDService(Dispatcher):
             for le in parent.log_entries(0):
                 if le["name"] in moved_names:
                     rm_keys.append(b"log/%016x" % le["version"])
+                    parent._log.pop(le["version"], None)
             txn.omap_rmkeys(coll, parent.META, rm_keys)
             for n in moved_names:
                 parent._inventory.pop(n, None)
@@ -892,6 +941,22 @@ class OSDService(Dispatcher):
                 infos[osd] = rep
             except (asyncio.TimeoutError, RuntimeError):
                 continue
+        # past-intervals gate (PeeringState::build_prior): any interval
+        # since our last activation that could have served writes must
+        # have at least one member among the peers we actually reached —
+        # else an unreached member may hold acked writes we cannot see,
+        # and going active would serve (and later un-serve) stale state
+        intervals = await self._pg_history(pg)
+        if intervals is None:
+            return False  # no map history without a mon quorum: wait
+        pool = self.osdmap.pools[pg.pool]
+        contacted = set(infos)
+        for _epoch, acting_h, primary_h in intervals:
+            live = [o for o in acting_h if o != _NONE]
+            if primary_h in (-1, _NONE) or len(live) < pool.min_size:
+                continue  # could not have gone active
+            if not (set(live) & contacted):
+                return False
         best_osd = max(
             infos,
             key=lambda o: (tuple(infos[o]["head"]), o == self.id),
@@ -906,6 +971,31 @@ class OSDService(Dispatcher):
         }
         pushed = await self._push_missing(pg, acting, member_infos)
         return ok and pushed
+
+    async def _pg_history(self, pg: PG):
+        """Past intervals for `pg`, fetched in ONE bulk mon command per
+        map epoch for every local PG and memoized (per-PG commands from
+        the whole fleet each epoch would swamp the mon and the loop)."""
+        epoch = self.osdmap.epoch
+        key = (pg.pool, pg.ps)
+        if self._hist_cache_epoch != epoch or key not in self._hist_cache:
+            queries = {
+                f"{p}.{s}": self.pgs[(p, s)].les
+                for (p, s) in self.pgs
+            }
+            queries[f"{pg.pool}.{pg.ps}"] = pg.les
+            try:
+                rep = await self.mon.command(
+                    "pg history", {"queries": queries}, timeout=8.0
+                )
+            except Exception:
+                return None
+            self._hist_cache = {
+                tuple(int(x) for x in pgid.split(".")): iv
+                for pgid, iv in rep["histories"].items()
+            }
+            self._hist_cache_epoch = epoch
+        return self._hist_cache.get(key, [])
 
     def _needs_backfill(self, pg: PG, info: dict) -> bool:
         """Log recovery can bridge a peer only when its head is an
@@ -1334,6 +1424,9 @@ class OSDService(Dispatcher):
         )
 
     async def _h_pg_backfill_done(self, conn, p) -> None:
+        self._enqueue_subop(p, self._do_pg_backfill_done, conn)
+
+    async def _do_pg_backfill_done(self, conn, p) -> None:
         """Backfill epilogue at the target: adopt the authority's
         inventory/head, drop strays (objects it no longer has)."""
         pg = self._pg_of(p["pgid"])
@@ -1379,6 +1472,9 @@ class OSDService(Dispatcher):
         )
 
     async def _h_obj_push(self, conn, p) -> None:
+        self._enqueue_subop(p, self._do_obj_push, conn)
+
+    async def _do_obj_push(self, conn, p) -> None:
         """Recovery push: store the object/shard + its log entry."""
         pg = self._pg_of(p["pgid"])
         e = p["entry"]
@@ -1397,6 +1493,9 @@ class OSDService(Dispatcher):
         self._reply_peer(conn, p["tid"], {"ok": True})
 
     async def _h_rep_write(self, conn, p) -> None:
+        self._enqueue_subop(p, self._do_rep_write, conn)
+
+    async def _do_rep_write(self, conn, p) -> None:
         """ReplicatedBackend sub-write: apply locally, ack; idempotent on
         resend (the entry version gate)."""
         pg = self._pg_of(p["pgid"])
@@ -1423,6 +1522,9 @@ class OSDService(Dispatcher):
         self._reply_peer(conn, p["tid"], {"ok": True})
 
     async def _h_ec_sub_write(self, conn, p) -> None:
+        self._enqueue_subop(p, self._do_ec_sub_write, conn)
+
+    async def _do_ec_sub_write(self, conn, p) -> None:
         """ECBackend::handle_sub_write for our shard."""
         pg = self._pg_of(p["pgid"])
         e = p["entry"]
@@ -1456,6 +1558,26 @@ class OSDService(Dispatcher):
         if key not in self.pgs:
             self.pgs[key] = PG(self, *key)
         return self.pgs[key]
+
+    def _enqueue_subop(self, p, fn, conn) -> None:
+        """Queue a lock-taking sub-op for ordered per-PG execution off
+        the dispatch path (per-connection arrival order is preserved by
+        the FIFO, which is the ordering _sub_op_persist relies on)."""
+        pg = self._pg_of(p["pgid"])
+        if pg.subop_task is None or pg.subop_task.done():
+            pg.subop_task = asyncio.create_task(self._subop_worker(pg))
+            self._tasks.append(pg.subop_task)
+        pg.subop_q.put_nowait((fn, conn, p))
+
+    async def _subop_worker(self, pg: PG) -> None:
+        while not self._stopped:
+            fn, conn, p = await pg.subop_q.get()
+            try:
+                await fn(conn, p)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # the sender retries; never kill the worker
 
     # -- client ops (the primary path) ----------------------------------------
 
@@ -1737,7 +1859,15 @@ class OSDService(Dispatcher):
             # distributing its result first, or this ack would cover a
             # write that lives on too few members
             if reqid not in pg._reqids_done:
-                await self._complete_entry_forward(pg, acting, name)
+                if not await self._complete_entry_forward(
+                    pg, acting, name
+                ):
+                    # some live member still lacks the entry: do NOT ack
+                    # (the write would exist on too few members); the
+                    # client's next resend tries again
+                    raise RuntimeError(
+                        f"op {reqid} logged but not fully replicated yet"
+                    )
                 pg._reqids_done.add(reqid)
             return [], b""
         ec = self.codec(pg.pool)
@@ -1965,15 +2095,18 @@ class OSDService(Dispatcher):
 
     async def _complete_entry_forward(
         self, pg: PG, acting: list[int], name: str
-    ) -> None:
+    ) -> bool:
         """Finish a partially-fanned entry by pushing the object's current
         full state (idempotent: version-gated at receivers) to every live
         acting member — the forward-completion half of the reference's
-        in-progress-op handling."""
+        in-progress-op handling. True only when EVERY live member took
+        the push: acking on anything less would cover a write that still
+        lives on too few members to survive the next failure."""
         entry = pg.latest_objects().get(name)
         if entry is None:
-            return
+            return True
         ec = self.codec(pg.pool)
+        ok = True
         for pos, osd in enumerate(acting):
             if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
                 continue
@@ -1986,7 +2119,8 @@ class OSDService(Dispatcher):
                     pg, entry, shard, acting
                 )
                 if got is None:
-                    continue  # peering completes it when sources return
+                    ok = False  # sources unavailable right now
+                    continue
                 raw, attrs = got
                 payload = {"entry": entry, "has_data": True,
                            "attrs": _attrs_to(attrs)}
@@ -1998,7 +2132,8 @@ class OSDService(Dispatcher):
                     timeout=5.0, raw=raw,
                 )
             except (asyncio.TimeoutError, RuntimeError):
-                continue
+                ok = False
+        return ok
 
     async def _load_state_ec(
         self, pg: PG, acting: list[int], name: str, need_data: bool = True
@@ -2087,6 +2222,9 @@ class OSDService(Dispatcher):
             await asyncio.gather(*waits)
 
     async def _h_rep_ops(self, conn, p) -> None:
+        self._enqueue_subop(p, self._do_rep_ops, conn)
+
+    async def _do_rep_ops(self, conn, p) -> None:
         """Replica-side op-vector application (the sub-op carries the ops,
         the reference carries the compiled transaction — both re-apply
         deterministically; _sub_op_persist guarantees in-order arrival)."""

@@ -19,6 +19,7 @@
  *
  * Output: one line per x: "x: id id id ..." (raw ids; CRUSH_ITEM_NONE as-is)
  */
+#include <pthread.h>
 #include <stdio.h>
 #include <time.h>
 #include <stdlib.h>
@@ -32,6 +33,37 @@
 #define MAX_CA 256
 static struct crush_choose_arg choose_args[MAX_CA];
 static int have_choose_args = 0;
+
+/* benchrunmt: the ParallelPGMapper comparator (OSDMapMapping.h:18) — the
+ * honest CPU baseline is the reference's thread-pool sharded mapping, not
+ * one thread. Each worker gets its own workspace/result scratch, exactly
+ * like ParallelPGMapper's per-thread state. */
+struct mt_arg {
+  struct crush_map *map;
+  int ruleno, min_x, max_x, result_max, nweights;
+  __u32 *weights;
+  struct crush_choose_arg *cargs;
+  unsigned long long acc;
+};
+
+static void *mt_run(void *v) {
+  struct mt_arg *a = v;
+  void *cwin = malloc(a->map->working_size +
+                      3 * a->result_max * sizeof(int));
+  int *result = malloc(sizeof(int) * a->result_max);
+  unsigned long long acc = 0;
+  for (int x = a->min_x; x < a->max_x; x++) {
+    crush_init_workspace(a->map, cwin);
+    int len = crush_do_rule(a->map, a->ruleno, x, result, a->result_max,
+                            a->weights, a->nweights, cwin, a->cargs);
+    for (int i = 0; i < len; i++)
+      acc ^= (unsigned long long)result[i] + x;
+  }
+  a->acc = acc;
+  free(result);
+  free(cwin);
+  return NULL;
+}
 
 int main(void) {
   struct crush_map *map = crush_create();
@@ -109,6 +141,48 @@ int main(void) {
         }
       }
       have_choose_args = 1;
+    } else if (!strcmp(cmd, "benchrunmt")) {
+      int nthreads, ruleno, min_x, max_x, result_max, nweights;
+      if (scanf("%d %d %d %d %d %d", &nthreads, &ruleno, &min_x, &max_x,
+                &result_max, &nweights) != 6)
+        return 2;
+      __u32 *weights = malloc(sizeof(__u32) * nweights);
+      for (int i = 0; i < nweights; i++) {
+        int w;
+        if (scanf("%d", &w) != 1) return 2;
+        weights[i] = (__u32)w;
+      }
+      crush_finalize(map);
+      struct mt_arg *args = malloc(sizeof(struct mt_arg) * nthreads);
+      pthread_t *tids = malloc(sizeof(pthread_t) * nthreads);
+      int total = max_x - min_x, per = (total + nthreads - 1) / nthreads;
+      struct timespec t0, t1;
+      clock_gettime(CLOCK_MONOTONIC, &t0);
+      for (int t = 0; t < nthreads; t++) {
+        args[t].map = map;
+        args[t].ruleno = ruleno;
+        args[t].min_x = min_x + t * per;
+        args[t].max_x = args[t].min_x + per;
+        if (args[t].max_x > max_x) args[t].max_x = max_x;
+        args[t].result_max = result_max;
+        args[t].weights = weights;
+        args[t].nweights = nweights;
+        args[t].cargs = have_choose_args ? choose_args : NULL;
+        pthread_create(&tids[t], NULL, mt_run, &args[t]);
+      }
+      unsigned long long acc = 0;
+      for (int t = 0; t < nthreads; t++) {
+        pthread_join(tids[t], NULL);
+        acc ^= args[t].acc;
+      }
+      clock_gettime(CLOCK_MONOTONIC, &t1);
+      double secs =
+          (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) / 1e9;
+      printf("checksum %llu\n", acc);
+      printf("elapsed %.6f\n", secs);
+      free(args);
+      free(tids);
+      free(weights);
     } else if (!strcmp(cmd, "run") || !strcmp(cmd, "benchrun")) {
       /* benchrun prints only an xor checksum — for timing the pure mapping
          loop without stdout overhead. Workspace is (re)initialized per x in

@@ -43,7 +43,7 @@ def build_shim() -> str | None:
         os.path.join(crush, "mapper.c"),
         os.path.join(crush, "crush.c"),
         os.path.join(crush, "hash.c"),
-        "-lm", "-o", out,
+        "-lm", "-lpthread", "-o", out,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True)

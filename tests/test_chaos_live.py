@@ -1,0 +1,203 @@
+"""Thrasher v2 — combined chaos: monitor kills, MULTIPLE OSDs down (to
+min_size), and fleet-wide socket-fault injection, all at once, under a
+seeded randomized workload with a consistency oracle.
+
+The oracle follows the reference's RadosModel discipline
+(src/test/osd/RadosModel.h): an acked write pins the model; a FAILED
+write leaves the key in an either/or state (the op may or may not have
+landed) until the next acked op pins it again. Ref: qa Thrasher
+(qa/tasks/ceph_manager.py kill_osd 196 / revive 380 / mon thrashing
+2501+), msgr-failures fragments (ms inject socket failures).
+"""
+
+import asyncio
+
+import numpy as np
+
+from ceph_tpu.rados.client import ObjectNotFound, Rados, RadosError
+from tests.test_cluster_live import (
+    EC_POOL,
+    N_OSDS,
+    REP_POOL,
+    Cluster,
+    initial_osdmap,
+    live_config,
+    wait_until,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 600))
+
+
+def chaos_config():
+    cfg = live_config()
+    cfg.set("ms_inject_socket_failures", 120)  # 1-in-120 frame I/Os dies
+    cfg.set("osd_min_pg_log_entries", 20)  # trim + backfill in play
+    return cfg
+
+
+def test_combined_chaos_with_consistency_oracle():
+    async def main():
+        rng = np.random.default_rng(1234)
+        cluster = Cluster(cfg=chaos_config())
+        await cluster.start()
+        rados = Rados("client.chaos", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        ios = {REP_POOL: rados.io_ctx(REP_POOL),
+               EC_POOL: rados.io_ctx(EC_POOL)}
+
+        #: (pool, name) -> set of acceptable values (1 = pinned;
+        #: 2 = unresolved failed write; may include None = "absent")
+        model: dict[tuple[int, str], set] = {}
+        dead_osds: list[int] = []
+        dead_mons: list[int] = []
+        mon_dbs: dict[int, object] = {}
+        #: kills are PROCESS kills: the store survives and revival
+        #: replays it (the qa Thrasher's kill_osd semantics — amnesiac
+        #: revival is the simpler thrasher's tier; losing min_size
+        #: DISKS is genuine data loss in the reference too)
+        osd_dbs: dict[int, object] = {}
+
+        def payload():
+            n = int(rng.integers(1, 3000))
+            return rng.integers(0, 256, n, np.uint8).tobytes()
+
+        # short per-op deadlines: at min_size, blocked ops FAIL FAST into
+        # the either/or model state instead of eating the whole budget
+        async def do_write(pool, name):
+            data = payload()
+            key = (pool, name)
+            try:
+                await rados.objecter.op_submit(
+                    pool, name, "write", data, timeout=8.0
+                )
+                model[key] = {data}
+            except RadosError:
+                prev = model.get(key, {None})
+                model[key] = prev | {data}
+
+        async def do_delete(pool, name):
+            key = (pool, name)
+            try:
+                await rados.objecter.op_submit(
+                    pool, name, "delete", timeout=8.0
+                )
+                model[key] = {None}
+            except ObjectNotFound:
+                # ENOENT: the object is definitely absent
+                model[key] = {None}
+            except RadosError:
+                model[key] = model.get(key, {None}) | {None}
+
+        async def do_read(pool, name):
+            key = (pool, name)
+            want = model.get(key)
+            if want is None:
+                return
+            try:
+                rep = await rados.objecter.op_submit(
+                    pool, name, "read", timeout=8.0
+                )
+                got = rep["_raw"]
+            except ObjectNotFound:
+                got = None
+            except RadosError:
+                return  # unreachable right now: consistency not judged
+            assert got in want, (
+                key, "read disagrees with every acceptable state"
+            )
+            model[key] = {got}  # observation pins the state
+
+        ops = 0
+        for step in range(90):
+            kind = rng.choice(
+                ["w", "w", "w", "r", "r", "r", "del",
+                 "kill_osd", "revive_osd", "kill_mon", "revive_mon"],
+            )
+            pool = int(rng.choice([REP_POOL, EC_POOL]))
+            name = f"c{int(rng.integers(0, 30))}"
+            if kind == "w":
+                await do_write(pool, name)
+                ops += 1
+            elif kind == "r":
+                await do_read(pool, name)
+                ops += 1
+            elif kind == "del":
+                await do_delete(pool, name)
+                ops += 1
+            elif kind == "kill_osd" and len(dead_osds) < 2:
+                # two down of six: replicated pools sit AT min_size,
+                # EC k2m2 pools sit at k+1-1 (writes may block) — the
+                # tier the reference's thrash-erasure-code suite runs
+                alive = [o for o in sorted(cluster.osds)
+                         if o not in dead_osds]
+                victim = int(rng.choice(alive))
+                osd_dbs[victim] = cluster.osds[victim].store.db
+                await cluster.kill_osd(victim)
+                dead_osds.append(victim)
+            elif kind == "revive_osd" and dead_osds:
+                osd = dead_osds.pop(
+                    int(rng.integers(0, len(dead_osds)))
+                )
+                await cluster.start_osd(osd, db=osd_dbs.pop(osd))
+            elif kind == "kill_mon" and not dead_mons:
+                # one mon of three down keeps quorum; the LEADER is a
+                # valid victim (election + paxos catch-up under faults)
+                rank = int(rng.integers(0, len(cluster.mons)))
+                mon = cluster.mons[rank]
+                mon_dbs[rank] = mon.db
+                await mon.stop()
+                dead_mons.append(rank)
+            elif kind == "revive_mon" and dead_mons:
+                rank = dead_mons.pop()
+                from ceph_tpu.mon import Monitor
+
+                # a restarted mon gets the GENESIS map: its durable paxos
+                # log replays the whole committed history on top (the
+                # MonitorDBStore contract)
+                mon = Monitor(
+                    rank, cluster.monmap, initial_osdmap(),
+                    db=mon_dbs.pop(rank), config=cluster.cfg,
+                )
+                cluster.mons[rank] = mon
+                await mon.bind()
+                mon.go()
+
+        # settle: everyone back, faults off, full verification
+        while dead_mons:
+            rank = dead_mons.pop()
+            from ceph_tpu.mon import Monitor
+
+            mon = Monitor(
+                rank, cluster.monmap, initial_osdmap(),
+                db=mon_dbs.pop(rank), config=cluster.cfg,
+            )
+            cluster.mons[rank] = mon
+            await mon.bind()
+            mon.go()
+        while dead_osds:
+            osd = dead_osds.pop()
+            await cluster.start_osd(osd, db=osd_dbs.pop(osd))
+        cluster.cfg.set("ms_inject_socket_failures", 0)
+        await wait_until(
+            lambda: all(
+                not any(
+                    o.osdmap.is_down(i) for i in range(N_OSDS)
+                )
+                for o in cluster.osds.values()
+            ),
+            timeout=60,
+        )
+        for (pool, name), want in sorted(model.items()):
+            try:
+                got = await ios[pool].read(name)
+            except ObjectNotFound:
+                got = None
+            assert got in want, (pool, name, "settled read diverges")
+        assert ops > 40
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())

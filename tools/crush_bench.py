@@ -78,6 +78,38 @@ def bench_c(cmap, n_pgs: int, replicas: int, weight) -> float | None:
     return wall
 
 
+def bench_c_mt(cmap, n_pgs: int, replicas: int, weight,
+               threads: int | None = None) -> tuple[float, int] | None:
+    """The honest CPU comparator: the reference's thread-pool mapping
+    (ParallelPGMapper, src/osd/OSDMapMapping.h:18) — every hardware
+    thread running the same crush_do_rule loop over a shard of x."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests"))
+    try:
+        from crush_oracle import build_shim, map_to_protocol
+    except ImportError:
+        return None
+    shim = build_shim()
+    if shim is None:
+        return None
+    threads = threads or (os.cpu_count() or 1)
+    wtxt = " ".join(str(w) for w in weight)
+    text = (
+        map_to_protocol(cmap)
+        + f"\nbenchrunmt {threads} 0 0 {n_pgs} {replicas} "
+        + f"{len(weight)} {wtxt}\n"
+    )
+    proc = subprocess.run(
+        [shim], input=text, capture_output=True, text=True, check=True
+    )
+    for line in proc.stdout.splitlines():
+        if line.startswith("elapsed "):
+            parsed = float(line.split()[1])
+            if parsed > 0:
+                return parsed, threads
+    return None
+
+
 def validate(cmap, compiled, jax_out, replicas, weight, n_check: int):
     from crush_oracle import build_shim, oracle_do_rule
 
@@ -142,6 +174,18 @@ def main(argv=None) -> int:
         }))
         print(json.dumps({"metric": "crush_vs_reference_c",
                           "value": round(c_s / jax_s, 3), "unit": "x"}))
+        mt = bench_c_mt(cmap, args.pgs, args.replicas, weight)
+        if mt is not None:
+            mt_s, threads = mt
+            print(json.dumps({
+                "metric": "crush_straw2_mappings_per_s_reference_c_mt",
+                "value": round(args.pgs / mt_s, 1),
+                "unit": "mappings/s", "threads": threads,
+            }))
+            print(json.dumps({
+                "metric": "crush_vs_reference_c_mt",
+                "value": round(mt_s / jax_s, 3), "unit": "x",
+            }))
         n_check = args.pgs if args.validate < 0 else min(args.validate, args.pgs)
         checked = validate(cmap, compiled, out, args.replicas, weight, n_check)
         if checked:
