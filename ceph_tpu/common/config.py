@@ -101,6 +101,11 @@ SCHEMA: dict[str, Option] = {
         _opt("crush_chunk_size", TYPE_UINT, LEVEL_DEV, 65536,
              "x batch per device launch in the vectorized mapper"),
         # fault injection (options.cc:1044-1066, 822)
+        _opt("ms_compress_mode", TYPE_STR, LEVEL_ADVANCED, "none",
+             "on-wire frame compression codec (none|zlib|snappy-like "
+             "names from the compressor registry) — msgr2 compression"),
+        _opt("ms_compress_min_size", TYPE_UINT, LEVEL_ADVANCED, 4096,
+             "frames below this size are never compressed"),
         _opt("ms_inject_socket_failures", TYPE_UINT, LEVEL_DEV, 0,
              "inject a transient store failure every Nth op"),
         _opt("ms_inject_delay_probability", TYPE_FLOAT, LEVEL_DEV, 0.0,
@@ -142,8 +147,10 @@ SCHEMA: dict[str, Option] = {
              4.0, "lease multiples a peon waits before calling an election"),
         _opt("mon_election_timeout", TYPE_FLOAT, LEVEL_ADVANCED, 5.0,
              "seconds an election proposal waits for a quorum"),
-        _opt("mon_osd_min_down_reporters", TYPE_UINT, LEVEL_ADVANCED, 1,
-             "distinct reporters required to mark an OSD down"),
+        _opt("mon_osd_min_down_reporters", TYPE_UINT, LEVEL_ADVANCED, 2,
+             "distinct reporters required to mark an OSD down (the "
+             "reference's default; one stalled reporter must not be able "
+             "to down a healthy daemon)"),
         # bench / profiling
         _opt("bench_profile_trace_dir", TYPE_STR, LEVEL_DEV, "",
              "write jax.profiler traces here when set",

@@ -543,6 +543,11 @@ class Monitor(Dispatcher):
                 or arch[-1][2] != primary
             ):
                 arch.append((self.osdmap.epoch, list(acting), primary))
+                if len(arch) > 64:
+                    # bounded: peers whose les predates the retained
+                    # horizon are unbridgeable-stale anyway and take the
+                    # backfill path on head comparison alone
+                    del arch[: len(arch) - 64]
 
     # -- map subscription / publication ---------------------------------------
 

@@ -49,6 +49,9 @@ class Tag(IntEnum):
     ACK = 7
     KEEPALIVE = 8
     RESET = 9
+    #: zlib-compressed MESSAGE payload (msgr2 compression mode: the
+    #: on-wire compression leg of src/compressor wired into ProtocolV2)
+    MESSAGE_COMPRESSED = 10
 
 
 @dataclass

@@ -271,7 +271,7 @@ class Connection:
                             if m not in self._unacked:
                                 continue  # acked while we were replaying
                             await stream.send(
-                                Frame(Tag.MESSAGE, m.encode()),
+                                self._encode_msg_frame(m),
                                 self.session_key,
                             )
                     self._ready.set()
@@ -357,11 +357,33 @@ class Connection:
 
     # -- shared loops ---------------------------------------------------------
 
+    def _encode_msg_frame(self, msg: Message) -> Frame:
+        """MESSAGE frame, compressed above the configured floor (the
+        msgr2 compression mode via the compressor registry)."""
+        payload = msg.encode()
+        algo = self.messenger.config.get("ms_compress_mode")
+        floor = self.messenger.config.get("ms_compress_min_size")
+        if algo and algo != "none" and len(payload) >= floor:
+            try:
+                from ceph_tpu.common.compressor import factory
+
+                # one ratio policy for wire AND store paths
+                did, packed = factory(algo).maybe_compress(payload)
+            except Exception:
+                did = False  # unknown/unavailable codec: ship raw
+            if did:
+                self.messenger.compressed_frames += 1
+                return Frame(
+                    Tag.MESSAGE_COMPRESSED,
+                    Encoder().string(algo).blob(packed).bytes(),
+                )
+        return Frame(Tag.MESSAGE, payload)
+
     async def _write_loop(self, stream: _InjectingStream) -> None:
         while True:
             kind, item = await self._send_q.get()
             if kind == "msg":
-                frame = Frame(Tag.MESSAGE, item.encode())
+                frame = self._encode_msg_frame(item)
             else:
                 frame = item
             await stream.send(frame, self.session_key)
@@ -370,6 +392,14 @@ class Connection:
         m = self.messenger
         while True:
             frame = await stream.recv(self.session_key)
+            if frame.tag == Tag.MESSAGE_COMPRESSED:
+                from ceph_tpu.common.compressor import factory
+
+                d = Decoder(frame.payload)
+                algo = d.string()
+                frame = Frame(
+                    Tag.MESSAGE, factory(algo).decompress(d.blob())
+                )
             if frame.tag == Tag.MESSAGE:
                 msg = Message.decode(frame.payload)
                 # ack on receipt, then dedup by per-peer in_seq
@@ -459,6 +489,8 @@ class Messenger:
         self.injected_failures = 0
         #: total frame bytes written (the wire-inflation diagnostic)
         self.bytes_sent = 0
+        #: MESSAGE frames that went out compressed (ms_compress_mode)
+        self.compressed_frames = 0
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -567,7 +599,7 @@ class Messenger:
                     if m not in conn._unacked:
                         continue  # acked while replaying
                     await stream.send(
-                        Frame(Tag.MESSAGE, m.encode()), conn.session_key
+                        conn._encode_msg_frame(m), conn.session_key
                     )
                 await conn._write_loop(stream)
 

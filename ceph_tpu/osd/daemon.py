@@ -2522,6 +2522,57 @@ class OSDService(Dispatcher):
     # with no watchers and clients must re-watch, matching the reference's
     # watch timeout + reconnect contract.
 
+    WATCHERS_XATTR = "\x01w"
+
+    async def _persist_watchers(
+        self, pg, name: str, remove: tuple | None = None
+    ) -> None:
+        """Mirror the watcher set into a reserved object xattr
+        (obc->watchers persisted in object_info): after a primary change
+        the NEW primary knows who SHOULD be watching, so notifies report
+        them as missed until they re-watch, instead of silently
+        succeeding against an empty table. The persisted set MERGES with
+        what a previous primary recorded (minus an explicit unwatch) —
+        overwriting with only our live sessions would silently drop
+        watchers that have not re-watched here yet."""
+        key = (pg.pool, pg.ps, name)
+        acting, primary = self.acting_of(pg.pool, pg.ps)
+        if primary != self.id:
+            return
+        live = {
+            (w, c) for _conn, w, c in self._watchers.get(key, [])
+        }
+        merged = live | set(
+            self._persisted_watchers(pg, acting, name)
+        )
+        if remove is not None:
+            merged.discard(remove)
+        persisted = sorted(f"{w}|{c}" for w, c in merged)
+        try:
+            async with pg.lock:
+                # re-check under the lock: a delete may have committed
+                # while we awaited it — the setxattr must not resurrect
+                # the object as a ghost
+                entry = pg.latest_objects().get(name)
+                if entry is None or entry["kind"] == "delete":
+                    return
+                await self._primary_ops(
+                    pg, acting, name,
+                    [{"op": "setxattr", "name": self.WATCHERS_XATTR,
+                      "value": json.dumps(persisted).encode().hex()}],
+                    [], None,
+                )
+        except Exception:
+            pass  # best effort: live sessions still work this interval
+
+    def _persisted_watchers(self, pg, acting, name: str) -> list[tuple]:
+        raw = self._head_xattrs(pg, acting, name).get(
+            self.WATCHERS_XATTR
+        )
+        if not raw:
+            return []
+        return [tuple(s.split("|", 1)) for s in json.loads(raw)]
+
     async def _h_op_watch(self, pg, conn, p) -> dict:
         key = (pg.pool, pg.ps, p["name"])
         entry = (conn, p.get("watcher", conn.peer_name), p.get("cookie", ""))
@@ -2530,6 +2581,7 @@ class OSDService(Dispatcher):
             w[1] == entry[1] and w[2] == entry[2] for w in watchers
         ):
             watchers.append(entry)
+            await self._persist_watchers(pg, p["name"])
         return {}
 
     async def _h_op_unwatch(self, pg, conn, p) -> dict:
@@ -2539,6 +2591,7 @@ class OSDService(Dispatcher):
             w for w in self._watchers.get(key, [])
             if (w[1], w[2]) != me
         ]
+        await self._persist_watchers(pg, p["name"], remove=me)
         return {}
 
     async def _h_op_notify(self, pg, conn, p) -> dict:
@@ -2575,6 +2628,18 @@ class OSDService(Dispatcher):
                 fut.cancel()
                 missed.append({"watcher": wname, "cookie": cookie})
             self._notify_waiters.pop((notify_id, wname, cookie), None)
+        # watchers persisted by a previous primary that have not
+        # re-established a session here are MISSED, not invisible
+        # (handle_watch_timeout semantics after failover)
+        acting, _primary = self.acting_of(pg.pool, pg.ps)
+        seen = {(a["watcher"], a["cookie"]) for a in acked} | {
+            (m["watcher"], m["cookie"]) for m in missed
+        }
+        for wname, cookie in self._persisted_watchers(
+            pg, acting, p["name"]
+        ):
+            if (wname, cookie) not in seen:
+                missed.append({"watcher": wname, "cookie": cookie})
         return {"acked": acked, "missed": missed}
 
     async def _notify_and_reply(self, pg, conn, p) -> None:

@@ -53,6 +53,11 @@ class Objecter(Dispatcher):
         self._waiters: dict[int, asyncio.Future] = {}
         #: (pool, name, cookie) -> callback(name, payload)
         self._watches: dict[tuple, object] = {}
+        #: watch key -> primary we registered at; watches are LINGER ops
+        #: (Objecter::linger_ops): a primary change re-registers them
+        self._watch_primary: dict[tuple, int] = {}
+        self._rewatch_tasks: set = set()
+        self.mon.on_map_change(self._rewatch_on_map)
 
     async def start(self) -> None:
         self.mon.subscribe()
@@ -91,6 +96,38 @@ class Objecter(Dispatcher):
                             ).encode(),
                         )
                     )
+
+    def _rewatch_on_map(self, _osdmap) -> None:
+        """Re-register every watch whose primary moved (the linger-op
+        resend contract; the new primary's persisted watcher table lists
+        us as missed until this lands)."""
+        for key in list(self._watches):
+            pool_id, name, cookie = key
+            try:
+                primary = self._calc_target(pool_id, name)
+            except RadosError:
+                continue
+            if self._watch_primary.get(key) == primary:
+                continue
+
+            async def rereg(key=key, pool_id=pool_id, name=name,
+                           cookie=cookie, primary=primary):
+                try:
+                    await self.op_submit(
+                        pool_id, name, "watch",
+                        extra={"watcher": self.name, "cookie": cookie},
+                        timeout=10.0,
+                    )
+                    # recorded only on SUCCESS: a failed re-watch must
+                    # stay eligible for the next attempt even if the
+                    # primary has not moved again
+                    self._watch_primary[key] = primary
+                except Exception:
+                    pass  # retried on the next map change
+
+            task = asyncio.get_event_loop().create_task(rereg())
+            self._rewatch_tasks.add(task)
+            task.add_done_callback(self._rewatch_tasks.discard)
 
     async def osd_admin(
         self, osd: int, cmd: str, args: dict | None = None,
@@ -403,9 +440,18 @@ class IoCtx:
             self.pool_id, name, "watch",
             extra={"watcher": self.objecter.name, "cookie": cookie},
         )
+        try:
+            self.objecter._watch_primary[
+                (self.pool_id, name, cookie)
+            ] = self.objecter._calc_target(self.pool_id, name)
+        except RadosError:
+            pass
 
     async def unwatch(self, name: str, cookie: str = "") -> None:
         self.objecter._watches.pop((self.pool_id, name, cookie), None)
+        self.objecter._watch_primary.pop(
+            (self.pool_id, name, cookie), None
+        )
         await self.objecter.op_submit(
             self.pool_id, name, "unwatch",
             extra={"watcher": self.objecter.name, "cookie": cookie},

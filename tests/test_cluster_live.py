@@ -30,7 +30,9 @@ def live_config() -> Config:
     cfg.set("mon_lease", 0.1)
     cfg.set("mon_election_timeout", 0.4)
     cfg.set("osd_heartbeat_interval", 0.15)
-    cfg.set("osd_heartbeat_grace", 1)
+    # grace must absorb single-core event-loop stalls (jit compiles):
+    # every daemon in these tests shares ONE Python event loop
+    cfg.set("osd_heartbeat_grace", 2)
     return cfg
 
 

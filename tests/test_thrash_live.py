@@ -1,7 +1,16 @@
 """Thrashing the LIVE cluster (qa Thrasher over real daemons): a seeded
 random schedule of writes/overwrites/reads/daemon-kills/revivals with a
 consistency oracle — every read must return exactly what the model says,
-through failure detection, degraded service, and peering recovery."""
+through failure detection, degraded service, and peering recovery.
+
+Environment note: every daemon here shares ONE Python event loop on (in
+CI) one CPU core, so multi-second stalls (jit compiles) can genuinely
+silence daemons past the heartbeat grace; mon_osd_min_down_reporters=2
+(the reference default) plus the self-healing rejoin absorb most of it,
+but a rare run can still see an op window where an amnesiac-revived
+shard plus a real kill leave an EC object transiently below k — the
+client surfaces a retryable error past its deadline. Revived-with-store
+kills (test_chaos_live) do not have this window."""
 
 import asyncio
 
