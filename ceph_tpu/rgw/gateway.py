@@ -120,6 +120,10 @@ class ObjectGateway:
         ETag."""
         if not await self.bucket_exists(bucket):
             raise GatewayError(f"no bucket {bucket!r}")
+        if await self._multipart_meta(bucket, key):
+            # overwriting an assembled multipart object must reclaim its
+            # parts, or every re-upload leaks them forever
+            await self._reclaim_parts(bucket, key)
         etag = f"{ceph_crc32c(0xFFFFFFFF, data):08x}"
         await self.ioctx.write_full(self._data_obj(bucket, key), data)
         await self.index_ioctx.exec(
@@ -128,7 +132,27 @@ class ObjectGateway:
         )
         return etag
 
+    async def _multipart_meta(self, bucket: str, key: str):
+        """The index entry IS the authority on whether a key is multipart
+        (user data that happens to look like a manifest must never be
+        interpreted as one)."""
+        try:
+            meta = await self.head_object(bucket, key)
+        except ObjectNotFound:
+            return None
+        return meta if meta.get("multipart") else None
+
     async def get_object(self, bucket: str, key: str) -> bytes:
+        if await self._multipart_meta(bucket, key):
+            m = json.loads(
+                await self.ioctx.read(self._data_obj(bucket, key))
+            )["__manifest__"]
+            chunks = []
+            for n in m["parts"]:
+                chunks.append(await self.ioctx.read(
+                    self._part_obj(bucket, key, m["multipart"], n)
+                ))
+            return b"".join(chunks)
         return await self.ioctx.read(self._data_obj(bucket, key))
 
     async def head_object(self, bucket: str, key: str) -> dict:
@@ -141,10 +165,29 @@ class ObjectGateway:
             raise ObjectNotFound(f"{bucket}/{key}")
         return meta
 
+    async def _reclaim_parts(self, bucket: str, key: str) -> None:
+        """Delete a multipart object's part objects via its manifest."""
+        try:
+            m = json.loads(
+                await self.ioctx.read(self._data_obj(bucket, key))
+            )["__manifest__"]
+        except (ObjectNotFound, ValueError, KeyError):
+            return
+        for n in m.get("parts", []):
+            try:
+                await self.ioctx.remove(
+                    self._part_obj(bucket, key, m["multipart"], n)
+                )
+            except ObjectNotFound:
+                pass
+
     async def delete_object(self, bucket: str, key: str) -> None:
+        multipart = await self._multipart_meta(bucket, key)
         await self.index_ioctx.exec(
             self._index_obj(bucket), "rgw_index", "remove", {"key": key}
         )
+        if multipart:
+            await self._reclaim_parts(bucket, key)
         await self.ioctx.remove(self._data_obj(bucket, key))
 
     async def list_objects(
@@ -167,3 +210,105 @@ class ObjectGateway:
         if stat["count"]:
             raise GatewayError(f"bucket {bucket!r} not empty")
         await self.index_ioctx.remove(self._index_obj(bucket))
+
+    # -- multipart upload (rgw_op.cc RGWInitMultipart / RGWPutObj part /
+    # -- RGWCompleteMultipart): parts are separate RADOS objects; complete
+    # -- writes a MANIFEST the read path follows — a large object is never
+    # -- concatenated into one rados object, exactly like RGW's manifests.
+
+    @staticmethod
+    def _part_obj(bucket: str, key: str, upload_id: str, n: int) -> str:
+        return f"{bucket}/{key}.__mp_{upload_id}.{n:05d}"
+
+    async def initiate_multipart(self, bucket: str, key: str) -> str:
+        if not await self.bucket_exists(bucket):
+            raise GatewayError(f"no bucket {bucket!r}")
+        import uuid
+
+        return uuid.uuid4().hex[:16]
+
+    async def upload_part(
+        self, bucket: str, key: str, upload_id: str, part_num: int,
+        data: bytes,
+    ) -> str:
+        """Store one part; returns its etag (parts are 1-indexed)."""
+        if part_num < 1:
+            raise GatewayError("part numbers are 1-based")
+        etag = f"{ceph_crc32c(0xFFFFFFFF, data):08x}"
+        pname = self._part_obj(bucket, key, upload_id, part_num)
+        await self.ioctx.write_full(pname, data)
+        # etag rides the part as an xattr so complete() never re-reads
+        # part payloads (the S3 contract passes etags back at complete)
+        await self.ioctx.setxattr(pname, "rgw.etag", etag.encode())
+        return etag
+
+    async def complete_multipart(
+        self, bucket: str, key: str, upload_id: str,
+        parts: list[int],
+    ) -> str:
+        """Assemble the object from its parts: a manifest object lands
+        under the key and the index entry records total size + the
+        S3-style multipart etag ('<hash>-<nparts>')."""
+        sizes = []
+        etags = []
+        for n in parts:
+            pname = self._part_obj(bucket, key, upload_id, n)
+            try:
+                st = await self.ioctx.stat(pname)
+                etags.append(
+                    (await self.ioctx.getxattr(pname, "rgw.etag"))
+                    .decode()
+                )
+            except ObjectNotFound:
+                raise GatewayError(f"missing part {n}")
+            sizes.append(st["size"])
+        etag = (
+            f"{ceph_crc32c(0xFFFFFFFF, ''.join(etags).encode()):08x}"
+            f"-{len(parts)}"
+        )
+        manifest = {
+            "multipart": upload_id,
+            "parts": list(parts),
+            "sizes": sizes,
+        }
+        await self.ioctx.write_full(
+            self._data_obj(bucket, key),
+            json.dumps({"__manifest__": manifest}).encode(),
+        )
+        await self.index_ioctx.exec(
+            self._index_obj(bucket), "rgw_index", "insert",
+            {"key": key,
+             "meta": {"size": sum(sizes), "etag": etag,
+                      "multipart": True}},
+        )
+        # unreferenced uploaded parts (client dropped them on retry) are
+        # reclaimed now — after complete there is no abort to catch them
+        await self._remove_stray_parts(
+            bucket, key, upload_id, keep=set(parts)
+        )
+        return etag
+
+    async def _remove_stray_parts(
+        self, bucket: str, key: str, upload_id: str, keep: set,
+        miss_budget: int = 64,
+    ) -> None:
+        n, misses = 1, 0
+        while misses < miss_budget:
+            if n in keep:
+                n += 1
+                continue
+            try:
+                await self.ioctx.remove(
+                    self._part_obj(bucket, key, upload_id, n)
+                )
+                misses = 0
+            except ObjectNotFound:
+                misses += 1
+            n += 1
+
+    async def abort_multipart(
+        self, bucket: str, key: str, upload_id: str
+    ) -> None:
+        # sparse part numbers are legal: scan past gaps with a bounded
+        # consecutive-miss budget instead of stopping at the first hole
+        await self._remove_stray_parts(bucket, key, upload_id, keep=set())

@@ -216,3 +216,92 @@ def test_unlink_reclaims_striped_data_and_layout_travels():
         await cluster.stop()
 
     run(main())
+
+
+def test_multipart_upload():
+    """Multipart upload (rgw_op.cc RGWInitMultipart/RGWCompleteMultipart):
+    parts live as separate rados objects behind a manifest; the assembled
+    object reads back whole, lists with its total size, and delete
+    reclaims every part."""
+
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        for osd in cluster.osds.values():
+            register_rgw_classes(osd)
+        rados = Rados("client.mp", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        gw = ObjectGateway(rados.io_ctx(EC_POOL),
+                           index_ioctx=rados.io_ctx(REP_POOL))
+        await gw.create_bucket("vids")
+
+        upload = await gw.initiate_multipart("vids", "movie")
+        parts = {
+            1: b"\x01" * 5000,
+            2: b"\x02" * 7000,
+            3: b"\x03" * 123,
+        }
+        for n, data in parts.items():
+            await gw.upload_part("vids", "movie", upload, n, data)
+        etag = await gw.complete_multipart("vids", "movie", upload,
+                                           [1, 2, 3])
+        assert etag.endswith("-3")
+
+        got = await gw.get_object("vids", "movie")
+        assert got == parts[1] + parts[2] + parts[3]
+        head = await gw.head_object("vids", "movie")
+        assert head["size"] == sum(len(p) for p in parts.values())
+        assert head["etag"] == etag
+
+        # a plain object whose BYTES look like a manifest is never
+        # interpreted as one (the index meta is the authority)
+        evil = b'{"__manifest__": {"parts": [1], "multipart": "x"}}'
+        await gw.put_object("vids", "fake", evil)
+        assert await gw.get_object("vids", "fake") == evil
+        await gw.delete_object("vids", "fake")
+
+        # overwriting an assembled multipart object reclaims its parts
+        def pool_objects():
+            total = 0
+            for osd in cluster.osds.values():
+                for coll in osd.store.list_collections():
+                    if coll.startswith(f"pg_{EC_POOL}_"):
+                        total += len([
+                            o for o in osd.store.list_objects(coll)
+                            if "__mp_" in o
+                        ])
+            return total
+
+        assert pool_objects() > 0
+        await gw.put_object("vids", "movie", b"tiny now")
+        assert pool_objects() == 0, "old parts leaked on overwrite"
+        assert await gw.get_object("vids", "movie") == b"tiny now"
+        await gw.delete_object("vids", "movie")
+        upload = await gw.initiate_multipart("vids", "movie")
+        for n, data in parts.items():
+            await gw.upload_part("vids", "movie", upload, n, data)
+        etag = await gw.complete_multipart("vids", "movie", upload,
+                                           [1, 2, 3])
+
+        # abort of an unfinished upload reclaims SPARSE part numbers too
+        u2 = await gw.initiate_multipart("vids", "other")
+        await gw.upload_part("vids", "other", u2, 1, b"zz")
+        await gw.upload_part("vids", "other", u2, 7, b"qq")
+        await gw.abort_multipart("vids", "other", u2)
+        assert not any(
+            "__mp_" + u2 in o
+            for osd in cluster.osds.values()
+            for coll in osd.store.list_collections()
+            if coll.startswith(f"pg_{EC_POOL}_")
+            for o in osd.store.list_objects(coll)
+        ), "sparse abort leaked parts"
+
+        # delete reclaims manifest + parts; bucket empties
+        await gw.delete_object("vids", "movie")
+        assert (await gw.list_objects("vids"))["entries"] == {}
+        await gw.delete_bucket("vids")
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
