@@ -36,11 +36,25 @@ class KVTransaction:
     """An atomic batch (KeyValueDB::Transaction): ops apply all-or-nothing."""
 
     def __init__(self) -> None:
-        #: (op, prefix, key, value) with op in {"set", "rm", "rm_prefix"}
+        #: (op, prefix, key, value) with op in {"set", "rm", "rm_prefix",
+        #: "setr"}
         self.ops: list[tuple[str, bytes, bytes, bytes]] = []
 
     def set(self, prefix: bytes, key: bytes, value: bytes) -> "KVTransaction":
         self.ops.append(("set", bytes(prefix), bytes(key), bytes(value)))
+        return self
+
+    def set_range(
+        self, prefix: bytes, key: bytes, off: int, value: bytes
+    ) -> "KVTransaction":
+        """Patch `value` into the row at byte offset `off` (zero-extending a
+        shorter row). The WAL records only the delta, which is what makes a
+        sub-stripe EC overwrite's store traffic proportional to the bytes
+        touched instead of the object size (RocksDB merge-operator role)."""
+        self.ops.append((
+            "setr", bytes(prefix), bytes(key),
+            Encoder().u64(off).blob(bytes(value)).bytes(),
+        ))
         return self
 
     def rm(self, prefix: bytes, key: bytes) -> "KVTransaction":
@@ -88,6 +102,15 @@ class KeyValueDB:
         for kind, prefix, key, value in txn.ops:
             if kind == "set":
                 table[(prefix, key)] = value
+            elif kind == "setr":
+                d = Decoder(value)
+                off, data = d.u64(), d.blob()
+                cur = table.get((prefix, key), b"")
+                if len(cur) < off + len(data):
+                    cur = cur + b"\x00" * (off + len(data) - len(cur))
+                table[(prefix, key)] = (
+                    cur[:off] + data + cur[off + len(data):]
+                )
             elif kind == "rm":
                 table.pop((prefix, key), None)
             elif kind == "rm_prefix":
@@ -100,6 +123,10 @@ class KeyValueDB:
 @dataclass
 class MemDB(KeyValueDB):
     table: dict = field(default_factory=dict)
+    #: bytes a durable backend would have logged for the same batches —
+    #: len(encode()) per batch, so tests can assert store-traffic scaling
+    #: identically against MemDB and FileDB
+    bytes_logged: int = 0
 
     def get(self, prefix: bytes, key: bytes) -> bytes | None:
         return self.table.get((bytes(prefix), bytes(key)))
@@ -110,6 +137,7 @@ class MemDB(KeyValueDB):
             yield (p, k), self.table[(p, k)]
 
     def submit_transaction(self, txn: KVTransaction) -> None:
+        self.bytes_logged += len(txn.encode())
         self._apply(self.table, txn)
 
 
@@ -123,6 +151,7 @@ class FileDB(KeyValueDB):
         self.path = path
         os.makedirs(path, exist_ok=True)
         self.table: dict = {}
+        self.bytes_logged = 0
         self._load()
         self._wal = open(os.path.join(path, self.WAL), "ab")
 
@@ -175,6 +204,7 @@ class FileDB(KeyValueDB):
         self._wal.write(rec)
         self._wal.flush()
         os.fsync(self._wal.fileno())
+        self.bytes_logged += len(body)  # same measure as MemDB
         self._apply(self.table, txn)
 
     def compact(self) -> None:

@@ -106,6 +106,14 @@ class ErasureCode:
     implement encode_array/decode_array (+ optionally sharper minimum_to_decode).
     """
 
+    #: True when parity byte column c depends ONLY on data byte column c
+    #: (a pure per-column GF matmul). That property is what lets the OSD
+    #: re-encode just the column windows a partial overwrite touches
+    #: (sub-stripe RMW); codecs with cross-column coupling (CLAY's paired
+    #: planes, LRC/SHEC layer compositions unless proven) leave it False
+    #: and take the whole-object RMW path.
+    column_independent = False
+
     def __init__(self):
         self.k = 0
         self.m = 0

@@ -66,6 +66,11 @@ class ErasureCodeRs(ErasureCode):
         "tpu": {name: name for name in matrices.TECHNIQUES},
     }
 
+    #: every technique here reduces to parity = gen @ data applied
+    #: byte-column-wise over GF(2^8), so sub-stripe (column window)
+    #: re-encoding is exact — the OSD's partial-overwrite fast path
+    column_independent = True
+
     def __init__(self, family: str = "tpu"):
         super().__init__()
         if family not in self.TECHNIQUES:

@@ -48,11 +48,17 @@ import json
 import zlib
 
 from ceph_tpu.common.config import Config
+from ceph_tpu.common.crc import ceph_crc32c
 from ceph_tpu.common.kv import KeyValueDB
 from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy
 from ceph_tpu.mon.client import MonClient
 from ceph_tpu.osd.cls import ClsError, MethodContext, default_handler
-from ceph_tpu.osd.ecutil import HashInfo
+from ceph_tpu.osd.ecutil import SEED, HashInfo
+from ceph_tpu.osd.extent_cache import (
+    ExtentCache,
+    patch_window,
+    write_column_intervals,
+)
 from ceph_tpu.osd.objectstore import KStore, StoreError, Transaction
 from ceph_tpu.osd.ops import (
     ObjectState,
@@ -63,6 +69,16 @@ from ceph_tpu.osd.ops import (
 from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE
 
 _NONE = CRUSH_ITEM_NONE
+
+
+class _StalePartial(Exception):
+    """A prepared sub-stripe RMW found its base superseded by a
+    whole-object mutation at commit time; the caller re-prepares."""
+
+
+class _PartialUnfit(Exception):
+    """Sub-stripe RMW preconditions failed mid-prepare (degraded shard,
+    stale version, codec geometry); fall back to whole-object RMW."""
 
 
 def pg_coll(pool: int, ps: int) -> str:
@@ -187,6 +203,15 @@ class PG:
         #: its messenger fast-dispatch non-blocking for the same reason)
         self.subop_q: asyncio.Queue = asyncio.Queue()
         self.subop_task: asyncio.Task | None = None
+        #: in-flight sub-stripe overwrite coordination (ExtentCache.h
+        #: role): overlapping column windows serialize, disjoint ones
+        #: run their read+encode legs outside the PG lock concurrently
+        self.extents = ExtentCache()
+        #: name -> obj_ver of the last WHOLE-object mutation this tenure
+        #: (full write / delete / truncate path); the fence a prepared
+        #: sub-stripe RMW validates against at commit — disjoint partial
+        #: writes may interleave freely, a full rewrite forces re-prepare
+        self._full_mut: dict[str, int] = {}
 
     # -- the persisted log ----------------------------------------------------
 
@@ -347,6 +372,7 @@ class OSDService(Dispatcher):
         self.perf = self.perf_collection.create(self.name)
         for key, desc in (
             ("op_w", "client writes served as primary"),
+            ("op_w_partial", "EC writes served via sub-stripe RMW"),
             ("op_r", "client reads served as primary"),
             ("op_rw", "client cls calls served as primary"),
             ("subop_w", "replica/shard sub-writes applied"),
@@ -1120,9 +1146,22 @@ class OSDService(Dispatcher):
         attrs: dict,
     ) -> None:
         """Store a recovered copy/shard, applying the _omap rider as real
-        omap rows (replacing any stale local ones)."""
+        omap rows (replacing any stale local ones). The hinfo digest for
+        THIS position is recomputed from the bytes being stored: attrs
+        travel from whichever shard sourced the recovery, and after
+        sub-stripe overwrites each shard's hinfo is only authoritative
+        for its own position."""
         attrs = dict(attrs)
         omap_hex = attrs.pop("_omap", None)
+        hinfo = attrs.get("hinfo")
+        if hinfo is not None:
+            base, sep, tail = sname.rpartition(".s")
+            if sep and tail.isdigit():
+                pos = int(tail)
+                hashes = list(hinfo.cumulative_shard_hashes)
+                if pos < len(hashes):
+                    hashes[pos] = ceph_crc32c(SEED, data)
+                attrs["hinfo"] = HashInfo(len(data), hashes)
         txn.write(coll, sname, data, attrs=attrs)
         if omap_hex:
             existing = self.store.omap_get(coll, sname)
@@ -1450,7 +1489,10 @@ class OSDService(Dispatcher):
         self._reply_peer(conn, p["tid"], {"ok": True})
 
     async def _h_obj_read(self, conn, p) -> None:
-        """handle_sub_read: local read (+ version check when asked)."""
+        """handle_sub_read: local read (+ version check when asked).
+        `runs` = [[off,len],...] requests sub-extent ranges only — the
+        ECSubRead (offset,count) shape (src/osd/ECMsgTypes.h to_read)
+        that sub-stripe RMW reads and CLAY fractional repairs ride."""
         try:
             data = self.store.read(p["coll"], p["name"])
             attrs = self.store.getattrs(p["coll"], p["name"])
@@ -1460,6 +1502,10 @@ class OSDService(Dispatcher):
         if p.get("ver") is not None and attrs.get("ver") != p["ver"]:
             self._reply_peer(conn, p["tid"], {"ok": False, "stale": True})
             return
+        if p.get("runs") is not None:
+            data = b"".join(
+                data[off: off + ln] for off, ln in p["runs"]
+            )
         attrs_out = _attrs_to(attrs)
         omap = self.store.omap_get(p["coll"], p["name"])
         if omap:
@@ -1541,6 +1587,17 @@ class OSDService(Dispatcher):
                         shard_name(e["src"], p["shard"]),
                         shard_name(e["name"], p["shard"]),
                     )
+                elif p.get("partial"):
+                    extents, cur = [], 0
+                    for off, ln in p.get("extents") or []:
+                        extents.append(
+                            (off, p["_raw"][cur: cur + ln])
+                        )
+                        cur += ln
+                    self._partial_shard_txn(
+                        txn, pg, shard_name(e["name"], p["shard"]),
+                        p["shard"], extents, e["obj_ver"],
+                    )
                 else:
                     txn.write(
                         pg.coll,
@@ -1612,13 +1669,38 @@ class OSDService(Dispatcher):
                 await shard.kick.wait()
                 continue
             conn, p = item
-            pool_id = p["pool"]
-            name = p["name"]
-            with self.op_tracker.track(
-                f"osd_op({p.get('op')} {pool_id}/{name} "
-                f"from {conn.peer_name})"
-            ) as tracked:
-                await self._do_osd_op(conn, p, pool_id, name, tracked)
+            if self._op_pipelines(p):
+                # EC all-write vectors run as their own tasks so the
+                # sub-stripe RMW read+encode legs of in-flight writes
+                # overlap (ECBackend pipelines rmw ops the same way,
+                # ECBackend.cc:1830); the ExtentCache serializes
+                # conflicting column windows, the _full_mut fence
+                # catches full-rewrite races, and version assignment +
+                # fan-out still serialize under the PG lock. Everything
+                # else keeps strict per-object worker order.
+                self._spawn(self._run_client_op(conn, p))
+            else:
+                await self._run_client_op(conn, p)
+
+    def _op_pipelines(self, p) -> bool:
+        if p.get("op") != "ops":
+            return False
+        try:
+            if self.codec(p["pool"]) is None:
+                return False
+        except Exception:
+            return False
+        ops = p.get("ops") or []
+        return bool(ops) and all(o.get("op") == "write" for o in ops)
+
+    async def _run_client_op(self, conn, p) -> None:
+        pool_id = p["pool"]
+        name = p["name"]
+        with self.op_tracker.track(
+            f"osd_op({p.get('op')} {pool_id}/{name} "
+            f"from {conn.peer_name})"
+        ) as tracked:
+            await self._do_osd_op(conn, p, pool_id, name, tracked)
 
     async def _do_osd_op(self, conn, p, pool_id, name, tracked) -> None:
         try:
@@ -1663,11 +1745,14 @@ class OSDService(Dispatcher):
                     f"{conn.peer_name}.{conn.peer_nonce}:{p['tid']}"
                 )
                 if is_mutating(ops):
-                    # full-object EC writes encode BEFORE the PG lock:
-                    # concurrent writes overlap here and the batch
-                    # service packs them into one planar launch, while
+                    # EC writes do their heavy lifting BEFORE the PG
+                    # lock: full-object writes pre-encode (concurrent
+                    # writes coalesce into one planar launch); partial
+                    # overwrites run the whole sub-stripe read+encode
+                    # leg outside too, coordinated by the ExtentCache —
                     # version assignment + fan-out stay serialized
                     pre_encoded = None
+                    partial = None
                     ec = self.codec(pool_id)
                     if (
                         ec is not None
@@ -1677,12 +1762,45 @@ class OSDService(Dispatcher):
                         pre_encoded = await self.encode_service.encode(
                             ec, datas[0]
                         )
-                    async with pg.lock:
-                        op_results, reply_raw = await self._primary_ops(
-                            pg, acting, name, ops, datas, reqid,
-                            snapc=p.get("snapc"),
-                            pre_encoded=pre_encoded,
+                    elif ec is not None:
+                        partial = await self._prepare_partial_ec(
+                            pg, acting, name, ops, datas,
+                            p.get("snapc"),
                         )
+                    try:
+                        for _attempt in range(3):
+                            try:
+                                async with pg.lock:
+                                    op_results, reply_raw = (
+                                        await self._primary_ops(
+                                            pg, acting, name, ops,
+                                            datas, reqid,
+                                            snapc=p.get("snapc"),
+                                            pre_encoded=pre_encoded,
+                                            partial=partial,
+                                        )
+                                    )
+                                break
+                            except _StalePartial:
+                                # a whole-object write superseded our
+                                # base between prepare and commit:
+                                # re-prepare against the new state
+                                pg.extents.release(partial["token"])
+                                partial = None
+                                partial = (
+                                    await self._prepare_partial_ec(
+                                        pg, acting, name, ops, datas,
+                                        p.get("snapc"),
+                                    )
+                                )
+                        else:
+                            raise RuntimeError(
+                                f"partial write to {name!r} kept "
+                                "racing full rewrites"
+                            )  # retryable: client resends
+                    finally:
+                        if partial is not None:
+                            pg.extents.release(partial["token"])
                     self.perf.inc("op_w")
                 else:
                     op_results, reply_raw = await self._primary_ops(
@@ -1842,6 +1960,7 @@ class OSDService(Dispatcher):
         datas: list[bytes], reqid: str | None,
         snapc: dict | None = None, snapid: int | None = None,
         pre_encoded: dict[int, bytes] | None = None,
+        partial: dict | None = None,
     ) -> tuple[list[dict], bytes]:
         """Execute a client op vector (execute_ctx -> do_osd_ops ->
         issue_repop): run against the object context, and when it mutated,
@@ -1878,6 +1997,30 @@ class OSDService(Dispatcher):
             self._check_min_size(pg, acting)
         if snapid is not None:
             name = self._resolve_snap(pg, acting, name, snapid)
+        if partial is not None:
+            # commit leg of a prepared sub-stripe RMW: valid only while
+            # no whole-object mutation superseded the base it read from
+            # (disjoint partial writes in between are fine — column
+            # independence + the ExtentCache reservation)
+            cur = self._obj_version(pg, name)
+            if (
+                pg._full_mut.get(name, 0) > partial["base_obj_ver"]
+                or cur < partial["base_obj_ver"]
+            ):
+                raise _StalePartial
+            entry = {
+                "version": pg.last_update + 1,
+                "name": name,
+                "obj_ver": cur + 1,
+                "kind": "modify",
+                "epoch": self.osdmap.epoch,
+            }
+            if reqid is not None:
+                entry["reqid"] = reqid
+            await self._fan_ec_partial(pg, acting, name, entry, partial)
+            if reqid is not None:
+                pg._reqids_done.add(reqid)
+            return [{} for _ in ops], b""
         if ec is None:
             state = self._load_state_local(pg, name)
         else:
@@ -2174,6 +2317,7 @@ class OSDService(Dispatcher):
             ).encode()
         if user_blob is not None:
             attrs["user"] = user_blob
+        pg._full_mut[name] = entry["obj_ver"]
         waits = []
         for pos, osd in enumerate(acting):
             if osd == _NONE or self.osdmap.is_down(osd):
@@ -2197,9 +2341,219 @@ class OSDService(Dispatcher):
         if waits:
             await asyncio.gather(*waits)
 
+    # -- sub-stripe EC overwrite (start_rmw / ExtentCache analogue) -----------
+
+    async def _prepare_partial_ec(
+        self, pg: PG, acting: list[int], name: str, ops: list[dict],
+        datas: list[bytes], snapc: dict | None,
+    ) -> dict | None:
+        """The read+encode leg of a sub-stripe RMW, run OUTSIDE the PG
+        lock (ECBackend::start_rmw's reads + ECTransaction's re-encode,
+        src/osd/ECBackend.cc:1830, ECTransaction.cc:101): map the write
+        ops to intra-chunk column windows, read exactly those columns of
+        the k data shards, patch the client bytes in, and re-encode the
+        windows through the batch service. Returns the per-shard
+        sub-extents for _primary_ops to commit, or None when the vector
+        doesn't qualify (growth, degraded data shard, clone-on-write
+        pending, non-column-independent codec) — the caller then takes
+        the whole-object path. The returned ctx holds an ExtentCache
+        reservation the caller MUST release."""
+        ec = self.codec(pg.pool)
+        if ec is None or not getattr(ec, "column_independent", False):
+            return None
+        if not ops or any(op["op"] != "write" for op in ops):
+            return None
+        if len(datas) != len(ops):
+            return None
+        entry = pg.latest_objects().get(name)
+        if entry is None or entry["kind"] == "delete":
+            return None
+        base_ver = entry["obj_ver"]
+        my = self._my_shard(pg, acting)
+        if my is None:
+            return None
+        try:
+            attrs = self.store.getattrs(pg.coll, shard_name(name, my))
+        except StoreError:
+            return None
+        size = attrs.get("size")
+        if attrs.get("ver") != base_ver or not size:
+            return None
+        writes: list[tuple[int, int, bytes]] = []
+        for op, data in zip(ops, datas):
+            off = int(op.get("off", 0))
+            if not data or off + len(data) > size:
+                return None  # growth or no-op: whole-object path
+            writes.append((off, len(data), data))
+        if snapc:
+            ss = load_snapset(self._head_xattrs(pg, acting, name))
+            if int(snapc.get("seq", 0)) > ss["seq"]:
+                return None  # make_writeable must clone first
+        k, n = ec.get_data_chunk_count(), ec.get_chunk_count()
+        bs = ec.get_chunk_size(size)
+        unit = ec.get_chunk_size(1)
+        intervals = write_column_intervals(
+            [(o, ln) for o, ln, _ in writes], bs, unit
+        )
+        if sum(hi - lo for lo, hi in intervals) >= bs:
+            return None  # windows span the whole stripe: nothing saved
+        token = await pg.extents.reserve(name, intervals)
+        try:
+            sub: dict[int, list[tuple[int, bytes]]] = {}
+            for lo, hi in intervals:
+                w = hi - lo
+                if ec.get_chunk_size(k * w) != w:
+                    raise _PartialUnfit
+                window = bytearray(k * w)
+                pieces = await asyncio.gather(*(
+                    self._read_shard_columns(
+                        pg, acting, name, ec.chunk_index(logical),
+                        lo, w, base_ver,
+                    )
+                    for logical in range(k)
+                ))
+                for logical, piece in enumerate(pieces):
+                    window[logical * w: (logical + 1) * w] = piece
+                before = bytes(window)
+                patch_window(window, (lo, hi), k, writes, bs)
+                encoded = await self.encode_service.encode(
+                    ec, bytes(window)
+                )
+                for logical in range(k):
+                    phys = ec.chunk_index(logical)
+                    seg = bytes(window[logical * w: (logical + 1) * w])
+                    if seg != before[logical * w: (logical + 1) * w]:
+                        sub.setdefault(phys, []).append((lo, seg))
+                for logical in range(k, n):
+                    phys = ec.chunk_index(logical)
+                    sub.setdefault(phys, []).append((lo, encoded[phys]))
+            return {
+                "token": token, "base_obj_ver": base_ver,
+                "size": size, "sub": sub, "intervals": intervals,
+            }
+        except _PartialUnfit:
+            pg.extents.release(token)
+            return None
+        except Exception:
+            pg.extents.release(token)
+            raise
+
+    async def _read_shard_columns(
+        self, pg: PG, acting: list[int], name: str, phys: int,
+        lo: int, w: int, base_ver: int,
+    ) -> bytes:
+        """Columns [lo, lo+w) of one data shard at version >= base_ver.
+        `>=` not `==`: a concurrent DISJOINT sub-stripe write bumps the
+        shard version without touching our columns (reservation excludes
+        overlapping ones), and an intervening whole-object write is
+        fenced at commit via _full_mut — so newer is safe here."""
+        osd = acting[phys] if phys < len(acting) else _NONE
+        if osd == _NONE or self.osdmap.is_down(osd):
+            raise _PartialUnfit
+        sname = shard_name(name, phys)
+        if osd == self.id:
+            try:
+                attrs = self.store.getattrs(pg.coll, sname)
+                data = self.store.read(pg.coll, sname)
+            except StoreError:
+                raise _PartialUnfit
+            if (attrs.get("ver") or 0) < base_ver:
+                raise _PartialUnfit
+            piece = data[lo: lo + w]
+        else:
+            try:
+                rep = await self._peer_call(
+                    osd, "obj_read",
+                    {"coll": pg.coll, "name": sname,
+                     "runs": [[lo, w]]},
+                    timeout=2.0,
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                raise _PartialUnfit
+            if not rep.get("ok"):
+                raise _PartialUnfit
+            if (_attrs_from(rep).get("ver") or 0) < base_ver:
+                raise _PartialUnfit
+            piece = rep["_raw"]
+        if len(piece) != w:
+            raise _PartialUnfit
+        return piece
+
+    def _partial_shard_txn(
+        self, txn: Transaction, pg: PG, sname: str, pos: int,
+        extents: list[tuple[int, bytes]], new_ver: int,
+    ) -> None:
+        """One shard's share of a sub-stripe write: patch the extents via
+        write_at (store traffic = bytes touched), bump the version, and
+        refresh this position's crc in the hinfo attr — each shard keeps
+        its OWN position's digest exact, which is all deep scrub ever
+        checks against it. A shard that is absent or not at new_ver-1
+        takes the log entry only and stays stale for recovery to repair
+        (the reference records it missing the same way)."""
+        try:
+            old = self.store.read(pg.coll, sname)
+            attrs = self.store.getattrs(pg.coll, sname)
+        except StoreError:
+            return
+        if attrs.get("ver") != new_ver - 1:
+            return
+        new_attrs: dict = {"ver": new_ver}
+        if extents:
+            patched = bytearray(old)
+            for off, data in extents:
+                patched[off: off + len(data)] = data
+                txn.write_at(pg.coll, sname, off, data)
+            hinfo = attrs.get("hinfo")
+            if hinfo is not None:
+                hashes = list(hinfo.cumulative_shard_hashes)
+                if pos < len(hashes):
+                    hashes[pos] = ceph_crc32c(SEED, bytes(patched))
+                new_attrs["hinfo"] = HashInfo(len(patched), hashes)
+        txn.setattrs(pg.coll, sname, new_attrs)
+
+    async def _fan_ec_partial(
+        self, pg: PG, acting: list[int], name: str, entry: dict,
+        partial: dict,
+    ) -> None:
+        """Commit leg of the sub-stripe RMW: per-shard sub-extents to
+        touched data + parity positions, a metadata-only version bump to
+        untouched data shards (their bytes didn't change but the object
+        version did), the log entry to everyone. Wire cost scales with
+        the column windows, never the object size."""
+        self.perf.inc("op_w_partial")
+        sub = partial["sub"]
+        waits = []
+        for pos, osd in enumerate(acting):
+            if osd == _NONE or self.osdmap.is_down(osd):
+                continue
+            extents = sub.get(pos, [])
+            if osd == self.id:
+                txn = Transaction()
+                self._partial_shard_txn(
+                    txn, pg, shard_name(name, pos), pos, extents,
+                    entry["obj_ver"],
+                )
+                pg.append_log(txn, entry)
+                self.store.queue_transaction(txn)
+                continue
+            payload = {
+                "pgid": [pg.pool, pg.ps], "shard": pos,
+                "entry": entry, "partial": True,
+                "extents": [[off, len(d)] for off, d in extents],
+            }
+            waits.append(
+                self._sub_op_persist(
+                    pg, osd, "ec_sub_write", payload,
+                    raw=b"".join(d for _off, d in extents),
+                )
+            )
+        if waits:
+            await asyncio.gather(*waits)
+
     async def _fan_ec_delete(
         self, pg: PG, acting: list[int], entry: dict
     ) -> None:
+        pg._full_mut[entry["name"]] = entry["obj_ver"]
         waits = []
         for pos, osd in enumerate(acting):
             if osd == _NONE or self.osdmap.is_down(osd):

@@ -75,6 +75,17 @@ class Transaction:
         self.ops.append(("write", coll, name, bytes(data), attrs))
         return self
 
+    def write_at(
+        self, coll: str, name: str, off: int, data: bytes
+    ) -> "Transaction":
+        """Patch `data` into the object at byte offset `off` without
+        rewriting the rest (ObjectStore::Transaction::write(off,len) — the
+        sub-extent shape ECBackend's overwrite path ships,
+        src/osd/ECTransaction.cc:101). Compiles to a KV set_range so both
+        the WAL record and the wire stay proportional to len(data)."""
+        self.ops.append(("write_at", coll, name, off, bytes(data)))
+        return self
+
     def remove(self, coll: str, name: str) -> "Transaction":
         self.ops.append(("remove", coll, name))
         return self
@@ -172,6 +183,9 @@ class KStore:
                 kv.set(_DATA, _okey(coll, name), data)
                 if attrs is not None:
                     kv.set(_ATTR, _okey(coll, name), _encode_attrs(attrs))
+            elif kind == "write_at":
+                _, coll, name, off, data = op
+                kv.set_range(_DATA, _okey(coll, name), off, data)
             elif kind == "remove":
                 _, coll, name = op
                 kv.rm(_DATA, _okey(coll, name))

@@ -1,0 +1,323 @@
+"""Sub-stripe EC partial overwrite: the start_rmw / ExtentCache round.
+
+Covers the round-4 acceptance contract (VERDICT #1): a small write into a
+large EC object must move wire + store bytes proportional to the column
+windows it touches, not the object size; overlapping concurrent overwrites
+must stay consistent; everything else (growth, degraded data shards,
+clone-on-write) falls back to the whole-object RMW transparently.
+
+Reference behavior being re-expressed: ECBackend::start_rmw reads only
+affected stripes (src/osd/ECBackend.cc:1830), ECTransaction ships per-shard
+sub-extents (src/osd/ECTransaction.cc:101), ExtentCache coordinates
+overlapping in-flight writes (src/osd/ExtentCache.h:1).
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.kv import FileDB, KVTransaction, MemDB
+from ceph_tpu.ec.registry import factory
+from ceph_tpu.osd.extent_cache import (
+    ExtentCache,
+    merge_intervals,
+    overlaps,
+    patch_window,
+    write_column_intervals,
+)
+
+from test_cluster_live import Cluster, run, wait_until
+
+
+# -- pure algebra -------------------------------------------------------------
+
+
+def test_merge_and_overlap():
+    assert merge_intervals([(10, 20), (20, 30), (40, 50)]) == [
+        (10, 30), (40, 50)
+    ]
+    assert overlaps([(0, 10)], [(9, 12)])
+    assert not overlaps([(0, 10)], [(10, 12)])
+
+
+def test_write_column_intervals_single_chunk():
+    # bs=1024, unit=64: a 100-byte write at 200 -> one aligned window
+    assert write_column_intervals([(200, 100)], 1024, 64) == [(192, 320)]
+
+
+def test_write_column_intervals_spanning_chunks():
+    # write [1000, 1100) with bs=1024 touches chunk0 cols [1000,1024) and
+    # chunk1 cols [0,76): two windows, the tail-of-chunk one clamped to bs
+    ivals = write_column_intervals([(1000, 100)], 1024, 64)
+    assert ivals == [(0, 128), (960, 1024)]
+
+
+def test_patch_window_matches_naive():
+    rng = np.random.default_rng(7)
+    k, bs = 3, 256
+    obj = bytearray(rng.integers(0, 256, k * bs, dtype=np.uint8).tobytes())
+    writes = [
+        (100, 50, bytes(rng.integers(0, 256, 50, dtype=np.uint8))),
+        (240, 300, bytes(rng.integers(0, 256, 300, dtype=np.uint8))),
+    ]
+    expected = bytearray(obj)
+    for off, ln, data in writes:
+        expected[off: off + ln] = data
+    ivals = write_column_intervals(
+        [(o, ln) for o, ln, _ in writes], bs, 64
+    )
+    for lo, hi in ivals:
+        w = hi - lo
+        window = bytearray(
+            b"".join(obj[c * bs + lo: c * bs + hi] for c in range(k))
+        )
+        patch_window(window, (lo, hi), k, writes, bs)
+        for c in range(k):
+            assert (
+                window[c * w: (c + 1) * w]
+                == expected[c * bs + lo: c * bs + hi]
+            ), (lo, hi, c)
+
+
+def test_window_encode_equals_full_encode_slice():
+    """Column independence: encoding just a column window of the data
+    chunks yields exactly the matching columns of the full parity."""
+    for profile in (
+        {"plugin": "tpu", "k": "2", "m": "2"},
+        {"plugin": "tpu", "k": "4", "m": "2", "technique": "cauchy_good"},
+        {"plugin": "isa", "k": "3", "m": "2"},
+    ):
+        ec = factory(profile["plugin"], dict(profile))
+        assert ec.column_independent
+        k, n = ec.get_data_chunk_count(), ec.get_chunk_count()
+        rng = np.random.default_rng(3)
+        size = 8192
+        obj = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        bs = ec.get_chunk_size(size)
+        full = ec.encode(range(n), obj)
+        lo, hi = 128, 512
+        w = hi - lo
+        assert ec.get_chunk_size(k * w) == w
+        padded = obj + b"\x00" * (k * bs - size)
+        window = b"".join(
+            padded[c * bs + lo: c * bs + hi] for c in range(k)
+        )
+        win = ec.encode(range(n), window)
+        for logical in range(k, n):
+            phys = ec.chunk_index(logical)
+            assert win[phys] == full[phys][lo:hi], (profile, phys)
+
+
+# -- KV set_range -------------------------------------------------------------
+
+
+def test_kv_set_range_memdb():
+    db = MemDB()
+    db.submit_transaction(KVTransaction().set(b"t", b"k", b"\x00" * 100))
+    before = db.bytes_logged
+    db.submit_transaction(KVTransaction().set_range(b"t", b"k", 10, b"abc"))
+    assert db.get(b"t", b"k") == b"\x00" * 10 + b"abc" + b"\x00" * 87
+    # the batch logged the delta, not the row
+    assert db.bytes_logged - before < 64
+    # zero-extension past the tail
+    db.submit_transaction(KVTransaction().set_range(b"t", b"k", 120, b"z"))
+    assert len(db.get(b"t", b"k")) == 121
+
+
+def test_kv_set_range_filedb_replay(tmp_path):
+    path = str(tmp_path / "db")
+    db = FileDB(path)
+    db.submit_transaction(KVTransaction().set(b"t", b"k", b"\xff" * 64))
+    base = db.bytes_logged
+    db.submit_transaction(KVTransaction().set_range(b"t", b"k", 32, b"AB"))
+    assert db.bytes_logged - base < 64  # WAL record is the delta
+    db.close()
+    db2 = FileDB(path)  # WAL replay applies set_range identically
+    assert db2.get(b"t", b"k") == b"\xff" * 32 + b"AB" + b"\xff" * 30
+    db2.close()
+
+
+# -- ExtentCache --------------------------------------------------------------
+
+
+def test_extent_cache_serializes_overlap_only():
+    async def main():
+        c = ExtentCache()
+        order = []
+
+        async def writer(tag, ivals, hold):
+            r = await c.reserve("obj", ivals)
+            order.append(tag)
+            await asyncio.sleep(hold)
+            c.release(r)
+
+        await asyncio.gather(
+            writer("a", [(0, 100)], 0.05),
+            writer("b", [(50, 150)], 0),   # overlaps a: waits
+            writer("c", [(200, 300)], 0),  # disjoint: proceeds at once
+        )
+        assert order == ["a", "c", "b"]
+        assert c.conflicts >= 1
+
+    asyncio.run(main())
+
+
+# -- live cluster -------------------------------------------------------------
+
+OBJ = 1 << 20  # 1 MiB object
+SMALL = 4096
+
+
+def _cluster_tx_bytes(cluster) -> int:
+    return sum(o.messenger.bytes_sent for o in cluster.osds.values())
+
+
+def _cluster_store_bytes(cluster) -> int:
+    return sum(o.store.db.bytes_logged for o in cluster.osds.values())
+
+
+def test_live_partial_overwrite_scales_and_round_trips():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        try:
+            from ceph_tpu.rados.client import Rados
+
+            rados = Rados("client.partial", cluster.monmap, config=cluster.cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+            io = rados.io_ctx(2)  # EC pool
+            rng = np.random.default_rng(11)
+            base = rng.integers(0, 256, OBJ, dtype=np.uint8).tobytes()
+            await io.write_full("big", base)
+
+            wire0 = _cluster_tx_bytes(cluster)
+            store0 = _cluster_store_bytes(cluster)
+            patch = bytes(rng.integers(0, 256, SMALL, dtype=np.uint8))
+            await io.write("big", patch, off=123_456)
+            wire = _cluster_tx_bytes(cluster) - wire0
+            store = _cluster_store_bytes(cluster) - store0
+
+            # the whole-object path would move ~2x the object (decode
+            # read + (1+m/k)x shard fan-out); the sub-stripe path must
+            # stay within a small multiple of the 4 KiB window
+            assert wire < OBJ // 4, f"wire bytes {wire} ~ object-sized"
+            assert store < OBJ // 4, f"store bytes {store} ~ object-sized"
+            assert sum(
+                o.perf._counters["op_w_partial"].value
+                for o in cluster.osds.values()
+            ) == 1
+
+            expected = bytearray(base)
+            expected[123_456: 123_456 + SMALL] = patch
+            got = await io.read("big")
+            assert got == bytes(expected)
+
+            # deep scrub: per-shard hinfo digests must still verify
+            primary = next(iter(cluster.osds.values()))
+            report = await primary._scrub(2, deep=True)
+            assert report["errors"] == []
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_live_partial_concurrent_disjoint_and_overlapping():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        try:
+            from ceph_tpu.rados.client import Rados
+
+            rados = Rados("client.conc", cluster.monmap, config=cluster.cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+            io = rados.io_ctx(2)
+            rng = np.random.default_rng(13)
+            base = rng.integers(0, 256, OBJ, dtype=np.uint8).tobytes()
+            await io.write_full("obj", base)
+
+            w1 = bytes(rng.integers(0, 256, 8192, dtype=np.uint8))
+            w2 = bytes(rng.integers(0, 256, 8192, dtype=np.uint8))
+            # w3 overlaps w1's tail but writes IDENTICAL bytes there, so
+            # the final image is order-independent while the column
+            # windows genuinely conflict in the ExtentCache
+            w3 = w1[4096:] + bytes(
+                rng.integers(0, 256, 4096, dtype=np.uint8)
+            )
+            o1, o2, o3 = 40_000, 400_000, 40_000 + 4096
+            await asyncio.gather(
+                io.write("obj", w1, off=o1),
+                io.write("obj", w2, off=o2),
+                io.write("obj", w3, off=o3),
+            )
+            expected = bytearray(base)
+            expected[o1: o1 + len(w1)] = w1
+            expected[o3: o3 + len(w3)] = w3
+            expected[o2: o2 + len(w2)] = w2
+            got = await io.read("obj")
+            assert got == bytes(expected)
+
+            # op pipelining means the writes really went through the
+            # ExtentCache (spawned tasks, not worker-serialized)
+            assert sum(
+                pg.extents.reservations
+                for o in cluster.osds.values()
+                for pg in o.pgs.values()
+            ) >= 3
+
+            primary = next(iter(cluster.osds.values()))
+            report = await primary._scrub(2, deep=True)
+            assert report["errors"] == []
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_live_partial_falls_back_when_degraded():
+    """A down data-shard home disqualifies the sub-stripe path; the write
+    must still land via whole-object RMW (decode from survivors)."""
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        try:
+            from ceph_tpu.rados.client import Rados
+
+            rados = Rados("client.degraded", cluster.monmap, config=cluster.cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+            io = rados.io_ctx(2)
+            rng = np.random.default_rng(17)
+            base = rng.integers(0, 256, 65536, dtype=np.uint8).tobytes()
+            await io.write_full("deg", base)
+
+            # find the object's acting set and kill one member
+            any_osd = next(iter(cluster.osds.values()))
+            ps = any_osd.object_pg(2, "deg")
+            acting, _ = any_osd.acting_of(2, ps)
+            victim = acting[0]
+            await cluster.kill_osd(victim)
+            await wait_until(
+                lambda: any(
+                    o.osdmap.is_down(victim)
+                    for o in cluster.osds.values()
+                ),
+                timeout=30,
+            )
+            patch = bytes(rng.integers(0, 256, 1024, dtype=np.uint8))
+            await io.write("deg", patch, off=10_000)
+            expected = bytearray(base)
+            expected[10_000: 10_000 + len(patch)] = patch
+            got = await io.read("deg")
+            assert got == bytes(expected)
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())
