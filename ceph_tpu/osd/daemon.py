@@ -212,6 +212,21 @@ class PG:
         #: sub-stripe RMW validates against at commit — disjoint partial
         #: writes may interleave freely, a full rewrite forces re-prepare
         self._full_mut: dict[str, int] = {}
+        #: acting members whose logs could not be bridged (blank revival,
+        #: divergence): the PG activates WITHOUT them — they take no
+        #: write sub-ops and satisfy neither min_size nor reads until the
+        #: background drain backfills them (the reference's async
+        #: backfill with backfill_targets, PeeringState::Active +
+        #: recover_backfill; PastIntervals is what keeps their stale
+        #: stores from masquerading as current)
+        self.backfill_targets: set[int] = set()
+        self.backfill_task: asyncio.Task | None = None
+        #: the primary itself revived amnesiac: it adopted the
+        #: authority's log/inventory and serves (reads decode around the
+        #: missing local data) while a background sweep pulls its own
+        #: copies/shards back
+        self.self_backfill = False
+        self.self_backfill_task: asyncio.Task | None = None
 
     # -- the persisted log ----------------------------------------------------
 
@@ -378,6 +393,8 @@ class OSDService(Dispatcher):
             ("subop_w", "replica/shard sub-writes applied"),
             ("recovery_pushes", "objects/shards pushed during recovery"),
             ("recovery_pulls", "objects/shards pulled during peering"),
+            ("recovery_sub_bytes",
+             "helper bytes read via fractional sub-chunk repair"),
             ("scrub_errors", "inconsistencies found by scrub"),
             ("heartbeat_failures", "peer failures reported to the mon"),
         ):
@@ -398,6 +415,10 @@ class OSDService(Dispatcher):
         from ceph_tpu.common.admin import OpTracker
 
         self.op_tracker = OpTracker()
+        #: (pool, ps) -> error count from the last deep scrub of that PG
+        #: (primary-side); feeds the PG_DAMAGED health check and clears
+        #: when a rescrub comes back clean
+        self._scrub_incons: dict[tuple, int] = {}
         # dout-style subsystem logging with the always-on recent ring
         # (src/log/Log.cc); dumped via the `log dump` admin command
         from ceph_tpu.common.log import LogRegistry
@@ -422,6 +443,10 @@ class OSDService(Dispatcher):
                     else WeightedPriorityQueue()
                 )
                 self.kick = asyncio.Event()
+                #: object name -> in-flight PIPELINED op tasks; inline
+                #: ops on the same object drain these first so
+                #: per-object client ordering survives pipelining
+                self.inflight: dict[str, set] = {}
 
         self._op_shards = [_OpShard() for _ in range(4)]
         self._tasks: list[asyncio.Task] = []
@@ -478,6 +503,19 @@ class OSDService(Dispatcher):
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._peering_loop()))
         self._tasks.append(asyncio.create_task(self._resub_loop()))
+        self._tasks.append(asyncio.create_task(self._pg_stats_loop()))
+        if self.messenger.keyring is not None:
+            # cephx: fetch the rotating service-key window so client
+            # tickets verify locally, and keep it fresh through
+            # rotations (the KeyServer-to-daemon distribution) — plus
+            # reactively when a ticket shows up under a newer epoch
+            await self._fetch_rotating_keys()
+            self.messenger.on_service_keys_stale = (
+                self._fetch_rotating_keys
+            )
+            self._tasks.append(
+                asyncio.create_task(self._rotating_keys_loop())
+            )
         for shard in self._op_shards:
             self._tasks.append(
                 asyncio.create_task(self._op_shard_worker(shard))
@@ -760,12 +798,46 @@ class OSDService(Dispatcher):
             try:
                 async with pg.lock:
                     complete = await self._peer_and_recover(pg, acting)
-                if complete:
+                # serviceability: activation with backfill targets still
+                # needs enough COMPLETE members to reconstruct every
+                # object (k shards for EC, one copy replicated) — else
+                # keep peering and let revivals/drains change the math
+                ec = self.codec(pool_id)
+                need = 1 if ec is None else ec.get_data_chunk_count()
+                ready = sum(
+                    1 for o in acting
+                    if o != _NONE and not m.is_down(o)
+                    and o not in pg.backfill_targets
+                )
+                if complete and ready >= need:
                     pg.active = True
                     pg.last_acting = list(acting)
                     pg.set_les(m.epoch)
                     if (d := self.dlog.dout(5)) is not None:
-                        d(f"pg {pool_id}.{ps} active, acting {acting}")
+                        d(f"pg {pool_id}.{ps} active, acting {acting}, "
+                          f"backfilling {sorted(pg.backfill_targets)}")
+                    if pg.backfill_targets and (
+                        pg.backfill_task is None
+                        or pg.backfill_task.done()
+                    ):
+                        pg.backfill_task = asyncio.create_task(
+                            self._drain_backfill(pg)
+                        )
+                        self._ephemeral.add(pg.backfill_task)
+                        pg.backfill_task.add_done_callback(
+                            self._ephemeral.discard
+                        )
+                    if pg.self_backfill and (
+                        pg.self_backfill_task is None
+                        or pg.self_backfill_task.done()
+                    ):
+                        pg.self_backfill_task = asyncio.create_task(
+                            self._drain_self_backfill(pg)
+                        )
+                        self._ephemeral.add(pg.self_backfill_task)
+                        pg.self_backfill_task.add_done_callback(
+                            self._ephemeral.discard
+                        )
                 else:
                     retry_needed = True  # partial recovery: stay peering
             except asyncio.CancelledError:
@@ -779,6 +851,84 @@ class OSDService(Dispatcher):
 
             self._spawn(nudge())
         self._spawn(self._trim_removed_snaps())
+
+    async def _fetch_rotating_keys(self) -> None:
+        from ceph_tpu.auth.cephx import unseal
+
+        rep = await self.mon.command(
+            "auth rotating", {"service": "osd"}, timeout=10.0
+        )
+        if "sealed" in rep:
+            payload = unseal(
+                self.messenger.keyring[self.name],
+                bytes.fromhex(rep["sealed"]),
+            )
+            if payload is None:
+                raise RuntimeError("rotating keys unopenable")
+            window = json.loads(payload)
+        else:
+            window = rep["keys"]
+        self.messenger.service_keys = {
+            int(e): bytes.fromhex(k) for e, k in window.items()
+        }
+
+    async def _rotating_keys_loop(self) -> None:
+        interval = max(
+            1.0, self.config.get("auth_service_ticket_ttl") / 4
+        )
+        delay = interval
+        while not self._stopped:
+            await asyncio.sleep(delay)
+            try:
+                await self._fetch_rotating_keys()
+                delay = interval
+            except Exception:
+                delay = 1.0  # mon churn: keep retrying fast
+
+    async def _pg_stats_loop(self) -> None:
+        """Primaries report PG state sums to the mon on the
+        osd_mon_report_interval cadence (OSD::ms_handle osd_stat /
+        MPGStats flow): the feed for the mon's health checks."""
+        while not self._stopped:
+            await asyncio.sleep(
+                self.config.get("osd_mon_report_interval")
+            )
+            stats = {"num_pgs": 0, "degraded": 0, "undersized": 0,
+                     "backfilling": 0, "peering": 0, "inconsistent": 0}
+            for (pool_id, ps), pg in list(self.pgs.items()):
+                pool = self.osdmap.pools.get(pool_id)
+                if pool is None:
+                    continue
+                acting, primary = self.acting_of(pool_id, ps)
+                if primary != self.id:
+                    continue
+                stats["num_pgs"] += 1
+                if not pg.active:
+                    stats["peering"] += 1
+                    continue
+                live = [
+                    o for o in acting
+                    if o != _NONE and not self.osdmap.is_down(o)
+                ]
+                complete = [
+                    o for o in live if o not in pg.backfill_targets
+                ]
+                if len(live) < pool.size:
+                    stats["undersized"] += 1
+                if len(complete) < pool.size or pg.self_backfill:
+                    stats["degraded"] += 1
+                if pg.backfill_targets or pg.self_backfill:
+                    stats["backfilling"] += 1
+                stats["inconsistent"] += self._scrub_incons.get(
+                    (pool_id, ps), 0
+                )
+            try:
+                await self.mon.command(
+                    "pg stats report",
+                    {"osd": self.id, "stats": stats}, timeout=5.0,
+                )
+            except Exception:
+                pass  # mon churn: next interval re-reports
 
     async def _trim_removed_snaps(self) -> None:
         """SnapTrimmer: drop clones whose snap was deleted from the pool
@@ -1104,9 +1254,16 @@ class OSDService(Dispatcher):
     async def _backfill_self(
         self, pg: PG, source: int, acting: list[int]
     ) -> bool:
-        """Full resync FROM the authority: pull its whole inventory,
-        overwrite local objects, drop strays, adopt its log head
-        (recover_backfill in the pulling direction)."""
+        """Resync FROM the authority (recover_backfill pulling): adopt
+        its inventory + log head NOW — dropping local strays — and let
+        the PG activate immediately; the object DATA heals in the
+        background (_drain_self_backfill). An amnesiac primary can serve
+        the moment it knows WHAT exists: EC reads decode around the
+        missing local shard, replicated reads fall back to peer copies,
+        and new writes land fresh locally. Blocking the whole PG behind
+        a full self-pull was the availability hole the thrasher kept
+        finding (the reference's answer is PastIntervals + pg_temp: a
+        complete member serves while the newcomer backfills)."""
         try:
             rep = await self._peer_call(
                 source, "pg_inventory", {"pgid": [pg.pool, pg.ps]},
@@ -1115,21 +1272,6 @@ class OSDService(Dispatcher):
         except (asyncio.TimeoutError, RuntimeError):
             return False
         inventory = rep["inventory"]
-        my_shard = self._my_shard(pg, acting)
-        for name, e in sorted(inventory.items()):
-            if e["kind"] == "delete":
-                continue
-            got = await self._pull_object(
-                pg, name, my_shard, acting, e
-            )
-            if got is None:
-                return False
-            txn = Transaction()
-            self._write_fetched(
-                txn, pg.coll, shard_name(name, my_shard), got[0], got[1]
-            )
-            self.store.queue_transaction(txn)
-            self.perf.inc("recovery_pulls")
         txn = Transaction()
         for logical, sname in self._local_logical_names(pg).items():
             e = inventory.get(logical)
@@ -1139,7 +1281,63 @@ class OSDService(Dispatcher):
             txn, inventory, tuple(rep["head"]), rep["tail"]
         )
         self.store.queue_transaction(txn)
+        pg.self_backfill = True
         return True
+
+    async def _drain_self_backfill(self, pg: PG) -> None:
+        """Pull our own missing/stale copies/shards back while serving
+        (the puller half of async backfill). Each landed object is
+        version-gated against concurrent client writes: a pull result
+        older than what a write just stored locally is dropped — the
+        next sweep sees the newer inventory entry already satisfied."""
+        while pg.self_backfill and not self._stopped:
+            acting, primary = self.acting_of(pg.pool, pg.ps)
+            if primary != self.id or not pg.active:
+                return
+            my = self._my_shard(pg, acting)
+            missing = 0
+            for name, e in sorted(pg.latest_objects().items()):
+                if e["kind"] == "delete":
+                    continue
+                sname = shard_name(name, my)
+                try:
+                    if (
+                        self.store.getattrs(pg.coll, sname).get("ver")
+                        == e["obj_ver"]
+                    ):
+                        continue
+                except StoreError:
+                    pass
+                got = await self._pull_object(pg, name, my, acting, e)
+                cur = pg.latest_objects().get(name)
+                if got is None or cur is None:
+                    missing += 1
+                    continue
+                if cur["obj_ver"] != e["obj_ver"]:
+                    missing += 1  # advanced mid-pull: next sweep
+                    continue
+                try:
+                    local_ver = self.store.getattrs(
+                        pg.coll, sname
+                    ).get("ver") or 0
+                except StoreError:
+                    local_ver = 0
+                if local_ver == cur["obj_ver"]:
+                    continue  # a concurrent write healed it for us
+                # any other local version — including a HIGHER one from
+                # a divergent past reign — is stale; overwrite it
+                txn = Transaction()
+                self._write_fetched(txn, pg.coll, sname, got[0], got[1])
+                self.store.queue_transaction(txn)
+                self.perf.inc("recovery_pulls")
+            if missing == 0:
+                # one re-check pass: anything written mid-sweep has a
+                # fresh local copy already (writes apply locally too)
+                pg.self_backfill = False
+                if (d := self.dlog.dout(5)) is not None:
+                    d(f"pg {pg.pool}.{pg.ps} self-backfill complete")
+                return
+            await asyncio.sleep(0.2)
 
     def _write_fetched(
         self, txn: Transaction, coll: str, sname: str, data: bytes,
@@ -1246,6 +1444,99 @@ class OSDService(Dispatcher):
                 return rep["_raw"], _attrs_from(rep)
         return None
 
+    async def _rebuild_shard_subchunks(
+        self, pg: PG, name: str, shard: int, acting: list[int], ver: int,
+        exclude: int | None,
+    ):
+        """Fractional repair over the wire (the CLAY contract): fetch
+        ONLY the repair sub-chunk runs minimum_to_decode names from the
+        d helper shards at their acting homes — d*(1/q) of the data a
+        whole-shard rebuild would move (ErasureCodeClay::minimum_to_decode,
+        src/erasure-code/clay/ErasureCodeClay.cc:304+, read via the
+        ECSubRead sub-extent shape, src/osd/ECBackend.cc:1605). Returns
+        (bytes, attrs) or None to fall back to the whole-shard path
+        (helpers missing at acting homes, or no fractional saving)."""
+        ec = self.codec(pg.pool)
+        sub = ec.get_sub_chunk_count()
+        avail = set()
+        for pos, osd in enumerate(acting):
+            if (
+                pos == shard or osd in (_NONE, exclude)
+                or self.osdmap.is_down(osd)
+                or osd in pg.backfill_targets
+            ):
+                continue
+            avail.add(pos)
+        try:
+            minimum = ec.minimum_to_decode({shard}, avail)
+        except Exception:
+            return None
+        if all(
+            list(runs) == [(0, sub)] for runs in minimum.values()
+        ):
+            return None  # whole-shard reads anyway: use the plain path
+        chunks: dict[int, bytes] = {}
+        attrs = cs = None
+        for pos, runs in sorted(minimum.items()):
+            osd = acting[pos]
+            sname = shard_name(name, pos)
+            if osd == self.id:
+                try:
+                    a = self.store.getattrs(pg.coll, sname)
+                    data = self.store.read(pg.coll, sname)
+                except StoreError:
+                    return None
+                if a.get("ver") != ver:
+                    return None
+                cs = len(data)
+                unit = cs // sub
+                raw = b"".join(
+                    data[o * unit: (o + c) * unit] for o, c in runs
+                )
+            else:
+                if cs is None:
+                    # one attrs-only probe tells us the object size and
+                    # therefore the shard/sub-chunk geometry
+                    try:
+                        probe = await self._peer_call(
+                            osd, "obj_read",
+                            {"coll": pg.coll, "name": sname,
+                             "ver": ver, "runs": []},
+                            timeout=2.0,
+                        )
+                    except (asyncio.TimeoutError, RuntimeError):
+                        return None
+                    size = (
+                        _attrs_from(probe).get("size")
+                        if probe.get("ok") else None
+                    )
+                    if not size:
+                        return None
+                    cs = ec.get_chunk_size(size)
+                unit = cs // sub
+                try:
+                    rep = await self._peer_call(
+                        osd, "obj_read",
+                        {"coll": pg.coll, "name": sname, "ver": ver,
+                         "runs": [[o * unit, c * unit]
+                                  for o, c in runs]},
+                        timeout=2.0,
+                    )
+                except (asyncio.TimeoutError, RuntimeError):
+                    return None
+                if not rep.get("ok"):
+                    return None
+                raw = rep["_raw"]
+                a = _attrs_from(rep)
+            chunks[pos] = raw
+            attrs = attrs or a
+            self.perf.inc("recovery_sub_bytes", len(raw))
+        try:
+            rebuilt = ec.decode({shard}, chunks, chunk_size=cs)[shard]
+        except Exception:
+            return None
+        return rebuilt, attrs
+
     async def _rebuild_shard(
         self, pg: PG, name: str, shard: int, acting: list[int], ver: int,
         exclude: int | None = None,
@@ -1253,6 +1544,12 @@ class OSDService(Dispatcher):
         """Decode shard `shard` from current-version source shards found at
         acting homes or strays (RecoveryOp READING with MissingLoc)."""
         ec = self.codec(pg.pool)
+        if ec.get_sub_chunk_count() > 1:
+            got = await self._rebuild_shard_subchunks(
+                pg, name, shard, acting, ver, exclude
+            )
+            if got is not None:
+                return got
         chunks: dict[int, bytes] = {}
         attrs = None
         for pos in range(len(acting)):
@@ -1299,13 +1596,17 @@ class OSDService(Dispatcher):
     async def _push_missing(
         self, pg: PG, acting: list[int], infos: dict[int, dict]
     ) -> bool:
-        """Push log entries + object data to every laggard member — or a
-        full backfill when its log can't be bridged; True only when every
-        member is known complete (the PG must not go active on a partial
-        recovery)."""
+        """Push log entries + object data to every laggard member; a
+        member whose log can't be bridged becomes a BACKFILL TARGET
+        instead of blocking here — the PG activates without it and the
+        background drain resyncs it (async backfill: the reference goes
+        Active with backfill_targets excluded from acting-set service
+        rather than wedging client IO behind a full resync). True when
+        every non-target member is known complete."""
         inventory = pg.latest_objects()
         ec = self.codec(pg.pool)
         complete = True
+        targets: set[int] = set()
         for pos, osd in enumerate(acting):
             if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
                 continue
@@ -1322,10 +1623,7 @@ class OSDService(Dispatcher):
                 continue
             shard = pos if ec is not None else None
             if self._needs_backfill(pg, info):
-                if not await self._backfill_member(
-                    pg, acting, osd, shard
-                ):
-                    complete = False
+                targets.add(osd)
                 continue
             since = info["last_update"]
             if since >= pg.last_update:
@@ -1362,7 +1660,35 @@ class OSDService(Dispatcher):
                 except (asyncio.TimeoutError, RuntimeError):
                     complete = False
                     break  # next pass retries this member
+        pg.backfill_targets = targets
         return complete
+
+    async def _drain_backfill(self, pg: PG) -> None:
+        """Background backfill of this PG's targets, one at a time,
+        while the PG serves client IO (recover_backfill running under
+        the Active state). Ends when no targets remain or primaryship
+        moves (the next peering pass re-evaluates)."""
+        while pg.backfill_targets and not self._stopped:
+            acting, primary = self.acting_of(pg.pool, pg.ps)
+            if primary != self.id or not pg.active:
+                return
+            ec = self.codec(pg.pool)
+            progressed = False
+            for osd in sorted(pg.backfill_targets):
+                if osd not in acting or self.osdmap.is_down(osd):
+                    pg.backfill_targets.discard(osd)
+                    progressed = True
+                    continue
+                pos = acting.index(osd)
+                shard = pos if ec is not None else None
+                if await self._backfill_member(pg, acting, osd, shard):
+                    pg.backfill_targets.discard(osd)
+                    progressed = True
+                    if (d := self.dlog.dout(5)) is not None:
+                        d(f"pg {pg.pool}.{pg.ps} backfill of osd.{osd} "
+                          "complete")
+            if not progressed:
+                await asyncio.sleep(0.2)
 
     async def _backfill_member(
         self, pg: PG, acting: list[int], osd: int, shard: int | None
@@ -1371,37 +1697,70 @@ class OSDService(Dispatcher):
         live object at its current version, then hand it our inventory +
         head so it drops strays and restarts its log (recover_backfill +
         the reservation throttle, PeeringState WaitRemoteBackfillReserved:
-        osd_max_backfills bounds concurrent backfills we source)."""
+        osd_max_backfills bounds concurrent backfills we source).
+
+        Runs while the PG serves writes (the target takes no write
+        sub-ops meanwhile): unlocked convergence passes push the moving
+        inventory until a pass finds nothing new, then one final pass
+        under the PG lock quiesces writes for the (tiny) residue and the
+        inventory/head handoff — the backfill-finish-under-lock step."""
         async with self._backfill_sem:
-            inventory = pg.latest_objects()
-            for name, e in sorted(inventory.items()):
-                if e["kind"] == "delete":
-                    continue
-                got = await self._object_for_push(pg, e, shard, acting)
-                if got is None:
+            pushed: dict[str, int] = {}
+
+            async def push_diff() -> int | None:
+                n = 0
+                for name, e in sorted(pg.latest_objects().items()):
+                    if pushed.get(name) == e["version"]:
+                        continue
+                    if e["kind"] == "delete":
+                        payload, raw = {"entry": e, "has_data": False}, b""
+                    else:
+                        got = await self._object_for_push(
+                            pg, e, shard, acting
+                        )
+                        if got is None:
+                            return None
+                        raw, attrs = got
+                        payload = {"entry": e, "has_data": True,
+                                   "force": True,
+                                   "attrs": _attrs_to(attrs)}
+                    try:
+                        await self._peer_call(
+                            osd, "obj_push",
+                            {"pgid": [pg.pool, pg.ps],
+                             "shard": shard, **payload},
+                            timeout=5.0, raw=raw,
+                        )
+                        self.perf.inc("recovery_pushes")
+                    except (asyncio.TimeoutError, RuntimeError):
+                        return None
+                    pushed[name] = e["version"]
+                    n += 1
+                return n
+
+            for _pass in range(5):
+                n = await push_diff()
+                if n is None:
                     return False
-                data, attrs = got
+                if n == 0:
+                    break  # converged against the live inventory
+            # under SUSTAINED writes unlocked passes may never find an
+            # empty diff — after the pass cap, quiesce and finish: the
+            # locked pass is correct for any residue, just holds the
+            # lock proportionally longer
+            async with pg.lock:
+                if await push_diff() is None:
+                    return False
                 try:
                     await self._peer_call(
-                        osd, "obj_push",
-                        {"pgid": [pg.pool, pg.ps], "shard": shard,
-                         "entry": e, "has_data": True,
-                         "attrs": _attrs_to(attrs)},
-                        timeout=5.0, raw=data,
+                        osd, "pg_backfill_done",
+                        {"pgid": [pg.pool, pg.ps],
+                         "inventory": pg.latest_objects(),
+                         "head": list(pg.head), "tail": pg.log_tail},
+                        timeout=10.0,
                     )
-                    self.perf.inc("recovery_pushes")
                 except (asyncio.TimeoutError, RuntimeError):
                     return False
-            try:
-                await self._peer_call(
-                    osd, "pg_backfill_done",
-                    {"pgid": [pg.pool, pg.ps],
-                     "inventory": inventory,
-                     "head": list(pg.head), "tail": pg.log_tail},
-                    timeout=10.0,
-                )
-            except (asyncio.TimeoutError, RuntimeError):
-                return False
             return True
 
     async def _object_for_push(
@@ -1521,20 +1880,35 @@ class OSDService(Dispatcher):
         self._enqueue_subop(p, self._do_obj_push, conn)
 
     async def _do_obj_push(self, conn, p) -> None:
-        """Recovery push: store the object/shard + its log entry."""
+        """Recovery push: store the object/shard + its log entry. The
+        data write is version-gated: a backfill/recovery push must never
+        regress a copy that a concurrent client write already advanced
+        past the pushed version."""
         pg = self._pg_of(p["pgid"])
         e = p["entry"]
+        sname = shard_name(e["name"], p.get("shard"))
         txn = Transaction()
         if e["version"] > pg.last_update:
             pg.append_log(txn, e)
         if p.get("has_data"):
-            self._write_fetched(
-                txn, pg.coll,
-                shard_name(e["name"], p.get("shard")),
-                p["_raw"], _attrs_from(p),
-            )
+            # backfill pushes are authoritative (full resync from the
+            # primary: "force") — obj_vers from a divergent reign are
+            # not comparable and must be overwritten. Non-forced pushes
+            # (repair, forward-completion) share our log lineage, so
+            # the gate keeps them from regressing a newer local write.
+            pushed_ver = _attrs_from(p).get("ver") or 0
+            try:
+                local_ver = self.store.getattrs(
+                    pg.coll, sname
+                ).get("ver") or 0
+            except StoreError:
+                local_ver = 0
+            if p.get("force") or local_ver <= pushed_ver:
+                self._write_fetched(
+                    txn, pg.coll, sname, p["_raw"], _attrs_from(p)
+                )
         elif e["kind"] == "delete":
-            txn.remove(pg.coll, shard_name(e["name"], p.get("shard")))
+            txn.remove(pg.coll, sname)
         self.store.queue_transaction(txn)
         self._reply_peer(conn, p["tid"], {"ok": True})
 
@@ -1669,17 +2043,43 @@ class OSDService(Dispatcher):
                 await shard.kick.wait()
                 continue
             conn, p = item
+            name = p.get("name")
+            inflight = shard.inflight.get(name)
             if self._op_pipelines(p):
                 # EC all-write vectors run as their own tasks so the
                 # sub-stripe RMW read+encode legs of in-flight writes
                 # overlap (ECBackend pipelines rmw ops the same way,
                 # ECBackend.cc:1830); the ExtentCache serializes
-                # conflicting column windows, the _full_mut fence
-                # catches full-rewrite races, and version assignment +
-                # fan-out still serialize under the PG lock. Everything
-                # else keeps strict per-object worker order.
-                self._spawn(self._run_client_op(conn, p))
+                # conflicting column windows in SPAWN order (reserve is
+                # reached before the task's first yield point), the
+                # _full_mut fence catches full-rewrite races, and
+                # version assignment + fan-out still serialize under
+                # the PG lock.
+                task = asyncio.create_task(
+                    self._run_client_op(conn, p)
+                )
+                self._ephemeral.add(task)
+                bucket = shard.inflight.setdefault(name, set())
+                bucket.add(task)
+
+                def _done(t, name=name, bucket=bucket):
+                    self._ephemeral.discard(t)
+                    bucket.discard(t)
+                    if not bucket and shard.inflight.get(
+                        name
+                    ) is bucket:
+                        del shard.inflight[name]
+
+                task.add_done_callback(_done)
             else:
+                # strict per-object order for everything else: an
+                # inline op (read, mixed vector, full rewrite) must
+                # observe every previously-queued pipelined write on
+                # its object — same-client read-your-writes
+                if inflight:
+                    await asyncio.gather(
+                        *list(inflight), return_exceptions=True
+                    )
                 await self._run_client_op(conn, p)
 
     def _op_pipelines(self, p) -> bool:
@@ -1863,14 +2263,20 @@ class OSDService(Dispatcher):
         error is retryable (no errno) so the client resends once the
         cluster heals."""
         pool = self.osdmap.pools[pg.pool]
+        # backfill targets don't count: an amnesiac-revived store takes
+        # no writes and holds nothing yet, so letting it satisfy
+        # min_size would ack writes that live on too few REAL copies to
+        # survive the next failure (the hole PastIntervals closes in the
+        # reference, osd_types.h:3030)
         alive = sum(
             1 for o in acting
             if o != _NONE and not self.osdmap.is_down(o)
+            and o not in pg.backfill_targets
         )
         if alive < pool.min_size:
             raise RuntimeError(
-                f"pg {pg.pool}.{pg.ps} has {alive} acting members, "
-                f"below min_size {pool.min_size}"
+                f"pg {pg.pool}.{pg.ps} has {alive} complete acting "
+                f"members, below min_size {pool.min_size}"
             )
 
     async def _sub_op_persist(
@@ -2103,6 +2509,7 @@ class OSDService(Dispatcher):
                 for osd in acting
                 if osd not in (self.id, _NONE)
                 and not self.osdmap.is_down(osd)
+                and osd not in pg.backfill_targets
             ]
             if waits:
                 await asyncio.gather(*waits)
@@ -2193,7 +2600,8 @@ class OSDService(Dispatcher):
             ec = self.codec(pg.pool)
             waits = []
             for pos, osd in enumerate(acting):
-                if osd == _NONE or self.osdmap.is_down(osd):
+                if (osd == _NONE or self.osdmap.is_down(osd)
+                        or osd in pg.backfill_targets):
                     continue
                 shard = pos if ec is not None else None
                 if osd == self.id:
@@ -2251,7 +2659,8 @@ class OSDService(Dispatcher):
         ec = self.codec(pg.pool)
         ok = True
         for pos, osd in enumerate(acting):
-            if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
+            if (osd in (self.id, _NONE) or self.osdmap.is_down(osd)
+                    or osd in pg.backfill_targets):
                 continue
             shard = pos if ec is not None else None
             if entry["kind"] == "delete":
@@ -2320,7 +2729,8 @@ class OSDService(Dispatcher):
         pg._full_mut[name] = entry["obj_ver"]
         waits = []
         for pos, osd in enumerate(acting):
-            if osd == _NONE or self.osdmap.is_down(osd):
+            if (osd == _NONE or self.osdmap.is_down(osd)
+                    or osd in pg.backfill_targets):
                 continue  # degraded write: that shard stays missing
             if osd == self.id:
                 txn = Transaction().write(
@@ -2448,7 +2858,8 @@ class OSDService(Dispatcher):
         overlapping ones), and an intervening whole-object write is
         fenced at commit via _full_mut — so newer is safe here."""
         osd = acting[phys] if phys < len(acting) else _NONE
-        if osd == _NONE or self.osdmap.is_down(osd):
+        if (osd == _NONE or self.osdmap.is_down(osd)
+                or osd in pg.backfill_targets):
             raise _PartialUnfit
         sname = shard_name(name, phys)
         if osd == self.id:
@@ -2524,7 +2935,8 @@ class OSDService(Dispatcher):
         sub = partial["sub"]
         waits = []
         for pos, osd in enumerate(acting):
-            if osd == _NONE or self.osdmap.is_down(osd):
+            if (osd == _NONE or self.osdmap.is_down(osd)
+                    or osd in pg.backfill_targets):
                 continue
             extents = sub.get(pos, [])
             if osd == self.id:
@@ -2556,7 +2968,8 @@ class OSDService(Dispatcher):
         pg._full_mut[entry["name"]] = entry["obj_ver"]
         waits = []
         for pos, osd in enumerate(acting):
-            if osd == _NONE or self.osdmap.is_down(osd):
+            if (osd == _NONE or self.osdmap.is_down(osd)
+                    or osd in pg.backfill_targets):
                 continue
             if osd == self.id:
                 txn = Transaction().remove(
@@ -2678,6 +3091,7 @@ class OSDService(Dispatcher):
                 for osd in acting
                 if osd not in (self.id, _NONE)
                 and not self.osdmap.is_down(osd)
+                and osd not in pg.backfill_targets
             ]
             if waits:
                 await asyncio.gather(*waits)
@@ -2712,6 +3126,7 @@ class OSDService(Dispatcher):
             for osd in acting
             if osd not in (self.id, _NONE)
             and not self.osdmap.is_down(osd)
+            and osd not in pg.backfill_targets
         ]
         if waits:
             await asyncio.gather(*waits)
@@ -2724,18 +3139,33 @@ class OSDService(Dispatcher):
             raise StoreError("ENOENT", f"no such object {name!r}")
         ec = self.codec(pg.pool)
         if ec is None:
-            data = self.store.read(pg.coll, name)
-            attrs = self.store.getattrs(pg.coll, name)
-            if attrs.get("ver") != entry["obj_ver"]:
-                raise RuntimeError(f"local replica of {name!r} is stale")
-            return data
+            try:
+                data = self.store.read(pg.coll, name)
+                attrs = self.store.getattrs(pg.coll, name)
+                if attrs.get("ver") == entry["obj_ver"]:
+                    return data
+            except StoreError:
+                pass
+            # local copy missing/stale (self-backfilling primary):
+            # serve from any current-version holder instead of wedging
+            got = await self._fetch_copy(
+                pg, name, entry["obj_ver"],
+                [o for o in self._holders_for(acting, None)
+                 if o != self.id and o not in pg.backfill_targets],
+            )
+            if got is None:
+                raise RuntimeError(
+                    f"no current copy of {name!r} reachable"
+                )  # retryable
+            return got[0]
 
         # EC: probe current-version shard availability at acting homes
         available: dict[int, int] = {}
         chunks: dict[int, bytes] = {}
         size = None
         for pos, osd in enumerate(acting):
-            if osd == _NONE or self.osdmap.is_down(osd):
+            if (osd == _NONE or self.osdmap.is_down(osd)
+                    or osd in pg.backfill_targets):
                 continue
             if osd == self.id:
                 try:
@@ -3131,7 +3561,8 @@ class OSDService(Dispatcher):
                     continue
                 copies: dict[int, tuple] = {}  # pos -> (data, attrs)
                 for pos, osd in enumerate(acting):
-                    if osd == _NONE or self.osdmap.is_down(osd):
+                    if (osd == _NONE or self.osdmap.is_down(osd)
+                            or osd in pg.backfill_targets):
                         continue
                     shard = pos if ec is not None else None
                     got = await self._scrub_fetch(
@@ -3203,6 +3634,20 @@ class OSDService(Dispatcher):
                                  "error": "inconsistent"}
                             )
         self.perf.inc("scrub_errors", len(errors))
+        if deep:
+            # refresh the health feed for every PG this pass scanned
+            # (zero clears a previously-flagged PG that came back clean)
+            scanned = [
+                (pid, ps) for (pid, ps), pg in sorted(self.pgs.items())
+                if pid == pool_id and pg.active
+                and self.acting_of(pid, ps)[1] == self.id
+            ]
+            for key in scanned:
+                self._scrub_incons[key] = 0
+            for err in errors:
+                key = tuple(err["pg"])
+                if key in self._scrub_incons:
+                    self._scrub_incons[key] += 1
         return {"errors": errors}
 
     async def _repair(self, pool_id: int) -> dict:

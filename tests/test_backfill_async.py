@@ -1,0 +1,221 @@
+"""Async backfill + the amnesiac-revival availability hole (VERDICT #2).
+
+The deterministic regression for the window the thrasher used to hit: an
+OSD revived with a BLANK store (its PG logs trimmed past bridging, so it
+needs full backfill) plus a REAL kill of another member. The old
+behavior wedged the PG (inactive until the whole backfill finished) or
+let the blank store masquerade as a current member; the fixed behavior:
+
+  * the PG activates with the blank member as a backfill target
+    (PeeringState::Active + backfill_targets; PastIntervals' role of
+    keeping amnesiac stores out of service, osd_types.h:3030),
+  * reads keep working through the double-failure window (decode from
+    the k complete shards),
+  * writes are REFUSED while complete members < min_size — the blank
+    store does not satisfy min_size,
+  * the background drain backfills the target and service heals.
+
+The test pins the window open deterministically by holding every
+daemon's backfill semaphore (osd_max_backfills reservation throttle), so
+no timing is involved.
+"""
+
+import asyncio
+
+import numpy as np
+
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import (
+    EC_POOL,
+    Cluster,
+    live_config,
+    wait_until,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 240))
+
+
+def trimmed_config():
+    cfg = live_config()
+    # force blank revivals to need BACKFILL, not log-bridging: with the
+    # log trimmed past version 0 an empty peer can never bridge
+    cfg.set("osd_min_pg_log_entries", 2)
+    return cfg
+
+
+def test_revive_blank_plus_kill_keeps_reads_refuses_unsafe_writes():
+    async def main():
+        cluster = Cluster(cfg=trimmed_config())
+        await cluster.start()
+        try:
+            rados = Rados("client.bf", cluster.monmap, config=cluster.cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+            io = rados.io_ctx(EC_POOL)
+            rng = np.random.default_rng(23)
+            payloads = {}
+            # several entries per PG so every log trims past 0
+            for i in range(24):
+                data = rng.integers(0, 256, 3000, np.uint8).tobytes()
+                await io.write_full(f"obj{i}", b"seed")
+                await io.write_full(f"obj{i}", data)
+                payloads[f"obj{i}"] = data
+
+            any_osd = next(iter(cluster.osds.values()))
+            victim_name = "obj7"
+            ps = any_osd.object_pg(EC_POOL, victim_name)
+            acting, primary = any_osd.acting_of(EC_POOL, ps)
+            blank = next(o for o in acting if o != primary)
+
+            # hold every daemon's backfill reservation so the drain
+            # cannot run: the window stays open deterministically
+            for o in cluster.osds.values():
+                await o._backfill_sem.acquire()
+
+            await cluster.kill_osd(blank)
+            await wait_until(
+                lambda: all(
+                    o.osdmap.is_down(blank)
+                    for o in cluster.osds.values()
+                )
+            )
+            await cluster.start_osd(blank)  # BLANK store: amnesiac
+            await cluster.osds[blank]._backfill_sem.acquire()
+            await wait_until(
+                lambda: all(
+                    not o.osdmap.is_down(blank)
+                    for o in cluster.osds.values()
+                )
+            )
+
+            def victim_pg():
+                p = cluster.osds.get(
+                    any_osd.acting_of(EC_POOL, ps)[1]
+                )
+                return p.pgs.get((EC_POOL, ps)) if p else None
+
+            # the PG must go ACTIVE with the blank member as a backfill
+            # target — not wedge behind the (blocked) backfill
+            await wait_until(
+                lambda: (pg := victim_pg()) is not None
+                and pg.active and blank in pg.backfill_targets,
+                timeout=60,
+            )
+
+            # the second, REAL failure: kill another acting member
+            second = next(
+                o for o in any_osd.acting_of(EC_POOL, ps)[0]
+                if o not in (blank, primary)
+                and o in cluster.osds
+            )
+            await cluster.kill_osd(second)
+            await wait_until(
+                lambda: all(
+                    o.osdmap.is_down(second)
+                    for o in cluster.osds.values()
+                )
+            )
+
+            # reads stay up through the double-failure window: k=2
+            # complete shards remain and the amnesiac member is never
+            # trusted as one of them
+            got = await asyncio.wait_for(io.read(victim_name), 30)
+            assert got == payloads[victim_name]
+
+            # writes must be refused: complete members (2) < min_size
+            # (3) — acking onto the blank store would fake durability
+            with np.testing.assert_raises(asyncio.TimeoutError):
+                await asyncio.wait_for(
+                    io.write_full(victim_name, b"unsafe"), 3.0
+                )
+            # the refused write must not have landed anywhere
+            got = await asyncio.wait_for(io.read(victim_name), 30)
+            assert got == payloads[victim_name]
+
+            # open the gate: drain backfills the blank member, service
+            # heals, writes flow again
+            for o in cluster.osds.values():
+                o._backfill_sem.release()
+            await wait_until(
+                lambda: (pg := victim_pg()) is not None
+                and pg.active and not pg.backfill_targets,
+                timeout=90,
+            )
+            await asyncio.wait_for(
+                io.write_full(victim_name, b"post-heal"), 30
+            )
+            assert await io.read(victim_name) == b"post-heal"
+
+            # every other object survived the whole episode
+            for name, data in payloads.items():
+                if name == victim_name:
+                    continue
+                assert await io.read(name) == data
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_amnesiac_primary_serves_while_self_backfilling():
+    """The revived-blank member IS the primary: it must adopt the
+    authority's inventory, activate, and serve reads by decoding around
+    its missing local shards while its own data heals in the
+    background."""
+    async def main():
+        cluster = Cluster(cfg=trimmed_config())
+        await cluster.start()
+        try:
+            rados = Rados("client.bfp", cluster.monmap,
+                          config=cluster.cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+            io = rados.io_ctx(EC_POOL)
+            rng = np.random.default_rng(29)
+            payloads = {}
+            for i in range(16):
+                data = rng.integers(0, 256, 2000, np.uint8).tobytes()
+                await io.write_full(f"p{i}", b"seed")
+                await io.write_full(f"p{i}", data)
+                payloads[f"p{i}"] = data
+
+            any_osd = next(iter(cluster.osds.values()))
+            name = "p3"
+            ps = any_osd.object_pg(EC_POOL, name)
+            acting, primary = any_osd.acting_of(EC_POOL, ps)
+
+            await cluster.kill_osd(primary)
+            await wait_until(
+                lambda: all(
+                    o.osdmap.is_down(primary)
+                    for o in cluster.osds.values()
+                )
+            )
+            await cluster.start_osd(primary)  # blank, and the primary
+            await wait_until(
+                lambda: all(
+                    not o.osdmap.is_down(primary)
+                    for o in cluster.osds.values()
+                )
+            )
+            # reads served by the amnesiac primary (decode around its
+            # missing shard) as soon as it re-learns the inventory
+            got = await asyncio.wait_for(io.read(name), 60)
+            assert got == payloads[name]
+            # and its own data heals in the background
+            await wait_until(
+                lambda: (
+                    pg := cluster.osds[primary].pgs.get((EC_POOL, ps))
+                ) is not None and pg.active and not pg.self_backfill,
+                timeout=90,
+            )
+            for nm, data in payloads.items():
+                assert await io.read(nm) == data
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())

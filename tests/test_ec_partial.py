@@ -321,3 +321,36 @@ def test_live_partial_falls_back_when_degraded():
             await cluster.stop()
 
     run(main())
+
+
+def test_pipelined_write_then_read_orders():
+    """A read queued right behind a pipelined partial write on the same
+    object must observe it (per-object client ordering survives the op
+    pipelining: inline ops drain in-flight spawned writes)."""
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        try:
+            from ceph_tpu.rados.client import Rados
+
+            rados = Rados("client.ord", cluster.monmap,
+                          config=cluster.cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+            io = rados.io_ctx(2)
+            base = bytes(200_000)
+            await io.write_full("ord", base)
+            for i in range(5):
+                patch = bytes([i + 1]) * 4096
+                results = await asyncio.gather(
+                    io.write("ord", patch, off=10_000),
+                    io.read("ord", off=10_000, length=4096),
+                )
+                # the read was queued after the write on one connection:
+                # it must see the write, not pre-write bytes
+                assert results[1] == patch, i
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())
