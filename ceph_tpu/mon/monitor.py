@@ -86,6 +86,11 @@ class Monitor(Dispatcher):
         self.config = config if config is not None else Config()
         self.db = db if db is not None else MemDB()
         self.name = monmap.name(rank)
+        #: live keyring the messenger authenticates against; the auth
+        #: service's committed entities are folded in (AuthMonitor's
+        #: KeyServer feeding the transport), so adding a client via
+        #: `auth get-or-create` immediately lets it connect
+        self._keyring = keyring
         self.messenger = Messenger(
             self.name, config=self.config, keyring=keyring
         )
@@ -125,6 +130,15 @@ class Monitor(Dispatcher):
         #: without contacting a possibly-newer interval's member
         self._acting_archive: dict[tuple, list] = {}
         self._last_applied_service = ""
+        #: leader-volatile PG stats reports: osd -> (mono time, stats)
+        #: — the PGMap/MgrStatMonitor role feeding health checks; a new
+        #: leader rebuilds it from the next report wave
+        self._pg_stats: dict[int, tuple[float, dict]] = {}
+        #: AuthMonitor state (paxos-replicated via the "auth" service):
+        #: entity -> secret, and per-service rotating key windows
+        #: (service -> epoch -> secret, the RotatingSecrets role)
+        self.auth_db: dict[str, bytes] = {}
+        self.rotating: dict[str, dict[int, bytes]] = {}
         self._replay_committed()
         #: peer_name -> (connection, from_epoch) map subscribers
         self._subs: dict[str, object] = {}
@@ -502,6 +516,28 @@ class Monitor(Dispatcher):
                 self.config_kv[k] = v
             for k in delta.get("rm", []):
                 self.config_kv.pop(k, None)
+        elif service == "auth":
+            # AuthMonitor delta: entity adds/removals + rotating-key
+            # epochs; replayed deterministically like every service,
+            # and folded into the live transport keyring so commits
+            # take effect on the very next handshake
+            delta = json.loads(payload)
+            for entity, keyhex in delta.get("add", {}).items():
+                self.auth_db[entity] = bytes.fromhex(keyhex)
+                if self._keyring is not None:
+                    self._keyring[entity] = bytes.fromhex(keyhex)
+            for entity in delta.get("rm", []):
+                self.auth_db.pop(entity, None)
+                if self._keyring is not None:
+                    self._keyring.pop(entity, None)
+            for svc, epochs in delta.get("rotate", {}).items():
+                window = self.rotating.setdefault(svc, {})
+                for e, keyhex in epochs.items():
+                    window[int(e)] = bytes.fromhex(keyhex)
+                # keep a two-epoch window: current + previous (tickets
+                # sealed under the old key stay valid through rotation)
+                for old in sorted(window)[:-2]:
+                    del window[old]
 
     def _archive_actings(self, inc: Incremental) -> None:
         """Append changed acting sets to the per-PG interval archive.
@@ -869,7 +905,7 @@ class Monitor(Dispatcher):
             )
             return
         try:
-            result = await self._run_command(p)
+            result = await self._run_command(p, conn)
             reply = {"tid": p.get("tid"), "ok": True, "result": result}
         except Exception as e:  # commands reply, never crash the mon
             reply = {"tid": p.get("tid"), "ok": False, "error": str(e)}
@@ -1028,9 +1064,11 @@ class Monitor(Dispatcher):
     async def _propose_osdmap(self, inc: Incremental) -> None:
         await self.propose("osdmap", inc.encode())
 
-    async def _run_command(self, p: dict) -> dict:
+    async def _run_command(self, p: dict, conn=None) -> dict:
         cmd = p["cmd"]
         args = p.get("args", {})
+        if cmd.startswith("auth "):
+            return await self._cmd_auth(cmd, args, conn)
         if cmd == "osd pool create":
             return await self._cmd_pool_create(args)
         if cmd == "osd erasure-code-profile set":
@@ -1218,6 +1256,167 @@ class Monitor(Dispatcher):
                 "num_osds": self.osdmap.max_osd,
                 "num_up": int(self.osdmap.osd_up.sum()),
                 "pools": sorted(self.osdmap.pools),
+                "health": self._health(),
+            }
+        if cmd == "pg stats report":
+            # primaries report PG state sums (num/degraded/undersized/
+            # backfilling/peering/inconsistent) — the PGStats flow that
+            # feeds the reference's health checks via the mgr's PGMap
+            self._pg_stats[int(args["osd"])] = (
+                asyncio.get_event_loop().time(), dict(args["stats"])
+            )
+            return {}
+        if cmd == "health":
+            return self._health()
+        raise ValueError(f"unknown command {cmd!r}")
+
+    def _health(self) -> dict:
+        """Real health checks (the role of Monitor.cc's get_health /
+        HealthMonitor + the mgr PGMap's check generation): map-derived
+        OSD_DOWN plus PG checks aggregated from primaries' stats
+        reports. Stale reports (>30s) and reports from down OSDs are
+        ignored — their PGs re-report from their new primaries."""
+        checks: dict[str, dict] = {}
+        down = [
+            o for o in range(self.osdmap.max_osd)
+            if self.osdmap.is_down(o)
+        ]
+        if down:
+            checks["OSD_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(down)} osds down",
+                "count": len(down),
+                "detail": [f"osd.{o} is down" for o in down],
+            }
+        now = asyncio.get_event_loop().time()
+        agg = {"degraded": 0, "undersized": 0, "backfilling": 0,
+               "peering": 0, "inconsistent": 0}
+        for osd, (t, stats) in list(self._pg_stats.items()):
+            if now - t > 30 or self.osdmap.is_down(osd):
+                continue
+            for key in agg:
+                agg[key] += int(stats.get(key, 0))
+        for key, name, sev, noun in (
+            ("degraded", "PG_DEGRADED", "HEALTH_WARN",
+             "pgs degraded"),
+            ("undersized", "PG_UNDERSIZED", "HEALTH_WARN",
+             "pgs undersized"),
+            ("backfilling", "PG_BACKFILLING", "HEALTH_WARN",
+             "pgs backfilling"),
+            ("peering", "PG_AVAILABILITY", "HEALTH_WARN",
+             "pgs not active"),
+            ("inconsistent", "PG_DAMAGED", "HEALTH_ERR",
+             "scrub errors"),
+        ):
+            if agg[key]:
+                checks[name] = {
+                    "severity": sev,
+                    "summary": f"{agg[key]} {noun}",
+                    "count": agg[key],
+                }
+        if any(
+            c["severity"] == "HEALTH_ERR" for c in checks.values()
+        ):
+            status = "HEALTH_ERR"
+        elif checks:
+            status = "HEALTH_WARN"
+        else:
+            status = "HEALTH_OK"
+        return {"status": status, "checks": checks}
+
+    async def _cmd_auth(self, cmd: str, args: dict, conn) -> dict:
+        """AuthMonitor (src/mon/AuthMonitor.cc + CephxProtocol.h roles):
+        the entity-key database, rotating service keys, and ticket
+        grants. Secrets never travel in the clear on authenticated
+        deployments: rotating keys are sealed under the requesting
+        daemon's entity key, ticket session keys under the client's."""
+        import os as _os
+        import time as _time
+
+        from ceph_tpu.auth.cephx import make_ticket, seal
+
+        requester = conn.peer_name if conn is not None else self.name
+        # key administration is capability-gated (the reference's mon
+        # caps): only the admin entity and mons may mint, read, or
+        # revoke other entities' keys — any authenticated client being
+        # able to fetch client.admin's secret would void the whole model
+        admin = requester == "client.admin" or requester.startswith(
+            "mon."
+        )
+        if cmd in ("auth get-or-create", "auth rm", "auth rotate"):
+            if not admin:
+                raise ValueError(
+                    f"{requester!r} lacks auth admin capability"
+                )
+        if cmd == "auth get-or-create":
+            entity = args["entity"]
+            existing = self.auth_db.get(entity)
+            if existing is not None:
+                return {"entity": entity, "key": existing.hex()}
+            key = args.get("key") or _os.urandom(16).hex()
+            await self.propose(
+                "auth", json.dumps({"add": {entity: key}}).encode()
+            )
+            return {"entity": entity, "key": key}
+        if cmd == "auth rm":
+            await self.propose(
+                "auth", json.dumps({"rm": [args["entity"]]}).encode()
+            )
+            return {}
+        if cmd == "auth rotate":
+            svc = args["service"]
+            epoch = max(self.rotating.get(svc, {0: b""}), default=0) + 1
+            await self.propose(
+                "auth",
+                json.dumps({
+                    "rotate": {svc: {str(epoch): _os.urandom(16).hex()}}
+                }).encode(),
+            )
+            return {"epoch": epoch}
+        if cmd == "auth rotating":
+            svc = args["service"]
+            if not self.rotating.get(svc):
+                # internal bootstrap rotation: mon-initiated, not gated
+                await self._cmd_auth(
+                    "auth rotate", {"service": svc}, None
+                )
+            window = {
+                str(e): k.hex()
+                for e, k in self.rotating[svc].items()
+            }
+            payload = json.dumps(window).encode()
+            if self._keyring is None:
+                return {"keys": window}  # auth disabled: plain
+            dkey = self._keyring.get(requester)
+            if dkey is None or not requester.split(".")[0] in (
+                "mon", "osd", "mgr", "mds"
+            ):
+                raise ValueError(
+                    f"{requester!r} may not fetch rotating keys"
+                )
+            return {"sealed": seal(dkey, payload).hex()}
+        if cmd == "auth get-ticket":
+            svc = args["service"]
+            ekey = self.auth_db.get(requester) or (
+                (self._keyring or {}).get(requester)
+            )
+            if ekey is None:
+                raise ValueError(f"unknown entity {requester!r}")
+            if not self.rotating.get(svc):
+                await self._cmd_auth(
+                    "auth rotate", {"service": svc}, None
+                )
+            epoch = max(self.rotating[svc])
+            session_key = _os.urandom(32)
+            ttl = self.config.get("auth_service_ticket_ttl")
+            ticket = make_ticket(
+                self.rotating[svc][epoch], epoch, requester,
+                session_key, _time.time() + ttl,
+            )
+            return {
+                "ticket": ticket.hex(),
+                "session_key": seal(ekey, session_key).hex(),
+                "ttl": ttl,
             }
         raise ValueError(f"unknown command {cmd!r}")
 
@@ -1265,17 +1464,27 @@ class Monitor(Dispatcher):
                 raise ValueError(
                     f"no erasure-code profile {profile_name!r}"
                 )
-            k = int(profile.get("k", 2))
-            m = int(profile.get("m", 1))
+            # size/min_size come from the CODEC, not k+m: LRC's locality
+            # chunks and CLAY's geometry make get_chunk_count() the real
+            # width (OSDMonitor::prepare_pool_size instantiates the
+            # erasure code the same way, OSDMonitor.cc:6407)
+            from ceph_tpu.ec.registry import factory
+
+            ec = factory(
+                profile.get("plugin", "tpu"),
+                {kk: v for kk, v in profile.items() if kk != "plugin"},
+            )
+            size = ec.get_chunk_count()
+            data = ec.get_data_chunk_count()
             pool = PgPool(
                 pg_num=args.get("pg_num",
                                 self.config.get("osd_pool_default_pg_num")),
-                size=k + m,
-                # k+1, the reference's EC default: a write acked at
+                size=size,
+                # data+1, the reference's EC default: a write acked at
                 # exactly k live shards has zero redundancy the moment
                 # one of them is lost (OSDMonitor's
                 # osd_pool_default_min_size rule for EC pools)
-                min_size=k + 1 if m > 1 else k,
+                min_size=data + 1 if size > data + 1 else data,
                 type=TYPE_ERASURE,
                 crush_rule=args["crush_rule"],
                 erasure_code_profile=profile_name,

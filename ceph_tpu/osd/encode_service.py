@@ -42,22 +42,61 @@ def _bucket_pad(words: np.ndarray) -> tuple[np.ndarray, int]:
     return padded, w
 
 
+_UNSET = object()
+
+
 class EncodeService:
-    def __init__(self, window: float = 0.002, max_batch: int = 128):
+    def __init__(
+        self, window: float = 0.002, max_batch: int = 128,
+        mesh_min_bytes: int = 8192,
+    ):
         #: seconds the first op of a batch waits for company
         self.window = window
         self.max_batch = max_batch
+        #: planar widths >= this dispatch through the device MESH
+        #: (parallel.sharding): the coalesced batch's byte axis folds
+        #: onto the (stripe, byte) mesh with no communication, so every
+        #: visible chip shares the launch; below it the single-device
+        #: kernel wins (dispatch overhead beats the parallelism)
+        self.mesh_min_bytes = mesh_min_bytes
+        self._mesh_cache = _UNSET
+        #: launches that went through the sharded mesh path
+        self.mesh_launches = 0
         self._enc_q: dict[int, list] = {}
         self._dec_q: dict[tuple, list] = {}
         self._codecs: dict[int, object] = {}
         #: armed window timers, cancelled on flush (a stale timer from a
         #: max_batch-flushed batch would otherwise cut the NEXT window
-        #: short and erode coalescing under sustained load)
+        #: short and erode coalescing under sustained load). Decode
+        #: timers are keyed per CODEC: one shared window drains every
+        #: erasure signature queued for it (mass-failure recovery waves
+        #: mix signatures; a window per signature would serialize them)
         self._enc_timers: dict[int, object] = {}
-        self._dec_timers: dict[tuple, object] = {}
+        self._dec_timers: dict[int, object] = {}
         #: device launches / objects served — the coalescing evidence
         self.launches = 0
         self.objects = 0
+
+    def _mesh(self, width_bytes: int):
+        """The device mesh for a planar launch of `width_bytes`, or None
+        (single device / width too small to amortize dispatch)."""
+        if width_bytes < self.mesh_min_bytes:
+            return None
+        if self._mesh_cache is _UNSET:
+            import jax
+
+            n = len(jax.devices())
+            if n > 1:
+                from ceph_tpu.parallel import sharding
+
+                # largest power-of-2 subset: bucket-padded planar widths
+                # then always fold evenly onto the (stripe, byte) axes
+                self._mesh_cache = sharding.ec_mesh(
+                    1 << (n.bit_length() - 1)
+                )
+            else:
+                self._mesh_cache = None
+        return self._mesh_cache
 
     # -- encode ---------------------------------------------------------------
 
@@ -98,7 +137,17 @@ class EncodeService:
                 padded[: len(data)] = np.frombuffer(data, dtype=np.uint8)
                 for i in range(k):
                     rows[i].append(padded[i * bs: (i + 1) * bs])
-            if gp.available():
+            planes = np.stack([np.concatenate(r) for r in rows])
+            mesh = self._mesh(planes.shape[1])
+            if mesh is not None:
+                from ceph_tpu.parallel import sharding
+
+                padded, width = _bucket_pad(planes)
+                parity = sharding.mesh_encode_planar(
+                    codec, padded, mesh
+                )[:, :width]
+                self.mesh_launches += 1
+            elif gp.available():
                 words = np.stack(
                     [np.concatenate(r).view(np.int32) for r in rows]
                 )
@@ -108,10 +157,9 @@ class EncodeService:
                 )[:, :width].view(np.uint8)
                 parity = parity.reshape(codec.m, -1)
             else:
-                # off-TPU: exact table-driven numpy planar path — no
-                # device, no jit-per-width (CPU test meshes would
-                # otherwise recompile for every batch composition)
-                planes = np.stack([np.concatenate(r) for r in rows])
+                # off-device: exact table-driven numpy planar path — no
+                # jit-per-width (tiny batches would otherwise recompile
+                # for every composition)
                 parity_mat = codec._gen[codec.k:]
                 if getattr(codec, "_xor_ok", False):
                     parity = np.bitwise_xor.reduce(
@@ -169,16 +217,31 @@ class EncodeService:
         q.append((chunks, blocksize, want, fut))
         if len(q) >= self.max_batch:
             self._flush_decode(key)
-        elif len(q) == 1:
-            self._dec_timers[key] = asyncio.get_event_loop().call_later(
-                self.window, self._flush_decode, key
+            if not any(k[0] == id(codec) for k in self._dec_q):
+                timer = self._dec_timers.pop(id(codec), None)
+                if timer is not None:
+                    timer.cancel()
+        elif id(codec) not in self._dec_timers:
+            # ONE window per codec, not per signature: a mass-failure
+            # recovery wave decodes with many erasure signatures at
+            # once, and paying a fresh window per signature would
+            # serialize exactly when throughput matters most — window
+            # expiry drains EVERY signature queued for this codec
+            # (one launch each, shared window)
+            self._dec_timers[id(codec)] = (
+                asyncio.get_event_loop().call_later(
+                    self.window, self._flush_decode_all, id(codec)
+                )
             )
         return await fut
 
+    def _flush_decode_all(self, codec_id: int) -> None:
+        """Window expiry: drain every signature queued for this codec."""
+        self._dec_timers.pop(codec_id, None)
+        for key in [k for k in self._dec_q if k[0] == codec_id]:
+            self._flush_decode(key)
+
     def _flush_decode(self, key: tuple) -> None:
-        timer = self._dec_timers.pop(key, None)
-        if timer is not None:
-            timer.cancel()
         q = self._dec_q.pop(key, None)
         if not q:
             return
@@ -192,7 +255,17 @@ class EncodeService:
                     rows[i].append(
                         np.frombuffer(chunks[phys], dtype=np.uint8)
                     )
-            if gp.available():
+            planes = np.stack([np.concatenate(r) for r in rows])
+            mesh = self._mesh(planes.shape[1])
+            if mesh is not None:
+                from ceph_tpu.parallel import sharding
+
+                padded, width = _bucket_pad(planes)
+                rebuilt = sharding.mesh_decode_planar(
+                    codec, list(present), list(targets), padded, mesh
+                )[:, :width]
+                self.mesh_launches += 1
+            elif gp.available():
                 words = np.stack(
                     [np.concatenate(r).view(np.int32) for r in rows]
                 )
@@ -205,7 +278,6 @@ class EncodeService:
             else:
                 from ceph_tpu.ec import matrices
 
-                planes = np.stack([np.concatenate(r) for r in rows])
                 dm = matrices.decode_matrix(
                     codec._gen, codec.k, list(present), list(targets)
                 )

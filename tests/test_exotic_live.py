@@ -1,0 +1,218 @@
+"""Exotic EC plugins on the LIVE cluster (VERDICT #3): clay/lrc/shec/
+jerasure pools served by real daemons — write/read, degraded decode
+through a failure, recovery, deep scrub — plus CLAY's defining feature
+exercised over the wire: single-shard repair reads only the fractional
+d*(1/q) helper sub-chunks (ErasureCodeClay.cc:304+ via the ECSubRead
+range shape, ECBackend.cc:1605), not whole shards."""
+
+import asyncio
+
+import numpy as np
+
+from ceph_tpu.rados.client import Rados
+from tests.test_backfill_async import trimmed_config
+from tests.test_cluster_live import Cluster, wait_until
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 300))
+
+
+POOLS = {
+    10: ("clay", {"plugin": "clay", "k": "4", "m": "2", "d": "5"}),
+    11: ("lrc", {"plugin": "lrc", "k": "2", "m": "2", "l": "2"}),
+    12: ("shec", {"plugin": "shec", "k": "3", "m": "2", "c": "1"}),
+    13: ("jerasure", {"plugin": "jerasure", "k": "3", "m": "2",
+                      "technique": "reed_sol_van"}),
+}
+
+
+async def create_exotic_pools(rados):
+    for pool_id, (name, profile) in POOLS.items():
+        await rados.mon_command(
+            "osd erasure-code-profile set",
+            {"name": f"prof-{name}", "profile": profile},
+        )
+        await rados.mon_command(
+            "osd pool create",
+            {"pool_id": pool_id, "crush_rule": 0,
+             "erasure_code_profile": f"prof-{name}", "pg_num": 4},
+        )
+
+
+def test_exotic_codecs_live_end_to_end():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        try:
+            rados = Rados("client.ex", cluster.monmap,
+                          config=cluster.cfg)
+            await rados.connect()
+            await create_exotic_pools(rados)
+            rng = np.random.default_rng(31)
+            payloads: dict[tuple[int, str], bytes] = {}
+            for pool_id in POOLS:
+                io = rados.io_ctx(pool_id)
+                for i in range(5):
+                    data = rng.integers(
+                        0, 256, 3000, np.uint8
+                    ).tobytes()
+                    await io.write_full(f"x{i}", data)
+                    payloads[(pool_id, f"x{i}")] = data
+            for (pool_id, name), data in payloads.items():
+                assert await rados.io_ctx(pool_id).read(name) == data
+
+            # one real failure: every pool must keep serving (degraded
+            # decode where the dead OSD held a shard) and keep taking
+            # writes (complete members stay >= min_size)
+            victim = 2
+            db = cluster.osds[victim].store.db
+            await cluster.kill_osd(victim)
+            await wait_until(
+                lambda: all(
+                    o.osdmap.is_down(victim)
+                    for o in cluster.osds.values()
+                ),
+                timeout=30,
+            )
+            for (pool_id, name), data in payloads.items():
+                got = await asyncio.wait_for(
+                    rados.io_ctx(pool_id).read(name), 60
+                )
+                assert got == data, (pool_id, name)
+            for pool_id in POOLS:
+                io = rados.io_ctx(pool_id)
+                await asyncio.wait_for(
+                    io.write_full("during-failure", b"degraded-write"),
+                    60,
+                )
+                assert await io.read("during-failure") == (
+                    b"degraded-write"
+                )
+
+            # revive with its store: recovery pushes it current again,
+            # then a deep scrub of every exotic pool must be clean
+            await cluster.start_osd(victim, db=db)
+            await wait_until(
+                lambda: all(
+                    not o.osdmap.is_down(victim)
+                    for o in cluster.osds.values()
+                ),
+                timeout=30,
+            )
+
+            async def all_clean():
+                for pool_id in POOLS:
+                    for o in cluster.osds.values():
+                        rep = await o._scrub(pool_id, deep=True)
+                        if rep["errors"]:
+                            return False
+                return True
+
+            deadline = asyncio.get_event_loop().time() + 90
+            while not await all_clean():
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError("scrub never came clean")
+                await asyncio.sleep(1)
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_clay_fractional_repair_live():
+    """A blank-revived member of a CLAY pool is rebuilt by reading ONLY
+    the repair sub-chunk runs from its d helpers: helper traffic per
+    object is d*(chunk/q), asserted exactly via the recovery_sub_bytes
+    counter."""
+    async def main():
+        cluster = Cluster(cfg=trimmed_config())
+        await cluster.start()
+        try:
+            rados = Rados("client.clay", cluster.monmap,
+                          config=cluster.cfg)
+            await rados.connect()
+            await create_exotic_pools(rados)
+            io = rados.io_ctx(10)  # clay k4 m2 d5
+            rng = np.random.default_rng(37)
+            size = 3000
+            payloads = {}
+            for i in range(8):
+                data = rng.integers(0, 256, size, np.uint8).tobytes()
+                await io.write_full(f"c{i}", b"seed")
+                await io.write_full(f"c{i}", data)  # trim the logs
+                payloads[f"c{i}"] = data
+
+            from ceph_tpu.ec.registry import factory
+
+            clay = factory(
+                "clay", {"k": "4", "m": "2", "d": "5"}
+            )
+            cs = clay.get_chunk_size(size)
+            d, q = clay.d, clay.q
+            per_object = d * cs // q  # the fractional repair budget
+
+            victim = 3
+            await cluster.kill_osd(victim)
+            await wait_until(
+                lambda: all(
+                    o.osdmap.is_down(victim)
+                    for o in cluster.osds.values()
+                ),
+                timeout=30,
+            )
+            await cluster.start_osd(victim)  # BLANK: needs its shards
+            await wait_until(
+                lambda: all(
+                    not o.osdmap.is_down(victim)
+                    for o in cluster.osds.values()
+                ),
+                timeout=30,
+            )
+
+            def sub_bytes_now():
+                return sum(
+                    o.perf._counters["recovery_sub_bytes"].value
+                    for o in cluster.osds.values()
+                )
+
+            # first wait for fractional repair to actually happen (the
+            # drained predicate is vacuously true before peering
+            # registers the blank member), then for recovery to finish
+            await wait_until(
+                lambda: sub_bytes_now() >= per_object, timeout=120
+            )
+
+            def drained():
+                return all(
+                    not pg.backfill_targets and not pg.self_backfill
+                    for o in cluster.osds.values()
+                    for pg in o.pgs.values()
+                    if pg.pool == 10
+                ) and all(
+                    pg.active
+                    for o in cluster.osds.values()
+                    for pg in o.pgs.values()
+                    if pg.pool == 10 and (
+                        o.acting_of(10, pg.ps)[1] == o.id
+                    )
+                )
+
+            await wait_until(drained, timeout=120)
+
+            sub_bytes = sub_bytes_now()
+            # every rebuilt shard read exactly d*(cs/q) helper bytes;
+            # "seed" writes were superseded so only current versions
+            # (uniform size) get rebuilt
+            assert sub_bytes > 0, "no fractional repair happened"
+            assert sub_bytes % per_object == 0, (
+                sub_bytes, per_object
+            )
+            for name, data in payloads.items():
+                assert await io.read(name) == data
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())
