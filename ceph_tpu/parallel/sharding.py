@@ -82,3 +82,36 @@ def sharded_decode(ec, present, targets, survivors, mesh: Mesh):
     return _sharded_matmul(mesh)(
         jnp.asarray(bits), survivors[:, : ec.k, :]
     )
+
+
+# -- planar entry points (the EncodeService mesh path) ------------------------
+#
+# The batch service packs concurrent objects' chunks end to end into (k, W)
+# planar rows. Byte columns are independent, so the W axis folds exactly into
+# the 2D mesh: split W into `stripe` blocks (data-parallel) whose chunks then
+# shard on `byte` — one reshape, no communication, bit-exact vs single-device.
+
+
+def mesh_encode_planar(ec, planes: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """(k, W) uint8 planar rows -> (m, W) parity via the sharded kernel.
+    W must divide evenly into the mesh (callers bucket-pad to powers of
+    two, which any <=8-device mesh divides)."""
+    k, w = planes.shape
+    s = mesh.shape["stripe"]
+    data = planes.reshape(k, s, w // s).transpose(1, 0, 2)
+    out = np.asarray(sharded_encode(ec, shard_batch(data, mesh), mesh))
+    return out.transpose(1, 0, 2).reshape(-1, w)
+
+
+def mesh_decode_planar(
+    ec, present, targets, planes: np.ndarray, mesh: Mesh
+) -> np.ndarray:
+    """(k, W) planar survivor rows (logical ids `present`, ascending) ->
+    (len(targets), W) rebuilt rows, sharded like mesh_encode_planar."""
+    k, w = planes.shape
+    s = mesh.shape["stripe"]
+    data = planes.reshape(k, s, w // s).transpose(1, 0, 2)
+    out = np.asarray(
+        sharded_decode(ec, present, targets, shard_batch(data, mesh), mesh)
+    )
+    return out.transpose(1, 0, 2).reshape(len(targets), w)
