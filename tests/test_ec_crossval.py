@@ -1,0 +1,221 @@
+"""Independent EC cross-validation (VERDICT #8).
+
+The non-regression corpus is self-generated, so matrix-family correctness
+needs an anchor OUTSIDE the repo's own GF stack. Two anchors here:
+
+1. **Published constants**: the antilog table of GF(2^8)/0x11D — the
+   polynomial both ISA-L (ec_base.h tables) and jerasure/gf-complete
+   (w=8 default) use — is published verbatim in the Reed-Solomon
+   literature (it is the QR-code / RS tutorial table). Its first 64
+   entries are re-typed below and pinned against both implementations.
+
+2. **A second, independently-derived GF implementation**: Russian-
+   peasant carry-less multiplication with on-the-fly reduction, written
+   here from the field definition alone — no tables, no bit-planes, no
+   shared code with ceph_tpu.ops.gf (which uses log/antilog tables and
+   bit-matrix planes). Inversion is Fermat (a^254). The repo's gf_mul is
+   checked against it over the FULL 256x256 product space, and the
+   benchmark-config generators (ISA-L cauchy RS(8,3), jerasure
+   reed_sol_van(4,2)) are rebuilt from their published constructions on
+   top of it and matched chunk-for-chunk against the live codecs.
+
+Reference roles: src/test/erasure-code/ceph_erasure_code_non_regression.cc:37
+(cross-version bit-stability), ISA-L gf_gen_cauchy1_matrix /
+gf_gen_rs_matrix, jerasure reed_sol.c reed_sol_vandermonde_coding_matrix.
+"""
+
+import numpy as np
+
+from ceph_tpu.ec.registry import factory
+from ceph_tpu.ops.gf import GF_POLY, gf_mul
+
+# First 64 antilog entries (powers of 2) of GF(2^8) mod 0x11D, as
+# published in the Reed-Solomon literature (QR spec table); re-typed.
+PUBLISHED_ANTILOG_64 = [
+    1, 2, 4, 8, 16, 32, 64, 128, 29, 58, 116, 232, 205, 135, 19, 38,
+    76, 152, 45, 90, 180, 117, 234, 201, 143, 3, 6, 12, 24, 48, 96, 192,
+    157, 39, 78, 156, 37, 74, 148, 53, 106, 212, 181, 119, 238, 193,
+    159, 35, 70, 140, 5, 10, 20, 40, 80, 160, 93, 186, 105, 210, 185,
+    111, 222, 161,
+]
+
+
+# -- the independent field -----------------------------------------------------
+
+
+def pz_mul(a: int, b: int) -> int:
+    """GF(2^8) product by Russian-peasant shift-xor, reducing by 0x11D."""
+    p = 0
+    for _ in range(8):
+        if b & 1:
+            p ^= a
+        b >>= 1
+        a <<= 1
+        if a & 0x100:
+            a ^= 0x11D
+    return p
+
+
+def pz_pow(a: int, e: int) -> int:
+    r = 1
+    while e:
+        if e & 1:
+            r = pz_mul(r, a)
+        a = pz_mul(a, a)
+        e >>= 1
+    return r
+
+
+def pz_inv(a: int) -> int:
+    assert a != 0
+    return pz_pow(a, 254)  # Fermat: a^(2^8 - 2)
+
+
+def pz_encode(matrix, data_chunks):
+    """Parity chunks via the independent field: out[i][b] =
+    XOR_j M[i][j] * data[j][b], plain Python."""
+    m, k = len(matrix), len(matrix[0])
+    width = len(data_chunks[0])
+    out = []
+    for i in range(m):
+        row = bytearray(width)
+        for j in range(k):
+            c = matrix[i][j]
+            if c == 0:
+                continue
+            chunk = data_chunks[j]
+            for b in range(width):
+                row[b] ^= pz_mul(c, chunk[b])
+        out.append(bytes(row))
+    return out
+
+
+def test_polynomial_and_published_antilog():
+    assert GF_POLY == 0x11D
+    acc = 1
+    for want in PUBLISHED_ANTILOG_64:
+        assert acc == want
+        acc = pz_mul(acc, 2)
+    # the repo's table-driven gf_mul walks the same published sequence
+    acc = np.uint8(1)
+    for want in PUBLISHED_ANTILOG_64:
+        assert int(acc) == want
+        acc = gf_mul(acc, np.uint8(2))
+
+
+def test_repo_gf_mul_matches_peasant_everywhere():
+    a = np.arange(256, dtype=np.uint8)[:, None]
+    b = np.arange(256, dtype=np.uint8)[None, :]
+    repo = gf_mul(a, b)
+    for x in range(256):
+        for y in range(256):
+            assert int(repo[x, y]) == pz_mul(x, y), (x, y)
+
+
+def _independent_isa_cauchy(k: int, m: int):
+    """ISA-L gf_gen_cauchy1_matrix, from its published definition:
+    parity row i, column j = inverse((k+i) XOR j)."""
+    return [
+        [pz_inv((k + i) ^ j) for j in range(k)] for i in range(m)
+    ]
+
+
+def _independent_reed_sol_van(k: int, m: int):
+    """jerasure reed_sol_vandermonde_coding_matrix from reed_sol.c's
+    published construction: the (k+m) x k EXTENDED Vandermonde matrix
+    (row 0 = e_0, rows 1..k+m-2 = powers of the row index, last row =
+    e_{k-1}), column-reduced so the top k x k block is the identity,
+    then normalized so parity row 0 and parity column 0 are all ones."""
+    rows = k + m
+    V = [[0] * k for _ in range(rows)]
+    V[0][0] = 1
+    V[rows - 1][k - 1] = 1
+    for i in range(1, rows - 1):
+        acc = 1
+        for j in range(k):
+            V[i][j] = acc
+            acc = pz_mul(acc, i)
+
+    for i in range(1, k):
+        # pivot: make V[i][i] nonzero by a row swap from below
+        if V[i][i] == 0:
+            s = next(
+                r for r in range(i + 1, rows) if V[r][i] != 0
+            )
+            V[i], V[s] = V[s], V[i]
+        # scale column i so the pivot is 1
+        if V[i][i] != 1:
+            inv = pz_inv(V[i][i])
+            for r in range(rows):
+                V[r][i] = pz_mul(V[r][i], inv)
+        # eliminate every other column's row-i entry with column ops
+        for j in range(k):
+            if j == i or V[i][j] == 0:
+                continue
+            t = V[i][j]
+            for r in range(rows):
+                V[r][j] ^= pz_mul(t, V[r][i])
+
+    # normalization (reed_sol_big_vandermonde_distribution_matrix):
+    # divide parity columns so parity row 0 is all ones, then divide
+    # parity rows so parity column 0 is all ones
+    for j in range(k):
+        t = V[k][j]
+        if t not in (0, 1):
+            inv = pz_inv(t)
+            for r in range(k, rows):
+                V[r][j] = pz_mul(V[r][j], inv)
+    for i in range(k + 1, rows):
+        t = V[i][0]
+        if t not in (0, 1):
+            inv = pz_inv(t)
+            V[i] = [pz_mul(x, inv) for x in V[i]]
+    return [V[r] for r in range(k, rows)]
+
+
+def _chunks_of(codec, data: bytes):
+    n = codec.get_chunk_count()
+    enc = codec.encode(range(n), data)
+    k = codec.get_data_chunk_count()
+    datas = [enc[codec.chunk_index(j)] for j in range(k)]
+    parity = [enc[codec.chunk_index(k + i)] for i in range(n - k)]
+    return datas, parity
+
+
+def test_rs83_isa_cauchy_matches_independent_field():
+    codec = factory(
+        "isa", {"k": "8", "m": "3", "technique": "cauchy"}
+    )
+    rng = np.random.default_rng(83)
+    data = rng.integers(0, 256, 8 * 96, np.uint8).tobytes()
+    datas, parity = _chunks_of(codec, data)
+    want = pz_encode(_independent_isa_cauchy(8, 3), datas)
+    assert parity == want
+
+
+def test_rs42_reed_sol_van_matches_independent_field():
+    codec = factory(
+        "jerasure",
+        {"k": "4", "m": "2", "technique": "reed_sol_van"},
+    )
+    rng = np.random.default_rng(42)
+    data = rng.integers(0, 256, 4 * 128, np.uint8).tobytes()
+    datas, parity = _chunks_of(codec, data)
+    want = pz_encode(_independent_reed_sol_van(4, 2), datas)
+    assert parity == want
+
+
+def test_tpu_plugin_default_matches_independent_field():
+    """The flagship plugin=tpu default geometry, pinned the same way."""
+    codec = factory("tpu", {"k": "2", "m": "2"})
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, 2 * 256, np.uint8).tobytes()
+    datas, parity = _chunks_of(codec, data)
+    # encode through the independent field with the codec's generator:
+    # proves the kernel ARITHMETIC (bit-plane MXU path) against the
+    # peasant field even where the generator is an optimized variant
+    gen = [
+        [int(c) for c in row] for row in codec._gen[codec.k:]
+    ]
+    want = pz_encode(gen, datas)
+    assert parity == want
