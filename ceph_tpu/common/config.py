@@ -132,6 +132,12 @@ SCHEMA: dict[str, Option] = {
              "this take a full backfill instead of log recovery"),
         _opt("osd_max_backfills", TYPE_UINT, LEVEL_ADVANCED, 1,
              "concurrent backfills one OSD will source (reservations)"),
+        _opt("osd_mon_report_interval", TYPE_FLOAT, LEVEL_ADVANCED, 2.0,
+             "seconds between PG stats reports to the mon (health "
+             "checks aggregate these)"),
+        _opt("auth_service_ticket_ttl", TYPE_FLOAT, LEVEL_ADVANCED,
+             3600.0,
+             "cephx service ticket lifetime; clients renew at half-life"),
         _opt("osd_ec_batch_window", TYPE_FLOAT, LEVEL_ADVANCED, 0.002,
              "seconds the first EC op of a batch waits so concurrent "
              "objects share one planar device launch"),

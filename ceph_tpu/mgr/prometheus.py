@@ -39,6 +39,25 @@ class PrometheusExporter:
                 lab = "{" + inner + "}"
             lines.append(f"{full}{lab} {value}")
 
+        # health checks (ceph_health_status convention: 0 OK, 1 WARN,
+        # 2 ERR; one labeled gauge per active check with its count)
+        try:
+            health = await self.objecter.mon.command(
+                "health", timeout=10.0
+            )
+        except Exception:
+            health = None
+        if health is not None:
+            level = {"HEALTH_OK": 0, "HEALTH_WARN": 1,
+                     "HEALTH_ERR": 2}[health["status"]]
+            gauge("health_status", level)
+            for name, check in sorted(health["checks"].items()):
+                gauge(
+                    "health_check", check.get("count", 1),
+                    {"check": name,
+                     "severity": check["severity"]},
+                )
+
         # map-level gauges (the module's health/df family)
         gauge("osdmap_epoch", osdmap.epoch)
         gauge("osd_up", int(osdmap.max_osd - sum(

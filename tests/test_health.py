@@ -1,0 +1,139 @@
+"""Mon health model (VERDICT #10): real health checks — OSD_DOWN,
+PG_DEGRADED/UNDERSIZED/BACKFILLING/AVAILABILITY from primaries' PG
+stats reports, PG_DAMAGED from deep-scrub errors — feeding `ceph
+health`, `status`, and the prometheus exporter (the Monitor.cc
+get_health / HealthMonitor + mgr PGMap roles)."""
+
+import asyncio
+
+import numpy as np
+
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import (
+    EC_POOL,
+    REP_POOL,
+    Cluster,
+    live_config,
+    wait_until,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 240))
+
+
+def health_config():
+    cfg = live_config()
+    cfg.set("osd_mon_report_interval", 0.3)
+    return cfg
+
+
+def test_health_checks_live():
+    async def main():
+        cluster = Cluster(cfg=health_config())
+        await cluster.start()
+        try:
+            rados = Rados("client.h", cluster.monmap,
+                          config=cluster.cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+            io = rados.io_ctx(REP_POOL)
+            rng = np.random.default_rng(53)
+            for i in range(6):
+                await io.write_full(
+                    f"h{i}",
+                    rng.integers(0, 256, 2000, np.uint8).tobytes(),
+                )
+
+            async def health():
+                return await rados.mon_command("health")
+
+            async def wait_health(pred, timeout=60):
+                deadline = asyncio.get_event_loop().time() + timeout
+                while True:
+                    h = await health()
+                    if pred(h):
+                        return h
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise AssertionError(f"health stuck at {h}")
+                    await asyncio.sleep(0.3)
+
+            # settled cluster: HEALTH_OK, and status carries it too
+            await wait_health(lambda h: h["status"] == "HEALTH_OK")
+            status = await rados.mon_command("status")
+            assert status["health"]["status"] == "HEALTH_OK"
+
+            # kill an OSD: OSD_DOWN + degraded/undersized PG counts
+            victim = 4
+            db = cluster.osds[victim].store.db
+            await cluster.kill_osd(victim)
+            h = await wait_health(
+                lambda h: h["status"] == "HEALTH_WARN"
+                and "OSD_DOWN" in h["checks"]
+                and h["checks"].get("PG_DEGRADED", {}).get("count", 0)
+                > 0
+            )
+            assert h["checks"]["OSD_DOWN"]["count"] == 1
+            assert "osd.4 is down" in h["checks"]["OSD_DOWN"]["detail"]
+            assert (
+                h["checks"].get("PG_UNDERSIZED", {}).get("count", 0)
+                > 0
+            )
+
+            # revive: back to HEALTH_OK once recovery settles
+            await cluster.start_osd(victim, db=db)
+            await wait_health(
+                lambda h: h["status"] == "HEALTH_OK", timeout=90
+            )
+
+            # silent corruption on a replica -> deep scrub -> PG_DAMAGED
+            # at HEALTH_ERR; repair + rescrub clears it
+            any_osd = next(iter(cluster.osds.values()))
+            name = "h2"
+            ps = any_osd.object_pg(REP_POOL, name)
+            acting, primary = any_osd.acting_of(REP_POOL, ps)
+            replica = next(o for o in acting if o != primary)
+            bad = cluster.osds[replica]
+            from ceph_tpu.osd.daemon import pg_coll
+            from ceph_tpu.osd.objectstore import Transaction
+
+            coll = pg_coll(REP_POOL, ps)
+            attrs = bad.store.getattrs(coll, name)
+            bad.store.queue_transaction(
+                Transaction().write(
+                    coll, name, b"rotted bits", attrs=attrs
+                )
+            )
+            for o in cluster.osds.values():
+                await rados.objecter.osd_admin(
+                    o.id, "scrub", {"pool": REP_POOL, "deep": True}
+                )
+            h = await wait_health(
+                lambda h: h["status"] == "HEALTH_ERR"
+                and h["checks"].get("PG_DAMAGED", {}).get("count", 0)
+                > 0
+            )
+            assert h["checks"]["PG_DAMAGED"]["severity"] == (
+                "HEALTH_ERR"
+            )
+
+            for o in cluster.osds.values():
+                await rados.objecter.osd_admin(
+                    o.id, "repair", {"pool": REP_POOL}
+                )
+            for o in cluster.osds.values():
+                await rados.objecter.osd_admin(
+                    o.id, "scrub", {"pool": REP_POOL, "deep": True}
+                )
+            await wait_health(lambda h: h["status"] == "HEALTH_OK")
+
+            # the exporter surfaces the same model
+            from ceph_tpu.mgr.prometheus import PrometheusExporter
+
+            text = await PrometheusExporter(rados.objecter).collect()
+            assert "ceph_tpu_health_status 0" in text
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())

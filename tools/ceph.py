@@ -6,6 +6,8 @@ quorum and daemons from the shell.
 Commands mirror the reference surface:
 
     status | -s                      cluster status (quorum, epoch, osds)
+    health                           health checks (OSD_DOWN, PG_DEGRADED,
+                                     PG_DAMAGED, ...) with severities
     osd tree                         crush hierarchy with up/down + weights
     osd pool create <id> <rule> [--size N | --profile NAME] [--pg-num N]
     osd erasure-code-profile set <name> k=K m=M [plugin=tpu ...]
@@ -59,6 +61,9 @@ async def _dispatch(rados, args) -> dict:
     cmd = args.command
     if cmd in ("status", "-s"):
         return await rados.mon_command("status")
+
+    if cmd == "health":
+        return await rados.mon_command("health")
 
     if cmd == "osd":
         sub = args.rest[0]
