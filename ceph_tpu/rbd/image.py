@@ -1,30 +1,57 @@
-"""Image: create/open/read/write/resize/snapshots on a striped layout.
+"""Image: create/open/read/write/resize/snapshots/clones on a striped
+layout, with an object-map accelerating existence checks.
 
-Layout parity with the reference (src/librbd/ImageCtx + ObjectMap):
+Layout parity with the reference (src/librbd/ImageCtx + ObjectMap +
+CloneRequest/CopyupRequest):
 
-  header   "rbd_header.<name>"   json {size, order, snaps} — metadata
+  header   "rbd_header.<name>"   json {size, order, snaps, parent,
+           protected, children} — metadata
   data     "rbd_data.<name>.<objectno:016x>" — 2^order bytes each, sparse
+  map      "rbd_object_map.<name>[.<snapid:x>]" — one bit per object
+           (exists); snapshots freeze a copy, like the reference's
+           per-snap object maps
 
-`read` returns zeros for unwritten ranges (the reference reads an absent
-object as a hole via the object map / ENOENT); `write` loads, patches, and
-rewrites only the touched objects; `resize` truncates or extends, removing
-data objects wholly beyond the new size (ObjectMap-guided trim,
-librbd::Operations::resize).
+`read` returns zeros for unwritten ranges (holes); for a CLONE, a hole in
+the child reads through to the parent's protected snapshot within the
+overlap (librbd's parent read-through). `write` to an absent child object
+first copies the parent's content up (CopyupRequest) so the child object
+carries full data from then on. `flatten` copies every still-inherited
+object up and severs the parent link (Operations::flatten); the parent
+tracks a child count so `snap_unprotect` refuses while clones exist
+(the rbd_children registry role).
 
-Snapshots ride RADOS self-managed snaps (librbd::Operations::snap_create,
-src/librbd/Operations.cc): the image allocates a pool snap id, records it
-in the header, and every data write carries the snap context, so object
-clones happen server-side on first-write-after-snap. `snap_rollback`
-copies each object's at-snap state back over the head.
+Snapshots ride RADOS self-managed snaps (librbd::Operations::snap_create):
+the image allocates a pool snap id, records it in the header, and every
+data write carries the snap context, so object clones happen server-side
+on first-write-after-snap. `snap_rollback` copies each object's at-snap
+state back over the head.
+
+The object map is consulted on reads (an absent bit skips the RADOS read
+entirely — the fast-diff/existence role, src/librbd/ObjectMap.cc), kept
+exact on write/remove/resize/rollback/flatten, and rebuildable from a
+full stat sweep (`object_map_rebuild`, the `rbd object-map rebuild`
+CLI role).
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 
 from ceph_tpu.rados.client import IoCtx, ObjectNotFound, RadosError
 
 DEFAULT_ORDER = 22  # 4 MiB objects, the reference default (rbd_default_order)
+
+#: per-(pool, image) maintenance lock: clone/flatten/unprotect update the
+#: parent header read-modify-write, and two handles racing would lose a
+#: children-count update (the in-process slice of librbd's exclusive-lock
+#: feature; cross-process exclusion would ride watch/notify like the
+#: reference's managed lock)
+_header_locks: dict[tuple, asyncio.Lock] = {}
+
+
+def _header_lock(pool_id: int, name: str) -> asyncio.Lock:
+    return _header_locks.setdefault((pool_id, name), asyncio.Lock())
 
 
 class ImageNotFound(RadosError):
@@ -33,7 +60,8 @@ class ImageNotFound(RadosError):
 
 class Image:
     def __init__(self, ioctx: IoCtx, name: str, size: int, order: int,
-                 snaps: dict | None = None):
+                 snaps: dict | None = None, parent: dict | None = None,
+                 protected: list | None = None, children: int = 0):
         # a private IoCtx: the snap context is per-image state and must
         # not leak onto other users of the caller's pool handle
         self.ioctx = IoCtx(ioctx.objecter, ioctx.pool_id)
@@ -42,6 +70,16 @@ class Image:
         self.order = order
         #: snap name -> {"id": snapid, "size": image size at snap}
         self.snaps: dict = snaps or {}
+        #: {"pool": id, "image": name, "snap": name, "snapid": id,
+        #:  "overlap": bytes} for a clone, else None
+        self.parent: dict | None = parent
+        #: snap names protected against removal (clone prerequisites)
+        self.protected: list = list(protected or [])
+        #: number of clones whose parent is a snap of this image
+        self.children = children
+        self._parent_image: "Image | None" = None
+        #: head object map bits (1 = object exists); loaded lazily
+        self._omap_bits: bytearray | None = None
         self._apply_snapc()
 
     def _apply_snapc(self) -> None:
@@ -59,6 +97,10 @@ class Image:
 
     def _data_name(self, objectno: int) -> str:
         return f"rbd_data.{self.name}.{objectno:016x}"
+
+    def _map_name(self, snapid: int | None = None) -> str:
+        base = f"rbd_object_map.{self.name}"
+        return base if snapid is None else f"{base}.{snapid:x}"
 
     @classmethod
     async def create(
@@ -83,7 +125,10 @@ class Image:
         except ObjectNotFound as e:
             raise ImageNotFound(f"no image {name!r}") from e
         return cls(ioctx, name, header["size"], header["order"],
-                   snaps=header.get("snaps"))
+                   snaps=header.get("snaps"),
+                   parent=header.get("parent"),
+                   protected=header.get("protected"),
+                   children=header.get("children", 0))
 
     async def _save_header(self) -> None:
         # the header itself is never snapshotted: strip the snapc
@@ -92,19 +137,241 @@ class Image:
             await self.ioctx.write_full(
                 self._header_name(self.name),
                 json.dumps({"size": self.size, "order": self.order,
-                            "snaps": self.snaps}).encode(),
+                            "snaps": self.snaps,
+                            "parent": self.parent,
+                            "protected": self.protected,
+                            "children": self.children}).encode(),
             )
         finally:
             self.ioctx.snapc = saved
 
     async def remove(self) -> None:
+        await self._refresh()
+        if self.children:
+            raise RadosError(
+                f"image {self.name!r} has {self.children} clone(s)"
+            )
+        bits = await self._load_map()
         objsize = 1 << self.order
         for objectno in range((self.size + objsize - 1) // objsize):
+            if not self._map_get(bits, objectno):
+                continue  # object-map fast path: known-absent
             try:
                 await self.ioctx.remove(self._data_name(objectno))
             except ObjectNotFound:
                 pass
-        await self.ioctx.remove(self._header_name(self.name))
+        for snap in self.snaps.values():
+            try:
+                await self.ioctx.remove(self._map_name(snap["id"]))
+            except ObjectNotFound:
+                pass
+        for oname in (self._map_name(), self._header_name(self.name)):
+            try:
+                await self.ioctx.remove(oname)
+            except ObjectNotFound:
+                pass
+        if self.parent is not None:
+            await self._detach_parent()
+
+    # -- object map (src/librbd/ObjectMap.cc role) -----------------------------
+
+    def _map_get(self, bits: bytearray, objectno: int) -> bool:
+        byte = objectno >> 3
+        return byte < len(bits) and bool(
+            bits[byte] & (1 << (objectno & 7))
+        )
+
+    async def _load_map(self) -> bytearray:
+        if self._omap_bits is None:
+            saved, self.ioctx.snapc = self.ioctx.snapc, None
+            try:
+                self._omap_bits = bytearray(
+                    await self.ioctx.read(self._map_name())
+                )
+            except ObjectNotFound:
+                # no map yet (older image or fresh create): rebuild from
+                # a stat sweep so existing images upgrade transparently
+                self._omap_bits = await self._stat_sweep()
+                await self._persist_map()
+            finally:
+                self.ioctx.snapc = saved
+        return self._omap_bits
+
+    async def _stat_sweep(self) -> bytearray:
+        objsize = 1 << self.order
+        n = (self.size + objsize - 1) // objsize
+        bits = bytearray((n + 7) // 8)
+        for objectno in range(n):
+            try:
+                await self.ioctx.stat(self._data_name(objectno))
+            except ObjectNotFound:
+                continue
+            bits[objectno >> 3] |= 1 << (objectno & 7)
+        return bits
+
+    async def _persist_map(self) -> None:
+        saved, self.ioctx.snapc = self.ioctx.snapc, None
+        try:
+            await self.ioctx.write_full(
+                self._map_name(), bytes(self._omap_bits)
+            )
+        finally:
+            self.ioctx.snapc = saved
+
+    @staticmethod
+    def _set_bit(bits: bytearray, objectno: int, exists: bool) -> None:
+        byte = objectno >> 3
+        if byte >= len(bits):
+            bits.extend(b"\x00" * (byte + 1 - len(bits)))
+        if exists:
+            bits[byte] |= 1 << (objectno & 7)
+        else:
+            bits[byte] &= ~(1 << (objectno & 7)) & 0xFF
+
+    async def _map_set(self, objectno: int, exists: bool) -> None:
+        bits = await self._load_map()
+        self._set_bit(bits, objectno, exists)
+        await self._persist_map()
+
+    async def object_map_rebuild(self) -> None:
+        """`rbd object-map rebuild`: recompute from a full stat sweep."""
+        self._omap_bits = await self._stat_sweep()
+        await self._persist_map()
+
+    async def object_map_check(self) -> list[int]:
+        """Objects whose map bit disagrees with reality (diagnostic;
+        the `rbd object-map check` role). Empty list = consistent."""
+        bits = await self._load_map()
+        actual = await self._stat_sweep()
+        objsize = 1 << self.order
+        n = (self.size + objsize - 1) // objsize
+        return [
+            i for i in range(n)
+            if self._map_get(bits, i) != self._map_get(actual, i)
+        ]
+
+    # -- clones (librbd CloneRequest / CopyupRequest / flatten) ---------------
+
+    async def _refresh(self) -> None:
+        """Reload header state another handle may have changed (clone
+        counts, protection) — the ImageCtx refresh librbd runs before
+        maintenance operations."""
+        fresh = await Image.open(self.ioctx, self.name)
+        self.size = fresh.size
+        self.snaps = fresh.snaps
+        self.parent = fresh.parent
+        self.protected = fresh.protected
+        self.children = fresh.children
+        self._apply_snapc()
+
+    async def snap_protect(self, snap_name: str) -> None:
+        await self._refresh()
+        if snap_name not in self.snaps:
+            raise RadosError(f"no snap {snap_name!r}")
+        if snap_name not in self.protected:
+            self.protected.append(snap_name)
+            await self._save_header()
+
+    async def snap_unprotect(self, snap_name: str) -> None:
+        async with _header_lock(self.ioctx.pool_id, self.name):
+            await self._refresh()
+            if self.children:
+                raise RadosError(
+                    f"snap {snap_name!r} has {self.children} clone(s)"
+                )
+            if snap_name in self.protected:
+                self.protected.remove(snap_name)
+                await self._save_header()
+
+    @classmethod
+    async def clone(
+        cls, parent_ioctx: IoCtx, parent_name: str, snap_name: str,
+        child_ioctx: IoCtx, child_name: str,
+    ) -> "Image":
+        """Snapshot-backed copy-on-write child (librbd::CloneRequest):
+        the child starts with NO data objects; reads fall through to the
+        parent's protected snap within the overlap, writes copy-up."""
+        async with _header_lock(parent_ioctx.pool_id, parent_name):
+            parent = await cls.open(parent_ioctx, parent_name)
+            meta = parent.snaps.get(snap_name)
+            if meta is None:
+                raise RadosError(f"no snap {snap_name!r}")
+            if snap_name not in parent.protected:
+                raise RadosError(f"snap {snap_name!r} is not protected")
+            try:
+                await child_ioctx.stat(cls._header_name(child_name))
+                raise RadosError(f"image {child_name!r} exists")
+            except ObjectNotFound:
+                pass
+            parent.children += 1
+            await parent._save_header()
+        child = cls(
+            child_ioctx, child_name, meta["size"], parent.order,
+            parent={"pool": parent_ioctx.pool_id,
+                    "image": parent_name, "snap": snap_name,
+                    "snapid": meta["id"], "overlap": meta["size"]},
+        )
+        await child._save_header()
+        return child
+
+    async def _open_parent(self) -> "Image":
+        if self._parent_image is None:
+            pioctx = IoCtx(self.ioctx.objecter, self.parent["pool"])
+            self._parent_image = await Image.open(
+                pioctx, self.parent["image"]
+            )
+        return self._parent_image
+
+    async def _parent_object(self, objectno: int) -> bytes | None:
+        """The child object's content as inherited from the parent snap
+        (clipped to the overlap), or None when outside it."""
+        if self.parent is None:
+            return None
+        objsize = 1 << self.order
+        poff = objectno * objsize
+        overlap = self.parent["overlap"]
+        if poff >= overlap:
+            return None
+        length = min(objsize, overlap - poff)
+        parent = await self._open_parent()
+        return await parent.read(poff, length, self.parent["snap"])
+
+    async def _copy_up(self, objectno: int) -> bytes:
+        """CopyupRequest: materialize an absent child object from the
+        parent before the first write touches it."""
+        inherited = await self._parent_object(objectno)
+        return inherited if inherited is not None else b""
+
+    async def _detach_parent(self) -> None:
+        async with _header_lock(
+            self.parent["pool"], self.parent["image"]
+        ):
+            parent = await self._open_parent()
+            await parent._refresh()
+            parent.children = max(0, parent.children - 1)
+            await parent._save_header()
+        self.parent = None
+        self._parent_image = None
+
+    async def flatten(self) -> None:
+        """Copy every still-inherited object up, then sever the parent
+        link (librbd::Operations::flatten)."""
+        if self.parent is None:
+            return
+        objsize = 1 << self.order
+        overlap = min(self.parent["overlap"], self.size)
+        bits = await self._load_map()
+        for objectno in range((overlap + objsize - 1) // objsize):
+            if self._map_get(bits, objectno):
+                continue  # child already owns it
+            data = await self._copy_up(objectno)
+            await self.ioctx.write_full(
+                self._data_name(objectno), data
+            )
+            self._set_bit(bits, objectno, True)
+        await self._persist_map()
+        await self._detach_parent()
+        await self._save_header()
 
     # -- extent algebra (Striper::file_to_extents for the simple layout) ------
 
@@ -148,14 +415,27 @@ class Image:
             )
         out = bytearray(length)
         objsize = 1 << self.order
+        head_bits = (
+            await self._load_map() if snapid is None else None
+        )
         for objectno, obj_off, obj_len, buf_off in self._extents(
             off, length
         ):
-            try:
-                data = await self.ioctx.read(
-                    self._data_name(objectno), snapid=snapid
-                )
-            except ObjectNotFound:
+            data = None
+            if head_bits is not None and not self._map_get(
+                head_bits, objectno
+            ):
+                # object-map fast path: no child object — inherit from
+                # the parent snap (clone) or stay a hole
+                data = await self._parent_object(objectno)
+            else:
+                try:
+                    data = await self.ioctx.read(
+                        self._data_name(objectno), snapid=snapid
+                    )
+                except ObjectNotFound:
+                    data = await self._parent_object(objectno)
+            if data is None:
                 continue  # hole: stays zero
             if len(data) < objsize:
                 data = data + b"\0" * (objsize - len(data))
@@ -172,15 +452,31 @@ class Image:
         snapid = await self.ioctx.selfmanaged_snap_create()
         self.snaps[snap_name] = {"id": snapid, "size": self.size}
         self._apply_snapc()
+        # freeze the object map alongside the data (per-snap maps)
+        bits = await self._load_map()
+        saved, self.ioctx.snapc = self.ioctx.snapc, None
+        try:
+            await self.ioctx.write_full(
+                self._map_name(snapid), bytes(bits)
+            )
+        finally:
+            self.ioctx.snapc = saved
         await self._save_header()
         return snapid
 
     async def snap_remove(self, snap_name: str) -> None:
+        await self._refresh()
+        if snap_name in self.protected:
+            raise RadosError(f"snap {snap_name!r} is protected")
         meta = self.snaps.pop(snap_name, None)
         if meta is None:
             raise RadosError(f"no snap {snap_name!r}")
         self._apply_snapc()
         await self._save_header()
+        try:
+            await self.ioctx.remove(self._map_name(meta["id"]))
+        except ObjectNotFound:
+            pass
         # pool-level removal queues the OSD-side clone trim
         await self.ioctx.selfmanaged_snap_remove(meta["id"])
 
@@ -195,6 +491,7 @@ class Image:
         objsize = 1 << self.order
         cur_objects = (self.size + objsize - 1) // objsize
         snap_objects = (snap_size + objsize - 1) // objsize
+        bits = await self._load_map()
         for objectno in range(max(cur_objects, snap_objects)):
             try:
                 data = await self.ioctx.read(
@@ -203,12 +500,15 @@ class Image:
                 await self.ioctx.write_full(
                     self._data_name(objectno), data
                 )
+                self._set_bit(bits, objectno, True)
             except ObjectNotFound:
                 # hole (or did not exist) at snap time: drop the head
                 try:
                     await self.ioctx.remove(self._data_name(objectno))
                 except ObjectNotFound:
                     pass
+                self._set_bit(bits, objectno, False)
+        await self._persist_map()  # one batched map write for the sweep
         self.size = snap_size
         await self._save_header()
 
@@ -218,36 +518,61 @@ class Image:
     async def write(self, off: int, data: bytes) -> None:
         self._check_span(off, len(data))
         objsize = 1 << self.order
+        bits = await self._load_map()
+        dirty = False
         for objectno, obj_off, obj_len, buf_off in self._extents(
             off, len(data)
         ):
             piece = data[buf_off: buf_off + obj_len]
-            if obj_off == 0 and obj_len == objsize:
+            exists = self._map_get(bits, objectno)
+            if (
+                obj_off == 0 and obj_len == objsize
+                and (self.parent is None or exists)
+            ):
                 await self.ioctx.write_full(
                     self._data_name(objectno), piece
                 )
-                continue
-            # partial object: client-side read-modify-write
-            try:
-                cur = await self.ioctx.read(self._data_name(objectno))
-            except ObjectNotFound:
-                cur = b""
-            buf = bytearray(max(len(cur), obj_off + obj_len))
-            buf[: len(cur)] = cur
-            buf[obj_off: obj_off + obj_len] = piece
-            await self.ioctx.write_full(
-                self._data_name(objectno), bytes(buf)
-            )
+            else:
+                # partial object (or first clone write): read-modify-
+                # write, seeding from the parent via copy-up when the
+                # child object doesn't exist yet
+                if exists:
+                    try:
+                        cur = await self.ioctx.read(
+                            self._data_name(objectno)
+                        )
+                    except ObjectNotFound:
+                        cur = await self._copy_up(objectno)
+                else:
+                    cur = await self._copy_up(objectno)
+                buf = bytearray(max(len(cur), obj_off + obj_len))
+                buf[: len(cur)] = cur
+                buf[obj_off: obj_off + obj_len] = piece
+                await self.ioctx.write_full(
+                    self._data_name(objectno), bytes(buf)
+                )
+            if not exists:
+                self._set_bit(bits, objectno, True)
+                dirty = True
+        if dirty:
+            await self._persist_map()  # one map write per span
 
     async def resize(self, new_size: int) -> None:
         objsize = 1 << self.order
         old_objects = (self.size + objsize - 1) // objsize
         new_objects = (new_size + objsize - 1) // objsize
+        bits = await self._load_map()
+        trimmed = False
         for objectno in range(new_objects, old_objects):
-            try:
-                await self.ioctx.remove(self._data_name(objectno))
-            except ObjectNotFound:
-                pass
+            if self._map_get(bits, objectno):
+                try:
+                    await self.ioctx.remove(self._data_name(objectno))
+                except ObjectNotFound:
+                    pass
+                self._set_bit(bits, objectno, False)
+                trimmed = True
+        if trimmed:
+            await self._persist_map()
         if new_size < self.size and new_size & (objsize - 1):
             # shrink: truncate the partial boundary object too, or a later
             # grow would re-expose stale bytes where zeros are expected
@@ -262,5 +587,11 @@ class Image:
                     )
             except ObjectNotFound:
                 pass
+        if new_size < self.size and self.parent is not None:
+            # shrinking below the parent overlap reduces what a clone
+            # can ever inherit (librbd shrinks the overlap too)
+            self.parent["overlap"] = min(
+                self.parent["overlap"], new_size
+            )
         self.size = new_size
         await self._save_header()
