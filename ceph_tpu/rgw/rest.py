@@ -1,0 +1,411 @@
+"""S3 REST frontend: the rgw HTTP surface over ObjectGateway.
+
+The reference's defining RGW surface is the S3 wire protocol
+(src/rgw/rgw_rest_s3.cc) behind AWS Signature V4 auth
+(src/rgw/rgw_auth_s3.cc): XML bodies, path-style bucket/key routing,
+multipart via ?uploads/?uploadId query ops. This module serves that
+protocol from an asyncio HTTP/1.1 server so any S3-wire-format client
+can talk to the cluster:
+
+    PUT    /bucket                    create bucket
+    DELETE /bucket                    delete bucket (409 if non-empty)
+    GET    /bucket?prefix=&marker=    ListBucketResult XML
+    PUT    /bucket/key                put object (ETag header)
+    GET    /bucket/key                get object
+    HEAD   /bucket/key                stat (Content-Length/ETag)
+    DELETE /bucket/key                delete object
+    POST   /bucket/key?uploads        InitiateMultipartUploadResult XML
+    PUT    /bucket/key?partNumber=N&uploadId=U   upload part
+    POST   /bucket/key?uploadId=U     CompleteMultipartUpload (XML body)
+    DELETE /bucket/key?uploadId=U     abort multipart
+
+Auth is AWS SigV4 (the reference's AWS4-HMAC-SHA256 verifier): the
+canonical request is rebuilt from the wire, the signing key derived from
+the registered secret, and a mismatched signature or unknown access key
+is refused with the S3 XML error envelope — no anonymous access.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import re
+import urllib.parse
+from xml.etree import ElementTree
+from xml.sax.saxutils import escape
+
+from ceph_tpu.rados.client import ObjectNotFound
+from ceph_tpu.rgw.gateway import GatewayError, ObjectGateway
+
+ALGORITHM = "AWS4-HMAC-SHA256"
+UNSIGNED = "UNSIGNED-PAYLOAD"
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, code: str, message: str = ""):
+        super().__init__(message or code)
+        self.status = status
+        self.code = code
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def _uri_encode(s: str, keep_slash: bool = False) -> str:
+    safe = "-_.~" + ("/" if keep_slash else "")
+    return urllib.parse.quote(s, safe=safe)
+
+
+def signing_key(secret: str, date: str, region: str) -> bytes:
+    """The SigV4 key-derivation chain (rgw_auth_s3 get_v4_signing_key)."""
+    k = _hmac(("AWS4" + secret).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, "s3")
+    return _hmac(k, "aws4_request")
+
+
+def canonical_request(
+    method: str, path: str, query: dict[str, str],
+    headers: dict[str, str], signed_headers: list[str],
+    payload_hash: str,
+) -> str:
+    cq = "&".join(
+        f"{_uri_encode(k)}={_uri_encode(v)}"
+        for k, v in sorted(query.items())
+    )
+    ch = "".join(
+        f"{h}:{headers.get(h, '').strip()}\n" for h in signed_headers
+    )
+    return "\n".join([
+        method,
+        _uri_encode(path, keep_slash=True),
+        cq,
+        ch,
+        ";".join(signed_headers),
+        payload_hash,
+    ])
+
+
+def string_to_sign(
+    amz_date: str, scope: str, creq: str
+) -> str:
+    return "\n".join([
+        ALGORITHM, amz_date, scope, _sha256(creq.encode())
+    ])
+
+
+_AUTH_RE = re.compile(
+    r"AWS4-HMAC-SHA256\s+"
+    r"Credential=(?P<ak>[^/]+)/(?P<date>\d{8})/(?P<region>[^/]+)"
+    r"/s3/aws4_request,\s*"
+    r"SignedHeaders=(?P<sh>[^,]+),\s*Signature=(?P<sig>[0-9a-f]+)"
+)
+
+
+class S3Frontend:
+    """asyncio HTTP server speaking the S3 protocol over a gateway."""
+
+    def __init__(
+        self, gateway: ObjectGateway,
+        users: dict[str, str] | None = None,
+        region: str = "us-east-1",
+    ):
+        self.gw = gateway
+        #: access_key -> secret_key (the rgw user database role)
+        self.users = dict(users or {})
+        self.region = region
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+
+    def add_user(self, access_key: str, secret_key: str) -> None:
+        self.users[access_key] = secret_key
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(
+            self._serve, host, port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- HTTP plumbing --------------------------------------------------------
+
+    async def _serve(self, reader, writer) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                try:
+                    method, target, _version = (
+                        line.decode().strip().split(" ", 2)
+                    )
+                except ValueError:
+                    break
+                headers: dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    name, _, value = h.decode().partition(":")
+                    headers[name.strip().lower()] = value.strip()
+                body = b""
+                n = int(headers.get("content-length", "0") or "0")
+                if n:
+                    body = await reader.readexactly(n)
+                status, rhdrs, rbody = await self._handle(
+                    method, target, headers, body
+                )
+                if method == "HEAD":
+                    # HEAD responses never carry an entity (a body here
+                    # would desynchronize keep-alive clients); 200s set
+                    # their Content-Length explicitly in the handler
+                    rbody = b""
+                reason = {200: "OK", 204: "No Content",
+                          403: "Forbidden", 404: "Not Found",
+                          409: "Conflict", 400: "Bad Request"}.get(
+                    status, "OK"
+                )
+                out = [f"HTTP/1.1 {status} {reason}"]
+                rhdrs.setdefault("Content-Length", str(len(rbody)))
+                rhdrs.setdefault("Connection", "keep-alive")
+                for k, v in rhdrs.items():
+                    out.append(f"{k}: {v}")
+                writer.write(
+                    ("\r\n".join(out) + "\r\n\r\n").encode() + rbody
+                )
+                await writer.drain()
+        except (
+            asyncio.IncompleteReadError, ConnectionError,
+            asyncio.CancelledError,
+        ):
+            pass
+        finally:
+            writer.close()
+
+    @staticmethod
+    def _error_xml(code: str, message: str) -> bytes:
+        return (
+            "<?xml version=\"1.0\" encoding=\"UTF-8\"?>"
+            f"<Error><Code>{escape(code)}</Code>"
+            f"<Message>{escape(message)}</Message></Error>"
+        ).encode()
+
+    async def _handle(self, method, target, headers, body):
+        url = urllib.parse.urlsplit(target)
+        path = urllib.parse.unquote(url.path)
+        query = dict(
+            urllib.parse.parse_qsl(url.query, keep_blank_values=True)
+        )
+        try:
+            self._authenticate(method, url, query, headers, body)
+            return await self._route(method, path, query, headers, body)
+        except S3Error as e:
+            return (
+                e.status,
+                {"Content-Type": "application/xml"},
+                self._error_xml(e.code, str(e)),
+            )
+        except ObjectNotFound as e:
+            return (
+                404, {"Content-Type": "application/xml"},
+                self._error_xml("NoSuchKey", str(e)),
+            )
+        except GatewayError as e:
+            msg = str(e)
+            code = "NoSuchBucket" if "no bucket" in msg else (
+                "BucketAlreadyExists" if "exists" in msg else
+                "InvalidRequest"
+            )
+            status = 404 if code == "NoSuchBucket" else 409
+            return (
+                status, {"Content-Type": "application/xml"},
+                self._error_xml(code, msg),
+            )
+
+    # -- SigV4 verification (rgw_auth_s3.cc role) ------------------------------
+
+    def _authenticate(self, method, url, query, headers, body) -> None:
+        auth = headers.get("authorization", "")
+        m = _AUTH_RE.match(auth)
+        if m is None:
+            raise S3Error(
+                403, "AccessDenied", "missing/malformed authorization"
+            )
+        secret = self.users.get(m["ak"])
+        if secret is None:
+            raise S3Error(
+                403, "InvalidAccessKeyId",
+                f"unknown access key {m['ak']!r}",
+            )
+        payload_hash = headers.get("x-amz-content-sha256", "")
+        if not payload_hash:
+            raise S3Error(
+                400, "InvalidRequest", "x-amz-content-sha256 required"
+            )
+        if payload_hash != UNSIGNED and payload_hash != _sha256(body):
+            raise S3Error(
+                400, "XAmzContentSHA256Mismatch",
+                "payload hash does not match body",
+            )
+        amz_date = headers.get("x-amz-date", "")
+        if not amz_date.startswith(m["date"]):
+            raise S3Error(
+                403, "AccessDenied", "credential date mismatch"
+            )
+        signed = m["sh"].split(";")
+        creq = canonical_request(
+            method, urllib.parse.unquote(url.path), query, headers,
+            signed, payload_hash,
+        )
+        scope = f"{m['date']}/{m['region']}/s3/aws4_request"
+        sts = string_to_sign(amz_date, scope, creq)
+        key = signing_key(secret, m["date"], m["region"])
+        want = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, m["sig"]):
+            raise S3Error(
+                403, "SignatureDoesNotMatch",
+                "the request signature we calculated does not match",
+            )
+
+    # -- routing --------------------------------------------------------------
+
+    async def _route(self, method, path, query, headers, body):
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        if not bucket:
+            raise S3Error(400, "InvalidRequest", "bucket required")
+        ok_xml = {"Content-Type": "application/xml"}
+        if not key:
+            if method == "PUT":
+                await self.gw.create_bucket(bucket)
+                return 200, {}, b""
+            if method == "DELETE":
+                try:
+                    await self.gw.delete_bucket(bucket)
+                except GatewayError as e:
+                    if "not empty" in str(e):
+                        raise S3Error(
+                            409, "BucketNotEmpty", str(e)
+                        ) from e
+                    raise
+                return 204, {}, b""
+            if method in ("GET", "HEAD"):
+                if not await self.gw.bucket_exists(bucket):
+                    raise S3Error(
+                        404, "NoSuchBucket", f"no bucket {bucket!r}"
+                    )
+                if method == "HEAD":
+                    return 200, {}, b""
+                entries = await self.gw.list_objects(
+                    bucket,
+                    prefix=query.get("prefix", ""),
+                    marker=query.get("marker", ""),
+                    max_entries=int(query.get("max-keys", "1000")),
+                )
+                xml = [
+                    "<?xml version=\"1.0\" encoding=\"UTF-8\"?>",
+                    "<ListBucketResult>",
+                    f"<Name>{escape(bucket)}</Name>",
+                    f"<Prefix>{escape(query.get('prefix', ''))}"
+                    "</Prefix>",
+                    f"<IsTruncated>{str(bool(entries.get('truncated'))).lower()}"
+                    "</IsTruncated>",
+                ]
+                for k, meta in sorted(entries["entries"].items()):
+                    xml.append(
+                        "<Contents>"
+                        f"<Key>{escape(k)}</Key>"
+                        f"<Size>{meta.get('size', 0)}</Size>"
+                        f"<ETag>&quot;{meta.get('etag', '')}&quot;"
+                        "</ETag></Contents>"
+                    )
+                xml.append("</ListBucketResult>")
+                return 200, ok_xml, "".join(xml).encode()
+            raise S3Error(400, "MethodNotAllowed", method)
+
+        # object-scoped ops (+ multipart query dialect)
+        if method == "POST" and "uploads" in query:
+            upload_id = await self.gw.initiate_multipart(bucket, key)
+            xml = (
+                "<?xml version=\"1.0\" encoding=\"UTF-8\"?>"
+                "<InitiateMultipartUploadResult>"
+                f"<Bucket>{escape(bucket)}</Bucket>"
+                f"<Key>{escape(key)}</Key>"
+                f"<UploadId>{escape(upload_id)}</UploadId>"
+                "</InitiateMultipartUploadResult>"
+            )
+            return 200, ok_xml, xml.encode()
+        if method == "PUT" and "uploadId" in query:
+            etag = await self.gw.upload_part(
+                bucket, key, query["uploadId"],
+                int(query["partNumber"]), body,
+            )
+            return 200, {"ETag": f'"{etag}"'}, b""
+        if method == "POST" and "uploadId" in query:
+            root = ElementTree.fromstring(body.decode())
+            ns = ""
+            if root.tag.startswith("{"):
+                ns = root.tag[: root.tag.index("}") + 1]
+            part_nums = [
+                int(p.find(f"{ns}PartNumber").text)
+                for p in root.findall(f"{ns}Part")
+            ]
+            if not part_nums or part_nums != sorted(part_nums):
+                raise S3Error(
+                    400, "InvalidPartOrder",
+                    "parts must be ascending and non-empty",
+                )
+            etag = await self.gw.complete_multipart(
+                bucket, key, query["uploadId"], part_nums
+            )
+            xml = (
+                "<?xml version=\"1.0\" encoding=\"UTF-8\"?>"
+                "<CompleteMultipartUploadResult>"
+                f"<Bucket>{escape(bucket)}</Bucket>"
+                f"<Key>{escape(key)}</Key>"
+                f"<ETag>&quot;{etag}&quot;</ETag>"
+                "</CompleteMultipartUploadResult>"
+            )
+            return 200, ok_xml, xml.encode()
+        if method == "DELETE" and "uploadId" in query:
+            await self.gw.abort_multipart(
+                bucket, key, query["uploadId"]
+            )
+            return 204, {}, b""
+
+        if method == "PUT":
+            etag = await self.gw.put_object(bucket, key, body)
+            return 200, {"ETag": f'"{etag}"'}, b""
+        if method == "GET":
+            data = await self.gw.get_object(bucket, key)
+            meta = await self.gw.head_object(bucket, key)
+            return (
+                200,
+                {"Content-Type": "application/octet-stream",
+                 "ETag": f'"{meta.get("etag", "")}"'},
+                data,
+            )
+        if method == "HEAD":
+            meta = await self.gw.head_object(bucket, key)
+            return (
+                200,
+                {"Content-Length": str(meta.get("size", 0)),
+                 "ETag": f'"{meta.get("etag", "")}"'},
+                b"",
+            )
+        if method == "DELETE":
+            await self.gw.delete_object(bucket, key)
+            return 204, {}, b""
+        raise S3Error(400, "MethodNotAllowed", method)

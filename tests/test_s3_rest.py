@@ -1,0 +1,239 @@
+"""S3 REST frontend over a live cluster (VERDICT #5): an independent
+S3-wire-format client — SigV4 signing written here straight from the
+AWS specification, raw HTTP over a TCP socket, XML bodies — round-trips
+buckets, objects, listings, and multipart uploads against the frontend;
+a bad signature and an unknown access key are refused with the S3 error
+envelope. (Reference surface: src/rgw/rgw_rest_s3.cc + rgw_auth_s3.cc.)
+"""
+
+import asyncio
+import hashlib
+import hmac
+import urllib.parse
+from xml.etree import ElementTree
+
+import numpy as np
+
+from ceph_tpu.rados.client import Rados
+from ceph_tpu.rgw import ObjectGateway, register_rgw_classes
+from ceph_tpu.rgw.rest import S3Frontend
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+AK, SK = "AKIDTESTKEY", "wJalrXUtnFEMI/K7MDENG/bPxRfiCYtest"
+REGION = "us-east-1"
+AMZ_DATE = "20260731T000000Z"
+
+
+class MiniS3Client:
+    """SigV4 + HTTP/1.1 from first principles (no server-side helpers)."""
+
+    def __init__(self, host: str, port: int, ak: str, sk: str):
+        self.host, self.port, self.ak, self.sk = host, port, ak, sk
+
+    def _sign(self, method, path, query, payload):
+        date = AMZ_DATE[:8]
+        payload_hash = hashlib.sha256(payload).hexdigest()
+        headers = {
+            "host": f"{self.host}:{self.port}",
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": AMZ_DATE,
+        }
+        signed = sorted(headers)
+        cq = "&".join(
+            f"{urllib.parse.quote(k, safe='-_.~')}="
+            f"{urllib.parse.quote(v, safe='-_.~')}"
+            for k, v in sorted(query.items())
+        )
+        creq = "\n".join([
+            method,
+            urllib.parse.quote(path, safe="/-_.~"),
+            cq,
+            "".join(f"{h}:{headers[h]}\n" for h in signed),
+            ";".join(signed),
+            payload_hash,
+        ])
+        scope = f"{date}/{REGION}/s3/aws4_request"
+        sts = "\n".join([
+            "AWS4-HMAC-SHA256", AMZ_DATE, scope,
+            hashlib.sha256(creq.encode()).hexdigest(),
+        ])
+
+        def h(key, msg):
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = h(("AWS4" + self.sk).encode(), date)
+        k = h(k, REGION)
+        k = h(k, "s3")
+        k = h(k, "aws4_request")
+        sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        headers["authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.ak}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}"
+        )
+        return headers
+
+    async def request(
+        self, method, path, query=None, payload=b"", tamper=False
+    ):
+        query = dict(query or {})
+        headers = self._sign(method, path, query, payload)
+        if tamper:
+            headers["authorization"] = (
+                headers["authorization"][:-4] + "dead"
+            )
+        qs = urllib.parse.urlencode(query)
+        target = path + ("?" + qs if qs else "")
+        reader, writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        try:
+            lines = [f"{method} {target} HTTP/1.1"]
+            headers["content-length"] = str(len(payload))
+            for k, v in headers.items():
+                lines.append(f"{k}: {v}")
+            writer.write(
+                ("\r\n".join(lines) + "\r\n\r\n").encode() + payload
+            )
+            await writer.drain()
+            status_line = await reader.readline()
+            status = int(status_line.split()[1])
+            rhdrs = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode().partition(":")
+                rhdrs[name.strip().lower()] = value.strip()
+            body = b""
+            n = int(rhdrs.get("content-length", "0") or "0")
+            if n and method != "HEAD":  # HEAD: length, no entity
+                body = await reader.readexactly(n)
+            return status, rhdrs, body
+        finally:
+            writer.close()
+
+
+def test_s3_rest_round_trip_and_auth():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        front = None
+        try:
+            for osd in cluster.osds.values():
+                register_rgw_classes(osd)
+            rados = Rados("client.s3", cluster.monmap,
+                          config=cluster.cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+            gw = ObjectGateway(
+                rados.io_ctx(EC_POOL),
+                index_ioctx=rados.io_ctx(REP_POOL),
+            )
+            front = S3Frontend(gw, users={AK: SK}, region=REGION)
+            port = await front.start()
+            c = MiniS3Client("127.0.0.1", port, AK, SK)
+
+            # bucket + object round trip over the real wire
+            st, _, _ = await c.request("PUT", "/photos")
+            assert st == 200
+            rng = np.random.default_rng(61)
+            blob = rng.integers(0, 256, 50_000, np.uint8).tobytes()
+            st, hd, _ = await c.request(
+                "PUT", "/photos/cat.jpg", payload=blob
+            )
+            assert st == 200 and hd.get("etag")
+            st, hd, body = await c.request("GET", "/photos/cat.jpg")
+            assert st == 200 and body == blob
+            st, hd, _ = await c.request("HEAD", "/photos/cat.jpg")
+            assert st == 200 and int(hd["content-length"]) == len(blob)
+
+            # listing XML
+            await c.request("PUT", "/photos/dog.jpg", payload=b"woof")
+            st, _, body = await c.request(
+                "GET", "/photos", query={"prefix": ""}
+            )
+            assert st == 200
+            root = ElementTree.fromstring(body.decode())
+            keys = [e.find("Key").text for e in root.findall("Contents")]
+            assert keys == ["cat.jpg", "dog.jpg"]
+
+            # multipart: initiate -> parts -> complete (XML body)
+            st, _, body = await c.request(
+                "POST", "/photos/big.bin", query={"uploads": ""}
+            )
+            assert st == 200
+            upload_id = ElementTree.fromstring(
+                body.decode()
+            ).find("UploadId").text
+            parts = [
+                rng.integers(0, 256, 30_000, np.uint8).tobytes()
+                for _ in range(3)
+            ]
+            for i, p in enumerate(parts, start=1):
+                st, hd, _ = await c.request(
+                    "PUT", "/photos/big.bin",
+                    query={"partNumber": str(i),
+                           "uploadId": upload_id},
+                    payload=p,
+                )
+                assert st == 200
+            complete = (
+                "<CompleteMultipartUpload>"
+                + "".join(
+                    f"<Part><PartNumber>{i}</PartNumber>"
+                    f"<ETag>\"x\"</ETag></Part>"
+                    for i in range(1, 4)
+                )
+                + "</CompleteMultipartUpload>"
+            ).encode()
+            st, _, body = await c.request(
+                "POST", "/photos/big.bin",
+                query={"uploadId": upload_id}, payload=complete,
+            )
+            assert st == 200
+            etag = ElementTree.fromstring(
+                body.decode()
+            ).find("ETag").text
+            assert etag.strip('"').endswith("-3")
+            st, _, body = await c.request("GET", "/photos/big.bin")
+            assert st == 200 and body == b"".join(parts)
+
+            # deletes + empty-bucket contract
+            st, _, body = await c.request("DELETE", "/photos")
+            assert st == 409  # BucketNotEmpty
+            for k in ("cat.jpg", "dog.jpg", "big.bin"):
+                st, _, _ = await c.request("DELETE", f"/photos/{k}")
+                assert st == 204
+            st, _, _ = await c.request("DELETE", "/photos")
+            assert st == 204
+
+            # auth refusals: tampered signature, unknown key, no auth
+            st, _, body = await c.request(
+                "PUT", "/evil", tamper=True
+            )
+            assert st == 403 and b"SignatureDoesNotMatch" in body
+            c2 = MiniS3Client("127.0.0.1", port, "AKIDWHO", SK)
+            st, _, body = await c2.request("PUT", "/evil")
+            assert st == 403 and b"InvalidAccessKeyId" in body
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port
+            )
+            writer.write(
+                b"PUT /evil HTTP/1.1\r\ncontent-length: 0\r\n\r\n"
+            )
+            await writer.drain()
+            status_line = await reader.readline()
+            assert b"403" in status_line
+            writer.close()
+            await rados.shutdown()
+        finally:
+            if front is not None:
+                await front.stop()
+            await cluster.stop()
+
+    run(main())
