@@ -52,6 +52,9 @@ class Tag(IntEnum):
     #: zlib-compressed MESSAGE payload (msgr2 compression mode: the
     #: on-wire compression leg of src/compressor wired into ProtocolV2)
     MESSAGE_COMPRESSED = 10
+    #: cephx ticket presentation (client -> service daemon): the daemon
+    #: verifies with its rotating service keys, never the client's key
+    AUTH_TICKET = 11
 
 
 @dataclass

@@ -319,6 +319,11 @@ class Connection:
         self.peer_nonce = d.u64()
         if m.keyring is None:
             return
+        service = self.peer_name.split(".", 1)[0]
+        ticket = m.tickets.get(service)
+        if ticket is not None:
+            await self._client_ticket_auth(stream, ticket)
+            return
         secret = m.keyring.get(m.name)
         if secret is None:
             raise FrameError(f"no key for {m.name} in local keyring")
@@ -354,6 +359,41 @@ class Connection:
         if not hmac_mod.compare_digest(done.payload, server_proof):
             raise FrameError("server failed mutual auth proof")
         self.session_key = _session_key(secret, nonce_c, nonce_s)
+
+    async def _client_ticket_auth(
+        self, stream: _InjectingStream, ticket: tuple[bytes, bytes]
+    ) -> None:
+        """cephx ticket presentation: prove possession of the ticket's
+        session key (the CephXAuthorizer role); the server never needs
+        our entity key, only its rotating service keys."""
+        blob, skey = ticket
+        nonce_c = os.urandom(16)
+        await stream.send(
+            Frame(
+                Tag.AUTH_TICKET,
+                Encoder().blob(blob).blob(nonce_c).bytes(),
+            ),
+            None,
+        )
+        chal = await stream.recv(None)
+        if chal.tag == Tag.RESET:
+            raise FrameError("ticket refused")
+        if chal.tag != Tag.AUTH_CHALLENGE:
+            raise FrameError(f"expected AUTH_CHALLENGE, got {chal.tag}")
+        nonce_s = Decoder(chal.payload).blob()
+        proof = hmac_mod.new(
+            skey, b"cli" + nonce_c + nonce_s, hashlib.sha256
+        ).digest()
+        await stream.send(Frame(Tag.AUTH_PROOF, proof), None)
+        done = await stream.recv(None)
+        if done.tag != Tag.AUTH_DONE:
+            raise FrameError("ticket auth refused")
+        server_proof = hmac_mod.new(
+            skey, b"srv" + nonce_s + nonce_c, hashlib.sha256
+        ).digest()
+        if not hmac_mod.compare_digest(done.payload, server_proof):
+            raise FrameError("server failed mutual ticket proof")
+        self.session_key = _session_key(skey, nonce_c, nonce_s)
 
     # -- shared loops ---------------------------------------------------------
 
@@ -491,6 +531,17 @@ class Messenger:
         self.bytes_sent = 0
         #: MESSAGE frames that went out compressed (ms_compress_mode)
         self.compressed_frames = 0
+        #: cephx client state: service ("osd"/"mds") -> (ticket blob,
+        #: session key) obtained from the mon's auth service; when a
+        #: ticket exists for a peer's service the handshake presents it
+        #: instead of expecting the peer to know our entity key
+        self.tickets: dict[str, tuple[bytes, bytes]] = {}
+        #: cephx service state: rotating key window (epoch -> secret)
+        #: fetched from the mon; enables ticket-based acceptance
+        self.service_keys: dict[int, bytes] = {}
+        #: async callback to refresh service_keys when a ticket arrives
+        #: under an epoch we don't hold (rotation raced our timer)
+        self.on_service_keys_stale = None
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -630,6 +681,8 @@ class Messenger:
         self, stream: _InjectingStream, conn: Connection
     ) -> bool:
         req = await stream.recv(None)
+        if req.tag == Tag.AUTH_TICKET and self.service_keys:
+            return await self._server_ticket_auth(stream, conn, req)
         if req.tag != Tag.AUTH_REQUEST:
             await stream.send(Frame(Tag.RESET, b""), None)
             return False
@@ -658,4 +711,52 @@ class Messenger:
         ).digest()
         await stream.send(Frame(Tag.AUTH_DONE, server_proof), None)
         conn.session_key = _session_key(secret, nonce_c, nonce_s)
+        return True
+
+    async def _server_ticket_auth(
+        self, stream: _InjectingStream, conn: Connection, req
+    ) -> bool:
+        """Verify a cephx ticket against our rotating service keys
+        (CephxServiceHandler::verify_authorizer): the ticket's sealed
+        entity must be who the peer claimed at HELLO, and the peer must
+        prove the sealed session key."""
+        import time as _time
+
+        from ceph_tpu.auth.cephx import open_ticket
+
+        d = Decoder(req.payload)
+        blob = d.blob()
+        nonce_c = d.blob()
+        got = open_ticket(self.service_keys, blob, _time.time())
+        if got is None and self.on_service_keys_stale is not None:
+            # a just-rotated epoch we haven't fetched yet: refresh the
+            # window NOW instead of bouncing clients until the timer
+            try:
+                await self.on_service_keys_stale()
+            except Exception:
+                pass
+            got = open_ticket(self.service_keys, blob, _time.time())
+        if got is None or got[0] != conn.peer_name:
+            await stream.send(Frame(Tag.RESET, b""), None)
+            return False
+        _entity, skey = got
+        nonce_s = os.urandom(16)
+        await stream.send(
+            Frame(Tag.AUTH_CHALLENGE, Encoder().blob(nonce_s).bytes()),
+            None,
+        )
+        proof = await stream.recv(None)
+        want = hmac_mod.new(
+            skey, b"cli" + nonce_c + nonce_s, hashlib.sha256
+        ).digest()
+        if proof.tag != Tag.AUTH_PROOF or not hmac_mod.compare_digest(
+            proof.payload, want
+        ):
+            await stream.send(Frame(Tag.RESET, b""), None)
+            return False
+        server_proof = hmac_mod.new(
+            skey, b"srv" + nonce_s + nonce_c, hashlib.sha256
+        ).digest()
+        await stream.send(Frame(Tag.AUTH_DONE, server_proof), None)
+        conn.session_key = _session_key(skey, nonce_c, nonce_s)
         return True

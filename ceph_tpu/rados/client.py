@@ -57,13 +57,58 @@ class Objecter(Dispatcher):
         #: (Objecter::linger_ops): a primary change re-registers them
         self._watch_primary: dict[tuple, int] = {}
         self._rewatch_tasks: set = set()
+        self._keyring = keyring
+        self._ticket_task: asyncio.Task | None = None
         self.mon.on_map_change(self._rewatch_on_map)
 
     async def start(self) -> None:
         self.mon.subscribe()
         await self.mon.wait_for_map()
+        if self._keyring is not None:
+            # cephx: fetch an OSD service ticket from the AuthMonitor
+            # and keep it fresh at half-life (the rotating-key window
+            # keeps the old one honored through a rotation)
+            await self._renew_ticket()
+            self._ticket_task = asyncio.create_task(
+                self._ticket_renew_loop()
+            )
+
+    async def _renew_ticket(self) -> None:
+        from ceph_tpu.auth.cephx import unseal
+
+        rep = await self.mon.command(
+            "auth get-ticket", {"service": "osd"}, timeout=10.0
+        )
+        skey = unseal(
+            self._keyring[self.name],
+            bytes.fromhex(rep["session_key"]),
+        )
+        if skey is None:
+            raise RadosError("mon returned an unopenable session key")
+        self.messenger.tickets["osd"] = (
+            bytes.fromhex(rep["ticket"]), skey
+        )
+        self._ticket_ttl = rep.get("ttl", 3600)
+
+    async def _ticket_renew_loop(self) -> None:
+        delay = max(1.0, self._ticket_ttl / 2)
+        while True:
+            await asyncio.sleep(delay)
+            try:
+                await self._renew_ticket()
+                delay = max(1.0, self._ticket_ttl / 2)
+            except Exception:
+                # mon churn: keep retrying FAST until renewed — backing
+                # off a whole half-life here is how tickets expire
+                delay = 1.0
 
     async def close(self) -> None:
+        if self._ticket_task is not None:
+            self._ticket_task.cancel()
+            try:
+                await self._ticket_task
+            except (asyncio.CancelledError, Exception):
+                pass
         await self.messenger.shutdown()
 
     @property
