@@ -1,4 +1,4 @@
-"""BalancerModule: upmap balancing over a live cluster.
+"""BalancerModule: upmap + crush-compat balancing over a live cluster.
 
 The loop the reference's balancer module runs (pybind/mgr/balancer):
 
@@ -10,11 +10,22 @@ The loop the reference's balancer module runs (pybind/mgr/balancer):
      (`ceph osd pg-upmap-items` per PG; module.py:execute), after which the
      next map epoch re-routes the moved PGs and primaries re-peer.
 
-`run_once` does one optimize+execute pass and returns what moved.
+`run_once(mode="crush-compat")` is the reference's other mode
+(module.py do_crush_compat, :63-78): instead of per-PG upmap exceptions
+it writes a compat WEIGHT-SET (choose_args) that nudges each device's
+straw2 draw weight until observed PG counts track crush-weight targets —
+older clients that know nothing of upmaps still map identically. The
+candidate weight-sets are evaluated with the scalar oracle mapper (a
+full recompile of the batched mapper per candidate would dwarf the
+mini-scale pool walks; at reference scale the batched mapper with
+weights as runtime inputs is the drop-in).
 """
 
 from __future__ import annotations
 
+import numpy as np
+
+from ceph_tpu.crush.types import ChooseArg
 from ceph_tpu.osd.osdmap import OSDMap
 
 
@@ -27,8 +38,11 @@ class BalancerModule:
         pools: set[int] | None = None,
         max_deviation: float = 1.0,
         max_changes: int = 10,
+        mode: str = "upmap",
     ) -> dict:
         """One balancer pass; returns {changes, mappings} as committed."""
+        if mode == "crush-compat":
+            return await self.crush_compat(pools=pools)
         osdmap = await self.mon.wait_for_map()
         # optimize on a scratch copy: the real map only changes when the
         # mon commits (balancer module works on an OSDMap::Incremental)
@@ -52,3 +66,147 @@ class BalancerModule:
             "osd pg-upmap-items", {"mappings": mappings}
         )
         return {"changes": changes, "mappings": mappings, **result}
+
+    async def crush_compat(
+        self,
+        pools: set[int] | None = None,
+        max_iterations: int = 8,
+        step: float = 0.5,
+    ) -> dict:
+        """One crush-compat pass: iterate multiplicative weight-set
+        adjustments (w *= (target/actual)^step, the reference's
+        do_crush_compat feedback loop), keep the best iterate by PG-count
+        spread, and commit the choose_args through `osd crush set` (the
+        whole-map commit path every client re-reads)."""
+        from ceph_tpu.crush.compiler import decompile_crushmap
+
+        osdmap = await self.mon.wait_for_map()
+        scratch = OSDMap.decode(osdmap.encode())
+        cmap = scratch.crush
+        target_pools = sorted(pools if pools else scratch.pools)
+        if not target_pools:
+            return {"changes": 0}
+
+        def pg_counts() -> np.ndarray:
+            c = np.zeros(scratch.max_osd, dtype=np.int64)
+            for pid in target_pools:
+                pool = scratch.pools[pid]
+                for ps in range(pool.pg_num):
+                    for osd in scratch.pg_to_up_acting_osds(
+                        pid, ps
+                    )[2]:
+                        if 0 <= osd < scratch.max_osd:
+                            c[osd] += 1
+            return c
+
+        # crush-weight targets: device weights from the hierarchy
+        dev_weight = np.zeros(scratch.max_osd, dtype=np.float64)
+        for b in cmap.buckets.values():
+            for j, item in enumerate(b.items):
+                if 0 <= item < scratch.max_osd:
+                    w = (
+                        b.item_weights[j]
+                        if b.item_weights else b.item_weight
+                    )
+                    dev_weight[item] += w
+        if dev_weight.sum() == 0:
+            return {"changes": 0}
+
+        # start from the existing compat weight-set (or item weights)
+        from ceph_tpu.crush.types import BucketAlg
+
+        amap: dict[int, ChooseArg] = {}
+        for bid, b in cmap.buckets.items():
+            # weight-sets drive straw2 draws only (bucket_straw2_choose
+            # is the lone consumer of choose_args in both mappers);
+            # EVERY straw2 bucket participates — inner buckets too, or
+            # cross-host imbalance would be unreachable (the host draw
+            # happens at the root's weights)
+            if b.alg != BucketAlg.STRAW2 or not b.items:
+                continue
+            existing = cmap.choose_args.get(bid)
+            if existing is not None and existing.weight_set:
+                rows = [list(r) for r in existing.weight_set]
+            else:
+                rows = [[
+                    b.item_weights[j] if b.item_weights
+                    else b.item_weight
+                    for j in range(len(b.items))
+                ]]
+            amap[bid] = ChooseArg(weight_set=rows)
+
+        def subtree_devices(item: int) -> list[int]:
+            if item >= 0:
+                return [item] if item < scratch.max_osd else []
+            out: list[int] = []
+            b = cmap.buckets.get(item)
+            if b is not None:
+                for child in b.items:
+                    out.extend(subtree_devices(child))
+            return out
+
+        def install(a: dict[int, ChooseArg]) -> None:
+            cmap.choose_args = a
+            cmap.choose_args_maps = {-1: a} if a else {}
+
+        def spread(c: np.ndarray) -> float:
+            share = dev_weight / dev_weight.sum()
+            expect = c.sum() * share
+            mask = dev_weight > 0
+            return float(np.abs(c - expect)[mask].max())
+
+        install(amap)
+        counts = pg_counts()
+        best = {bid: ChooseArg(
+            weight_set=[list(r) for r in a.weight_set]
+        ) for bid, a in amap.items()}
+        best_spread = spread(counts)
+        start_spread = best_spread
+        for _ in range(max_iterations):
+            share = dev_weight / dev_weight.sum()
+            expect = counts.sum() * share
+            factor = np.ones(scratch.max_osd)
+            mask = (dev_weight > 0) & (counts > 0)
+            factor[mask] = (expect[mask] / counts[mask]) ** step
+            factor = np.clip(factor, 0.5, 2.0)
+
+            def item_factor(item: int) -> float:
+                # a bucket child's adjustment is its subtree's
+                # weight-averaged device factor (the hierarchy-wide
+                # sweep do_crush_compat performs)
+                devs = subtree_devices(item)
+                wsum = sum(dev_weight[d] for d in devs)
+                if not devs or wsum == 0:
+                    return 1.0
+                return float(
+                    sum(factor[d] * dev_weight[d] for d in devs)
+                    / wsum
+                )
+
+            for bid, arg in amap.items():
+                items = cmap.buckets[bid].items
+                for row in arg.weight_set:
+                    for j, item in enumerate(items):
+                        row[j] = max(
+                            1, int(row[j] * item_factor(item))
+                        )
+            install(amap)
+            counts = pg_counts()
+            s = spread(counts)
+            if s < best_spread:
+                best_spread = s
+                best = {bid: ChooseArg(
+                    weight_set=[list(r) for r in a.weight_set]
+                ) for bid, a in amap.items()}
+        if best_spread >= start_spread:
+            return {"changes": 0, "spread": start_spread}
+        install(best)
+        await self.mon.command(
+            "osd crush set",
+            {"crush_text": decompile_crushmap(cmap)},
+        )
+        return {
+            "changes": len(best),
+            "spread_before": start_spread,
+            "spread_after": best_spread,
+        }

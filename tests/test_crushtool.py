@@ -138,3 +138,52 @@ def test_vectorized_matches_scalar_tester():
     finally:
         jm.supports = orig
     assert got == scalar
+
+
+def test_tree_dumper_walk_and_validate():
+    """The generic CrushTreeDumper walk (crush/tree.py): visit order,
+    annotated dump, and the validation checks (cycles, dangling refs,
+    weight-sum disagreements) both CLIs share."""
+    from ceph_tpu.crush import builder as cb
+    from ceph_tpu.crush.tree import dump_items, roots_of, validate, walk
+    from ceph_tpu.crush.types import BucketAlg, CrushMap, Tunables
+
+    cmap = CrushMap(tunables=Tunables.jewel())
+    h0 = cb.make_bucket(
+        cmap, -2, BucketAlg.STRAW2, 1, [0, 1], [0x10000, 0x20000]
+    )
+    h1 = cb.make_bucket(
+        cmap, -3, BucketAlg.STRAW2, 1, [2], [0x10000]
+    )
+    cb.make_bucket(
+        cmap, -1, BucketAlg.STRAW2, 10, [h0.id, h1.id],
+        [h0.weight, h1.weight],
+    )
+    assert roots_of(cmap) == [-1]
+    assert validate(cmap) == []
+
+    nodes = dump_items(cmap)
+    assert [n["id"] for n in nodes] == [-1, -2, 0, 1, -3, 2]
+    assert nodes[0]["depth"] == 0 and nodes[2]["depth"] == 2
+    assert nodes[2]["type"] == "osd"
+    assert abs(nodes[1]["weight"] - 3.0) < 1e-9  # 1 + 2
+
+    visited = []
+    walk(cmap, lambda i, b, d: visited.append((i, d)))
+    assert visited == [
+        (-1, 0), (-2, 1), (0, 2), (1, 2), (-3, 1), (2, 2)
+    ]
+
+    # corruption 1: bucket weight disagreeing with its item sum
+    cmap.buckets[-2].weight += 7
+    assert any("weight" in p for p in validate(cmap))
+    cmap.buckets[-2].weight -= 7
+    # corruption 2: a cycle (root listed as its own descendant)
+    cmap.buckets[-3].items.append(-1)
+    cmap.buckets[-3].item_weights.append(0x10000)
+    problems = validate(cmap)
+    assert any("cycle" in p for p in problems)
+    # the walk itself must terminate on the cyclic map
+    count = []
+    walk(cmap, lambda i, b, d: count.append(i))
+    assert len(count) < 50

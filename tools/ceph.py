@@ -167,40 +167,22 @@ async def _dispatch(rados, args) -> dict:
 
 
 def _osd_tree(osdmap) -> dict:
-    """`ceph osd tree`: the crush hierarchy annotated with live state."""
-    from ceph_tpu.crush.compiler import decompile_crushmap  # noqa: F401
+    """`ceph osd tree`: the CrushTreeDumper walk annotated with live
+    daemon state (up/down + reweight)."""
+    from ceph_tpu.crush.tree import dump_items
 
     cmap = osdmap.crush
     nodes = []
-
-    def walk(bid: int, depth: int):
-        b = cmap.buckets[bid]
-        nodes.append({
-            "id": bid,
-            "name": cmap.item_names.get(bid, f"bucket{-bid}"),
-            "type": cmap.type_names.get(b.type, str(b.type)),
-            "depth": depth,
-            "weight": b.weight / 0x10000,
-        })
-        for item in b.items:
-            if item < 0:
-                walk(item, depth + 1)
-            else:
-                nodes.append({
-                    "id": item,
-                    "name": cmap.item_names.get(item, f"osd.{item}"),
-                    "type": "osd",
-                    "depth": depth + 1,
-                    "status": "up" if osdmap.osd_up[item] else "down",
-                    "reweight": float(osdmap.osd_weight[item]) / 0x10000,
-                })
-
-    children = {
-        i for b in cmap.buckets.values() for i in b.items if i < 0
-    }
-    for bid in sorted(cmap.buckets, reverse=True):
-        if bid not in children:
-            walk(bid, 0)
+    for node in dump_items(cmap):
+        if node["type"] == "osd":
+            osd = node["id"]
+            node = {
+                **node,
+                "status": "up" if osdmap.osd_up[osd] else "down",
+                "reweight": float(osdmap.osd_weight[osd]) / 0x10000,
+            }
+            node.pop("weight", None)
+        nodes.append(node)
     return {"nodes": nodes, "epoch": osdmap.epoch}
 
 

@@ -67,41 +67,21 @@ def load_map(path: str) -> CrushMap:
 
 
 def dump_tree(cmap: CrushMap, out) -> None:
-    """`crushtool --tree` style hierarchy dump (CrushTreeDumper.h)."""
-    def weight_of(item: int) -> float:
-        if item >= 0:
-            for b in cmap.buckets.values():
-                if item in b.items:
-                    return b.item_weights[b.items.index(item)] / 65536.0
-            return 0.0
-        b = cmap.buckets.get(item)
-        return (b.weight / 65536.0) if b else 0.0
-
-    roots = set(cmap.buckets)
-    for b in cmap.buckets.values():
-        for item in b.items:
-            roots.discard(item)
+    """`crushtool --tree` style hierarchy dump over the shared
+    CrushTreeDumper walk (ceph_tpu.crush.tree)."""
+    from ceph_tpu.crush.tree import dump_items
 
     print("ID\tWEIGHT\tTYPE NAME", file=out)
-
-    def walk(item: int, depth: int) -> None:
-        indent = "\t" * depth
-        if item >= 0:
-            name = cmap.item_names.get(item, f"osd.{item}")
-            print(f"{item}\t{weight_of(item):.5f}\t{indent}{name}", file=out)
-            return
-        b = cmap.buckets[item]
-        tname = cmap.type_names.get(b.type, str(b.type))
-        name = cmap.item_names.get(item, f"bucket{-item}")
+    for node in dump_items(cmap):
+        indent = "\t" * node["depth"]
+        label = (
+            node["name"] if node["type"] == "osd"
+            else f"{node['type']} {node['name']}"
+        )
         print(
-            f"{item}\t{weight_of(item):.5f}\t{indent}{tname} {name}",
+            f"{node['id']}\t{node['weight']:.5f}\t{indent}{label}",
             file=out,
         )
-        for child in b.items:
-            walk(child, depth + 1)
-
-    for root in sorted(roots, reverse=True):
-        walk(root, 0)
 
 
 def main(argv=None) -> int:
@@ -112,6 +92,9 @@ def main(argv=None) -> int:
     ap.add_argument("-o", "--outfn", metavar="out")
     ap.add_argument("--test", action="store_true")
     ap.add_argument("--tree", action="store_true")
+    ap.add_argument("--check", action="store_true",
+                    help="validate map structure (cycles, dangling "
+                         "items, weight sums)")
     ap.add_argument("--min-x", type=int, default=-1)
     ap.add_argument("--max-x", type=int, default=-1)
     ap.add_argument("--x", type=int, default=None)
@@ -177,6 +160,14 @@ def main(argv=None) -> int:
         if args.tree:
             dump_tree(cmap, sys.stdout)
             return 0
+
+        if args.check:
+            from ceph_tpu.crush.tree import validate
+
+            problems = validate(cmap)
+            for p in problems:
+                print(p, file=sys.stderr)
+            return 1 if problems else 0
 
         if args.test:
             tester = CrushTester(cmap)
