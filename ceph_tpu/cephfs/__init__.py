@@ -2,9 +2,16 @@
 
 The reference's CephFS is a metadata SERVER (src/mds, 84k LoC: its own
 journal, distributed locks, dirfrag trees) with clients doing capability
-leases. The mini equivalent keeps the storage layout and the atomicity
-boundary while the MDS's serialization job is done by cls methods running
-at each directory object's primary OSD:
+leases. Two tiers here:
+
+  * `mds.MDSService` + `client.CephFSClient` — the DAEMON model:
+    clients open sessions with the active MDS (mon FSMap + beacons,
+    standby failover), mutations journal before they apply (replayed at
+    takeover), and capabilities arbitrate file access with revoke
+    round-trips. Data IO bypasses the MDS entirely.
+  * `fs.FileSystem` — the direct library (no daemon), sharing the same
+    on-RADOS layout; the MDS serialization job is done by cls methods
+    running at each directory object's primary OSD:
 
   * every directory is a RADOS object ("dir.<ino>") whose entry map is
     mutated only by the `fs_dir` object class (link/unlink are
@@ -19,6 +26,11 @@ at each directory object's primary OSD:
 mkdir/listdir/create/write/read/unlink/rmdir/rename/stat.
 """
 
+from ceph_tpu.cephfs.client import CephFSClient, CephFSError
 from ceph_tpu.cephfs.fs import FileSystem, FsError
+from ceph_tpu.cephfs.mds import MDSService
 
-__all__ = ["FileSystem", "FsError"]
+__all__ = [
+    "CephFSClient", "CephFSError", "FileSystem", "FsError",
+    "MDSService",
+]

@@ -1,0 +1,209 @@
+"""CephFSClient: the mount-side of the MDS protocol (src/client role).
+
+Metadata goes to the active MDS over a session (requests carry tids the
+MDS dedups, so resends across failover are safe); file DATA never does —
+`open` returns the ino plus a capability and the client reads/writes the
+striped RADOS objects directly (the Client.cc / Objecter split). On a
+connection error or a not-active bounce the client refetches the FSMap
+from the mon, reconnects to the new active, and resends. A cap revoke
+from the MDS drops the client's cached file data and acks immediately
+(we write through, so there is nothing dirty to flush)."""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+
+from ceph_tpu.cephfs.fs import _file_soid
+from ceph_tpu.msg import Message, Policy
+from ceph_tpu.rados.client import ObjectNotFound, RadosError
+from ceph_tpu.rados.striper import RadosStriper
+
+
+class CephFSError(RadosError):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class CephFSClient:
+    def __init__(self, rados, pool_id: int):
+        """`rados` is a connected Rados handle: its objecter's messenger
+        carries the MDS session (ext_dispatch) and its IoCtx the data
+        path."""
+        self.rados = rados
+        self.objecter = rados.objecter
+        self.ioctx = rados.io_ctx(pool_id)
+        self.striper = RadosStriper(self.ioctx)
+        self.objecter.ext_dispatch = self._dispatch
+        self._tids = itertools.count(1)
+        self._waiters: dict[int, asyncio.Future] = {}
+        self._mds_conn = None
+        self._session_open = False
+        #: ino -> cached file bytes, valid while we hold a cap
+        self._cache: dict[int, bytes] = {}
+        self.revokes_seen = 0
+
+    # -- session / transport ---------------------------------------------------
+
+    async def _dispatch(self, conn, msg: Message) -> None:
+        p = json.loads(msg.data) if msg.data else {}
+        if msg.type in ("mds_reply", "mds_session_reply"):
+            fut = self._waiters.get(p.get("tid"))
+            if fut is not None and not fut.done():
+                fut.set_result(p)
+        elif msg.type == "mds_cap_revoke":
+            # nothing dirty (write-through); drop the cache and ack
+            self.revokes_seen += 1
+            self._cache.pop(p["ino"], None)
+            conn.send_message(Message(
+                type="mds_cap_release",
+                data=json.dumps({"ino": p["ino"]}).encode(),
+            ))
+
+    async def _connect_mds(self) -> None:
+        # a (re)connect means our caps may be gone (failover wipes the
+        # MDS cap table): cached data is no longer revoke-protected
+        self._cache.clear()
+        rep = await self.objecter.mon.command("fs map", timeout=10.0)
+        active = rep["fsmap"]["active"]
+        if active is None:
+            raise CephFSError("ENOENT", "no active MDS")
+        self._mds_conn = self.objecter.messenger.connect(
+            tuple(active["addr"]), Policy.lossless_client()
+        )
+        tid = next(self._tids)
+        fut = asyncio.get_event_loop().create_future()
+        self._waiters[tid] = fut
+        self._mds_conn.send_message(Message(
+            type="mds_session_open", tid=tid,
+            data=json.dumps({"tid": tid}).encode(),
+        ))
+        try:
+            await asyncio.wait_for(fut, 5.0)
+        finally:
+            self._waiters.pop(tid, None)
+        self._session_open = True
+
+    async def mount(self) -> None:
+        await self._connect_mds()
+
+    async def _request(self, payload: dict, timeout: float = 30.0) -> dict:
+        """Send to the active MDS; on bounce/timeout refetch the map,
+        re-open the session, resend the SAME tid (the MDS dedups)."""
+        tid = next(self._tids)
+        payload = {**payload, "tid": tid}
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            if not self._session_open or self._mds_conn is None:
+                try:
+                    await self._connect_mds()
+                except (CephFSError, asyncio.TimeoutError, OSError):
+                    await asyncio.sleep(0.3)
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise CephFSError(
+                            "ETIMEDOUT", "no reachable active MDS"
+                        ) from None
+                    continue
+            fut = asyncio.get_event_loop().create_future()
+            self._waiters[tid] = fut
+            # the MDS may legitimately block an open for a full revoke
+            # grace while it evicts an unresponsive cap holder — the
+            # per-attempt timeout must outlast that, or every eviction
+            # path churns the session
+            attempt = (
+                self.objecter.config.get("mds_beacon_grace") + 2.0
+            )
+            try:
+                self._mds_conn.send_message(Message(
+                    type="mds_request", tid=tid,
+                    data=json.dumps(payload).encode(),
+                ))
+                rep = await asyncio.wait_for(fut, attempt)
+            except (asyncio.TimeoutError, OSError, RuntimeError):
+                self._session_open = False  # failover: re-resolve
+                if asyncio.get_event_loop().time() > deadline:
+                    raise CephFSError(
+                        "ETIMEDOUT", f"mds request {payload['op']!r}"
+                    ) from None
+                continue
+            finally:
+                self._waiters.pop(tid, None)
+            if rep.get("not_active") or rep.get("no_session"):
+                self._session_open = False
+                await asyncio.sleep(0.2)
+                if asyncio.get_event_loop().time() > deadline:
+                    raise CephFSError(
+                        "ETIMEDOUT", f"mds request {payload['op']!r}"
+                    )
+                continue
+            if not rep.get("ok"):
+                raise CephFSError(
+                    rep.get("errno", "EIO"),
+                    rep.get("error", "mds error"),
+                )
+            return rep
+
+    # -- the filesystem surface ------------------------------------------------
+
+    async def mkfs(self) -> None:
+        await self._request({"op": "mkfs"})
+
+    async def mkdir(self, path: str) -> int:
+        return (await self._request({"op": "mkdir", "path": path}))[
+            "ino"
+        ]
+
+    async def listdir(self, path: str = "/") -> dict:
+        return (
+            await self._request({"op": "readdir", "path": path})
+        )["entries"]
+
+    async def stat(self, path: str) -> dict:
+        entry = (
+            await self._request({"op": "stat", "path": path})
+        )["entry"]
+        if entry["type"] == "file":
+            try:
+                entry["size"] = await self.striper.size(
+                    _file_soid(entry["ino"])
+                )
+            except ObjectNotFound:
+                entry["size"] = 0
+        return entry
+
+    async def open(self, path: str, mode: str = "r") -> dict:
+        """Returns {ino, cap}; data IO goes straight to RADOS."""
+        return await self._request(
+            {"op": "open", "path": path, "mode": mode}
+        )
+
+    async def write_file(self, path: str, data: bytes) -> int:
+        got = await self.open(path, mode="w")
+        ino = got["ino"]
+        await self.striper.write(_file_soid(ino), data)
+        self._cache[ino] = data
+        return ino
+
+    async def read_file(self, path: str) -> bytes:
+        got = await self.open(path, mode="r")
+        ino = got["ino"]
+        cached = self._cache.get(ino)
+        if cached is not None:
+            return cached  # cap-protected cache: revoke drops it
+        try:
+            data = await self.striper.read(_file_soid(ino))
+        except ObjectNotFound:
+            data = b""
+        self._cache[ino] = data
+        return data
+
+    async def unlink(self, path: str) -> None:
+        await self._request({"op": "unlink", "path": path})
+
+    async def rmdir(self, path: str) -> None:
+        await self._request({"op": "rmdir", "path": path})
+
+    async def rename(self, src: str, dst: str) -> None:
+        await self._request({"op": "rename", "src": src, "dst": dst})

@@ -1,0 +1,457 @@
+"""MDSService: the metadata daemon (the src/mds role, mini scale).
+
+The reference's MDS (src/mds, ~84k LoC) owns the filesystem namespace:
+clients open SESSIONS and send metadata requests; mutations are
+JOURNALED before they apply (MDLog/Journaler: the journal IS the
+authority across a crash); CAPABILITIES arbitrate which client may read
+or write an inode's data (Capability.h; conflicting access triggers
+revoke round-trips); standby daemons REPLAY the journal and take over
+when the mon's beacon grace expires (MDSMonitor + FSMap).
+
+This daemon reproduces those contracts at mini scale:
+
+  * boot: beacon to the mon ("mds beacon"); the committed FSMap names
+    one active + standbys, and the beacon reply tells us our role.
+  * namespace: dentries/inodes live in RADOS dir objects (the same
+    fs_dir/fs_ino object classes the client-side library uses — CDir
+    omap storage), accessed through the daemon's own Objecter: the MDS
+    is a RADOS client, exactly like the reference.
+  * journaling: every mutation appends an idempotent event (ino
+    pre-allocated into the event) to a Journaler object BEFORE applying
+    it; the applied position is committed/trimmed lazily. A takeover
+    REPLAYS the tail — events that already applied re-apply as no-ops
+    (link replace semantics, unlink tolerates ENOENT).
+  * capabilities: `open` grants "r" (shared) or "w" (exclusive) caps on
+    a file ino; a conflicting open revokes holders first
+    ("mds_cap_revoke" -> client flush/ack) and evicts sessions that
+    don't answer within the grace.
+  * sessions: per-client completed-tid table dedups resends across
+    failover (the client retries against the new active).
+
+Client data IO never touches the MDS: `open` returns the ino and the
+client reads/writes the striped file objects directly — the metadata /
+data path split that defines the architecture.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.cephfs.fs import ROOT_INO, _dir_obj, _file_soid
+from ceph_tpu.common.config import Config
+from ceph_tpu.journal.journal import Journaler
+from ceph_tpu.msg import Message
+from ceph_tpu.rados.client import ObjectNotFound, Objecter, RadosError
+
+JOURNAL_OBJ = "mds_journal"
+
+
+class MDSError(RadosError):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class _Session:
+    def __init__(self, name: str, conn):
+        self.name = name
+        self.conn = conn
+        #: tid -> reply payload (request dedup across resends/failover)
+        self.completed: dict[int, dict] = {}
+
+
+class MDSService:
+    def __init__(
+        self, name: str, monmap, pool_id: int,
+        config: Config | None = None,
+        keyring: dict[str, bytes] | None = None,
+    ):
+        self.name = name
+        self.config = config if config is not None else Config()
+        # the MDS is a RADOS client for its backing objects; its
+        # messenger doubles as the serving endpoint for client sessions
+        self.objecter = Objecter(
+            name, monmap, config=self.config, keyring=keyring
+        )
+        self.objecter.ext_dispatch = self._dispatch
+        self.ioctx = None  # bound in start()
+        self.pool_id = pool_id
+        self.journaler: Journaler | None = None
+        self.active = False
+        self.fsmap_epoch = 0
+        self._sessions: dict[str, _Session] = {}
+        #: ino -> {client_name: "r"|"w"} granted capabilities
+        self.caps: dict[int, dict[str, str]] = {}
+        self._cap_acks: dict[tuple[int, str], asyncio.Future] = {}
+        self._applied_pos = 0
+        self._stopped = False
+        self._tasks: list[asyncio.Task] = []
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.objecter.messenger.bind()
+        await self.objecter.start()
+        from ceph_tpu.rados.client import IoCtx
+
+        self.ioctx = IoCtx(self.objecter, self.pool_id)
+        self.journaler = Journaler(self.ioctx, JOURNAL_OBJ)
+        await self._beacon()  # learn the initial role
+        self._tasks.append(asyncio.create_task(self._beacon_loop()))
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.objecter.close()
+
+    @property
+    def addr(self):
+        return tuple(self.objecter.messenger.my_addr)
+
+    async def _beacon(self) -> None:
+        rep = await self.objecter.mon.command(
+            "mds beacon", {"name": self.name, "addr": list(self.addr)},
+            timeout=5.0,
+        )
+        fm = rep["fsmap"]
+        was_active = self.active
+        self.active = (
+            fm["active"] is not None
+            and fm["active"]["name"] == self.name
+        )
+        self.fsmap_epoch = fm["epoch"]
+        if self.active and not was_active:
+            await self._takeover()
+
+    async def _beacon_loop(self) -> None:
+        interval = self.config.get("mds_beacon_interval")
+        while not self._stopped:
+            await asyncio.sleep(interval)
+            try:
+                await self._beacon()
+            except Exception:
+                pass  # mon churn: next beacon retries
+
+    # -- journal (MDLog role) --------------------------------------------------
+
+    async def _takeover(self) -> None:
+        """Standby -> active: replay the journal tail over the RADOS
+        namespace state (MDSRank::boot_start REPLAY). Events are
+        idempotent, so re-applying ones the dead active already flushed
+        is harmless."""
+        rep = await self.journaler.read(from_pos=0)
+        pos = rep.get("commit", 0)
+        for ev in rep["entries"]:
+            pos = ev["pos"]
+            try:
+                await self._apply(ev["event"])
+            except Exception:
+                pass  # idempotent re-apply: conflicts mean "already done"
+        self._applied_pos = pos
+        if pos:
+            await self.journaler.commit_and_trim(pos)
+
+    async def _journal_and_apply(self, event: dict) -> None:
+        """Journal first, then apply (the write-ahead contract that
+        makes failover lossless): an MDS death between the two leaves
+        the event for the successor's replay."""
+        rec = await self.journaler.append(event)
+        await self._apply(event)
+        self._applied_pos = rec
+        # lazy trim: every 32 applied events
+        if self._applied_pos % 32 == 0:
+            try:
+                await self.journaler.commit_and_trim(self._applied_pos)
+            except Exception:
+                pass
+
+    async def _apply(self, ev: dict) -> None:
+        op = ev["op"]
+        if op == "mkfs":
+            await self.ioctx.write_full(_dir_obj(ROOT_INO), b"")
+            await self.ioctx.write_full(
+                "fs.inotable", str(max(ROOT_INO, ev["ino"])).encode()
+            )
+        elif op == "mkdir":
+            await self.ioctx.write_full(_dir_obj(ev["ino"]), b"")
+            await self.ioctx.exec(
+                _dir_obj(ev["parent"]), "fs_dir", "link",
+                {"name": ev["name"], "ino": ev["ino"],
+                 "type": "dir", "replace": True},
+            )
+        elif op == "create":
+            await self.ioctx.exec(
+                _dir_obj(ev["parent"]), "fs_dir", "link",
+                {"name": ev["name"], "ino": ev["ino"],
+                 "type": "file", "replace": True},
+            )
+        elif op == "unlink":
+            try:
+                await self.ioctx.exec(
+                    _dir_obj(ev["parent"]), "fs_dir", "unlink",
+                    {"name": ev["name"]},
+                )
+            except RadosError:
+                pass  # replay: already gone
+            if ev.get("ino"):
+                try:
+                    from ceph_tpu.rados.striper import RadosStriper
+
+                    await RadosStriper(self.ioctx).remove(
+                        _file_soid(ev["ino"])
+                    )
+                except (ObjectNotFound, RadosError):
+                    pass
+        elif op == "rmdir":
+            try:
+                await self.ioctx.exec(
+                    _dir_obj(ev["parent"]), "fs_dir", "unlink",
+                    {"name": ev["name"]},
+                )
+            except RadosError:
+                pass
+            try:
+                await self.ioctx.remove(_dir_obj(ev["ino"]))
+            except ObjectNotFound:
+                pass
+        elif op == "rename":
+            await self.ioctx.exec(
+                _dir_obj(ev["dparent"]), "fs_dir", "link",
+                {"name": ev["dname"], "ino": ev["ino"],
+                 "type": ev["type"], "replace": True},
+            )
+            try:
+                await self.ioctx.exec(
+                    _dir_obj(ev["sparent"]), "fs_dir", "unlink",
+                    {"name": ev["sname"]},
+                )
+            except RadosError:
+                pass
+        else:
+            raise MDSError("EINVAL", f"unknown journal op {op!r}")
+
+    # -- namespace helpers -----------------------------------------------------
+
+    async def _entries(self, ino: int) -> dict:
+        listing = await self.ioctx.exec(
+            _dir_obj(ino), "fs_dir", "list", {}
+        )
+        return listing["entries"]
+
+    async def _resolve_dir(self, parts: list[str]) -> int:
+        ino = ROOT_INO
+        for name in parts:
+            entry = (await self._entries(ino)).get(name)
+            if entry is None or entry["type"] != "dir":
+                raise MDSError("ENOENT", f"no directory {name!r}")
+            ino = entry["ino"]
+        return ino
+
+    @staticmethod
+    def _split(path: str) -> list[str]:
+        parts = [p for p in path.strip("/").split("/") if p]
+        if any(p in (".", "..") for p in parts):
+            raise MDSError("EINVAL", "'.'/'..' not supported")
+        return parts
+
+    async def _parent_and_name(self, path: str) -> tuple[int, str]:
+        parts = self._split(path)
+        if not parts:
+            raise MDSError("EINVAL", "path refers to the root")
+        return await self._resolve_dir(parts[:-1]), parts[-1]
+
+    async def _alloc_ino(self) -> int:
+        r = await self.ioctx.exec("fs.inotable", "fs_ino", "alloc", {})
+        return r["ino"]
+
+    # -- capabilities (Capability.h role) --------------------------------------
+
+    async def _grant_cap(
+        self, session: _Session, ino: int, mode: str
+    ) -> None:
+        """Grant after revoking conflicting holders: 'w' conflicts with
+        everything, 'r' conflicts with a held 'w'."""
+        holders = self.caps.setdefault(ino, {})
+        conflicting = [
+            (client, held) for client, held in holders.items()
+            if client != session.name
+            and (mode == "w" or held == "w")
+        ]
+        for client, _held in conflicting:
+            other = self._sessions.get(client)
+            if other is None or other.conn is None:
+                holders.pop(client, None)
+                continue
+            fut = asyncio.get_event_loop().create_future()
+            self._cap_acks[(ino, client)] = fut
+            other.conn.send_message(Message(
+                type="mds_cap_revoke",
+                data=json.dumps({"ino": ino}).encode(),
+            ))
+            try:
+                await asyncio.wait_for(
+                    fut, self.config.get("mds_beacon_grace")
+                )
+            except asyncio.TimeoutError:
+                # unresponsive client: evict its session (the
+                # reference's session autoclose + cap revocation)
+                self._evict(client)
+            finally:
+                self._cap_acks.pop((ino, client), None)
+            holders.pop(client, None)
+        holders[session.name] = mode
+
+    def _evict(self, client: str) -> None:
+        self._sessions.pop(client, None)
+        for holders in self.caps.values():
+            holders.pop(client, None)
+
+    # -- the wire --------------------------------------------------------------
+
+    async def _dispatch(self, conn, msg: Message) -> None:
+        p = json.loads(msg.data) if msg.data else {}
+        if msg.type == "mds_session_open":
+            existing = self._sessions.get(conn.peer_name)
+            session = _Session(conn.peer_name, conn)
+            if existing is not None:
+                # a session RE-open (reply lost, conn drop): the dedup
+                # table must survive or the client's resends re-execute
+                session.completed = existing.completed
+            self._sessions[conn.peer_name] = session
+            conn.send_message(Message(
+                type="mds_session_reply", tid=p.get("tid", 0),
+                data=json.dumps(
+                    {"tid": p.get("tid", 0), "ok": True}
+                ).encode(),
+            ))
+            return
+        if msg.type == "mds_cap_release":
+            fut = self._cap_acks.get((p["ino"], conn.peer_name))
+            if fut is not None and not fut.done():
+                fut.set_result(True)
+            else:
+                # voluntary release outside a revoke round-trip
+                self.caps.get(p["ino"], {}).pop(conn.peer_name, None)
+            return
+        if msg.type != "mds_request":
+            return
+        reply = await self._handle_request(conn, p)
+        conn.send_message(Message(
+            type="mds_reply", tid=p.get("tid", 0),
+            data=json.dumps(reply).encode(),
+        ))
+
+    async def _handle_request(self, conn, p: dict) -> dict:
+        tid = p.get("tid", 0)
+        if not self.active:
+            return {"tid": tid, "ok": False, "not_active": True}
+        session = self._sessions.get(conn.peer_name)
+        if session is None:
+            return {"tid": tid, "ok": False, "no_session": True}
+        if tid in session.completed:
+            return session.completed[tid]
+        try:
+            result = await self._execute(session, p)
+            reply = {"tid": tid, "ok": True, **result}
+        except MDSError as e:
+            reply = {"tid": tid, "ok": False, "errno": e.code,
+                     "error": str(e)}
+        except Exception as e:
+            return {"tid": tid, "ok": False, "error": str(e)}
+        session.completed[tid] = reply
+        if len(session.completed) > 512:
+            for old in sorted(session.completed)[:-256]:
+                del session.completed[old]
+        return reply
+
+    async def _execute(self, session: _Session, p: dict) -> dict:
+        op = p["op"]
+        if op == "mkfs":
+            ino = ROOT_INO
+            await self._journal_and_apply({"op": "mkfs", "ino": ino})
+            return {}
+        if op == "mkdir":
+            parent, name = await self._parent_and_name(p["path"])
+            if name in await self._entries(parent):
+                raise MDSError("EEXIST", f"{p['path']!r} exists")
+            ino = await self._alloc_ino()
+            await self._journal_and_apply({
+                "op": "mkdir", "parent": parent, "name": name,
+                "ino": ino,
+            })
+            return {"ino": ino}
+        if op == "readdir":
+            ino = await self._resolve_dir(self._split(p["path"]))
+            return {"entries": await self._entries(ino)}
+        if op == "stat":
+            parent, name = await self._parent_and_name(p["path"])
+            entry = (await self._entries(parent)).get(name)
+            if entry is None:
+                raise MDSError("ENOENT", f"no entry {p['path']!r}")
+            return {"entry": entry}
+        if op == "open":
+            parent, name = await self._parent_and_name(p["path"])
+            mode = p.get("mode", "r")
+            entry = (await self._entries(parent)).get(name)
+            if entry is None:
+                if mode != "w":
+                    raise MDSError("ENOENT", f"no file {p['path']!r}")
+                ino = await self._alloc_ino()
+                await self._journal_and_apply({
+                    "op": "create", "parent": parent, "name": name,
+                    "ino": ino,
+                })
+            elif entry["type"] != "file":
+                raise MDSError("EISDIR", f"{p['path']!r} is a dir")
+            else:
+                ino = entry["ino"]
+            await self._grant_cap(session, ino, mode)
+            return {"ino": ino, "cap": mode}
+        if op == "release":
+            self.caps.get(p["ino"], {}).pop(session.name, None)
+            return {}
+        if op == "unlink":
+            parent, name = await self._parent_and_name(p["path"])
+            entry = (await self._entries(parent)).get(name)
+            if entry is None or entry["type"] != "file":
+                raise MDSError("ENOENT", f"no file {p['path']!r}")
+            await self._journal_and_apply({
+                "op": "unlink", "parent": parent, "name": name,
+                "ino": entry["ino"],
+            })
+            self.caps.pop(entry["ino"], None)
+            return {}
+        if op == "rmdir":
+            parent, name = await self._parent_and_name(p["path"])
+            entry = (await self._entries(parent)).get(name)
+            if entry is None or entry["type"] != "dir":
+                raise MDSError("ENOENT", f"no directory {p['path']!r}")
+            if await self._entries(entry["ino"]):
+                raise MDSError(
+                    "ENOTEMPTY", f"directory {p['path']!r} not empty"
+                )
+            await self._journal_and_apply({
+                "op": "rmdir", "parent": parent, "name": name,
+                "ino": entry["ino"],
+            })
+            return {}
+        if op == "rename":
+            sparent, sname = await self._parent_and_name(p["src"])
+            dparent, dname = await self._parent_and_name(p["dst"])
+            entry = (await self._entries(sparent)).get(sname)
+            if entry is None:
+                raise MDSError("ENOENT", f"no entry {p['src']!r}")
+            await self._journal_and_apply({
+                "op": "rename", "sparent": sparent, "sname": sname,
+                "dparent": dparent, "dname": dname,
+                "ino": entry["ino"], "type": entry["type"],
+            })
+            return {}
+        raise MDSError("EINVAL", f"unknown mds op {op!r}")

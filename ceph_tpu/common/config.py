@@ -138,6 +138,10 @@ SCHEMA: dict[str, Option] = {
         _opt("auth_service_ticket_ttl", TYPE_FLOAT, LEVEL_ADVANCED,
              3600.0,
              "cephx service ticket lifetime; clients renew at half-life"),
+        _opt("mds_beacon_interval", TYPE_FLOAT, LEVEL_ADVANCED, 0.5,
+             "seconds between MDS beacons to the mon"),
+        _opt("mds_beacon_grace", TYPE_FLOAT, LEVEL_ADVANCED, 3.0,
+             "beacon silence before the mon fails the active MDS over"),
         _opt("osd_ec_batch_window", TYPE_FLOAT, LEVEL_ADVANCED, 0.002,
              "seconds the first EC op of a batch waits so concurrent "
              "objects share one planar device launch"),

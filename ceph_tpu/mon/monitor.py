@@ -139,6 +139,11 @@ class Monitor(Dispatcher):
         #: (service -> epoch -> secret, the RotatingSecrets role)
         self.auth_db: dict[str, bytes] = {}
         self.rotating: dict[str, dict[int, bytes]] = {}
+        #: FSMap-lite (the MDSMap role, src/mds/FSMap.h): one active
+        #: metadata daemon + standbys, paxos-replicated via the "fsmap"
+        #: service; beacons (leader-volatile) drive failover promotion
+        self.fsmap: dict = {"epoch": 0, "active": None, "standbys": []}
+        self._mds_beacons: dict[str, float] = {}
         self._replay_committed()
         #: peer_name -> (connection, from_epoch) map subscribers
         self._subs: dict[str, object] = {}
@@ -538,6 +543,12 @@ class Monitor(Dispatcher):
                 # sealed under the old key stay valid through rotation)
                 for old in sorted(window)[:-2]:
                     del window[old]
+        elif service == "fsmap":
+            # complete-state FSMap commits (MDSMonitor role): tiny map,
+            # deltas would buy nothing
+            new = json.loads(payload)
+            new["epoch"] = self.fsmap["epoch"] + 1
+            self.fsmap = new
 
     def _archive_actings(self, inc: Incremental) -> None:
         """Append changed acting sets to the per-PG interval archive.
@@ -1268,7 +1279,63 @@ class Monitor(Dispatcher):
             return {}
         if cmd == "health":
             return self._health()
+        if cmd == "mds beacon":
+            return await self._cmd_mds_beacon(args)
+        if cmd == "fs map":
+            return {"fsmap": self.fsmap}
         raise ValueError(f"unknown command {cmd!r}")
+
+    async def _cmd_mds_beacon(self, args: dict) -> dict:
+        """MDSMonitor::preprocess_beacon: record liveness, admit new
+        daemons (first becomes active, later ones stand by), and promote
+        a standby when the active's beacon has gone stale past
+        mds_beacon_grace — the failover decision rides the next standby
+        beacon, so no extra mon timer exists."""
+        name, addr = args["name"], list(args["addr"])
+        now = asyncio.get_event_loop().time()
+        self._mds_beacons[name] = now
+        fm = self.fsmap
+        # beacons are leader-volatile: after a mon restart or leader
+        # change the active has no record yet — stamp it as seen NOW so
+        # a standby's first beacon can't trigger a spurious failover
+        if fm["active"] is not None:
+            self._mds_beacons.setdefault(fm["active"]["name"], now)
+        known = {
+            m["name"] for m in ([fm["active"]] if fm["active"] else [])
+        } | {m["name"] for m in fm["standbys"]}
+        grace = self.config.get("mds_beacon_grace")
+        propose = None
+        if name not in known:
+            if fm["active"] is None:
+                propose = {
+                    "active": {"name": name, "addr": addr},
+                    "standbys": fm["standbys"],
+                }
+            else:
+                propose = {
+                    "active": fm["active"],
+                    "standbys": fm["standbys"]
+                    + [{"name": name, "addr": addr}],
+                }
+        elif (
+            fm["active"] is not None
+            and fm["active"]["name"] != name
+            and now - self._mds_beacons.get(
+                fm["active"]["name"], 0.0
+            ) > grace
+            and any(s["name"] == name for s in fm["standbys"])
+        ):
+            # the active went silent: promote THIS standby; the failed
+            # daemon is dropped and re-admits as standby if it revives
+            propose = {
+                "active": {"name": name, "addr": addr},
+                "standbys": [
+                    s for s in fm["standbys"] if s["name"] != name
+                ],
+            }
+        if propose is not None:
+            await self.propose("fsmap", json.dumps(propose).encode())
+        return {"fsmap": self.fsmap}
 
     def _health(self) -> dict:
         """Real health checks (the role of Monitor.cc's get_health /

@@ -115,7 +115,17 @@ class Objecter(Dispatcher):
     def osdmap(self):
         return self.mon.osdmap
 
+    #: optional handler for message types the Objecter doesn't own —
+    #: lets higher layers (the CephFS client's MDS session) share this
+    #: messenger/monclient instead of running their own transport
+    ext_dispatch = None
+
     async def ms_dispatch(self, conn, msg: Message) -> None:
+        if self.ext_dispatch is not None and msg.type.startswith(
+            "mds_"
+        ):
+            await self.ext_dispatch(conn, msg)
+            return
         if msg.type in ("osd_op_reply", "osd_admin_reply"):
             p = json.loads(msg.data)
             p["_raw"] = msg.raw  # bulk read payload (raw frame segment)
