@@ -80,6 +80,8 @@ class Image:
         self._parent_image: "Image | None" = None
         #: head object map bits (1 = object exists); loaded lazily
         self._omap_bits: bytearray | None = None
+        #: fast-diff clean bits (unchanged since the latest snap)
+        self._clean_bits: bytearray | None = None
         self._apply_snapc()
 
     def _apply_snapc(self) -> None:
@@ -165,7 +167,10 @@ class Image:
                 await self.ioctx.remove(self._map_name(snap["id"]))
             except ObjectNotFound:
                 pass
-        for oname in (self._map_name(), self._header_name(self.name)):
+        for oname in (
+            self._map_name(), self._map_name() + ".clean",
+            self._header_name(self.name),
+        ):
             try:
                 await self.ioctx.remove(oname)
             except ObjectNotFound:
@@ -237,6 +242,71 @@ class Image:
         """`rbd object-map rebuild`: recompute from a full stat sweep."""
         self._omap_bits = await self._stat_sweep()
         await self._persist_map()
+
+    async def _load_clean(self) -> bytearray:
+        """Bits for objects UNCHANGED since the latest snap_create (the
+        fast-diff EXISTS_CLEAN state); absent map = nothing known clean,
+        which only ever makes diff pessimistic, never wrong."""
+        if self._clean_bits is None:
+            saved, self.ioctx.snapc = self.ioctx.snapc, None
+            try:
+                self._clean_bits = bytearray(
+                    await self.ioctx.read(self._map_name() + ".clean")
+                )
+            except ObjectNotFound:
+                self._clean_bits = bytearray()
+            finally:
+                self.ioctx.snapc = saved
+        return self._clean_bits
+
+    async def _persist_clean(self) -> None:
+        saved, self.ioctx.snapc = self.ioctx.snapc, None
+        try:
+            await self.ioctx.write_full(
+                self._map_name() + ".clean", bytes(self._clean_bits)
+            )
+        finally:
+            self.ioctx.snapc = saved
+
+    async def diff(self, from_snap: str) -> list[int]:
+        """Object numbers that changed between `from_snap` and the head
+        (rbd diff --whole-object, the fast-diff contract): computed from
+        the frozen per-snap exists-bitmap, the head's, and the
+        clean-bitmap the head maintains since its latest snap — no data
+        object is read. Against an older snap the clean bits only say
+        "changed since the LATEST snap", so anything not provably clean
+        is reported — pessimistic, never missing a change."""
+        meta = self.snaps.get(from_snap)
+        if meta is None:
+            raise RadosError(f"no snap {from_snap!r}")
+        saved, self.ioctx.snapc = self.ioctx.snapc, None
+        try:
+            try:
+                snap_bits = bytearray(
+                    await self.ioctx.read(self._map_name(meta["id"]))
+                )
+            except ObjectNotFound:
+                snap_bits = bytearray()
+        finally:
+            self.ioctx.snapc = saved
+        head_bits = await self._load_map()
+        clean = await self._load_clean()
+        latest = max(
+            self.snaps.values(), key=lambda m: m["id"]
+        )["id"] == meta["id"]
+        objsize = 1 << self.order
+        n = (self.size + objsize - 1) // objsize
+        changed = []
+        for objectno in range(n):
+            was = self._map_get(snap_bits, objectno)
+            now = self._map_get(head_bits, objectno)
+            if was != now:
+                changed.append(objectno)
+            elif now and not (
+                latest and self._map_get(clean, objectno)
+            ):
+                changed.append(objectno)
+        return changed
 
     async def object_map_check(self) -> list[int]:
         """Objects whose map bit disagrees with reality (diagnostic;
@@ -452,8 +522,11 @@ class Image:
         snapid = await self.ioctx.selfmanaged_snap_create()
         self.snaps[snap_name] = {"id": snapid, "size": self.size}
         self._apply_snapc()
-        # freeze the object map alongside the data (per-snap maps)
+        # freeze the object map alongside the data (per-snap maps);
+        # everything existing right now is CLEAN relative to this snap
         bits = await self._load_map()
+        self._clean_bits = bytearray(bits)
+        await self._persist_clean()
         saved, self.ioctx.snapc = self.ioctx.snapc, None
         try:
             await self.ioctx.write_full(
@@ -472,6 +545,11 @@ class Image:
         if meta is None:
             raise RadosError(f"no snap {snap_name!r}")
         self._apply_snapc()
+        # clean bits were computed relative to the latest snap — if that
+        # reference point goes away they would falsely exonerate changed
+        # objects in diff(); void them (pessimistic, never wrong)
+        self._clean_bits = bytearray()
+        await self._persist_clean()
         await self._save_header()
         try:
             await self.ioctx.remove(self._map_name(meta["id"]))
@@ -509,6 +587,8 @@ class Image:
                     pass
                 self._set_bit(bits, objectno, False)
         await self._persist_map()  # one batched map write for the sweep
+        self._clean_bits = bytearray()  # rollback voids fast-diff state
+        await self._persist_clean()
         self.size = snap_size
         await self._save_header()
 
@@ -519,7 +599,7 @@ class Image:
         self._check_span(off, len(data))
         objsize = 1 << self.order
         bits = await self._load_map()
-        dirty = False
+        dirty = clean_dirty = False
         for objectno, obj_off, obj_len, buf_off in self._extents(
             off, len(data)
         ):
@@ -554,8 +634,14 @@ class Image:
             if not exists:
                 self._set_bit(bits, objectno, True)
                 dirty = True
+            clean = await self._load_clean()
+            if self._map_get(clean, objectno):
+                self._set_bit(clean, objectno, False)
+                clean_dirty = True
         if dirty:
             await self._persist_map()  # one map write per span
+        if clean_dirty:
+            await self._persist_clean()
 
     async def resize(self, new_size: int) -> None:
         objsize = 1 << self.order

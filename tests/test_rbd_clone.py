@@ -167,3 +167,106 @@ def test_object_map_exact_across_operations():
             await cluster.stop()
 
     run(main())
+
+
+def test_object_map_consistent_under_thrash():
+    """The object map survives a failure/recovery episode intact: writes
+    and snapshots land while an OSD dies and revives, and at the end
+    `object-map check` finds zero disagreements on every image (the
+    thrash leg of the VERDICT #7 done criterion)."""
+    import numpy as np
+
+    from tests.test_cluster_live import wait_until
+
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        try:
+            rados = Rados("client.omthrash", cluster.monmap,
+                          config=cluster.cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+            ioctx = rados.io_ctx(REP_POOL)
+            rng = np.random.default_rng(79)
+            img = await Image.create(
+                ioctx, "tvol", size=128 * 1024, order=12
+            )
+            parent_written = False
+
+            victim = 1
+            db = cluster.osds[victim].store.db
+            killed = False
+            for step in range(24):
+                off = int(rng.integers(0, 120 * 1024))
+                n = int(rng.integers(1, 6000))
+                await asyncio.wait_for(
+                    img.write(off, bytes([step % 251]) * n), 60
+                )
+                if step == 6:
+                    await img.snap_create(f"s{step}")
+                    await img.snap_protect(f"s{step}")
+                    parent_written = True
+                if step == 8:
+                    await cluster.kill_osd(victim)
+                    killed = True
+                if step == 16 and killed:
+                    await cluster.start_osd(victim, db=db)
+            if parent_written:
+                child = await Image.clone(
+                    ioctx, "tvol", "s6", ioctx, "tchild"
+                )
+                await child.write(3000, b"childbits")
+                assert (await child.object_map_check()) == []
+            await wait_until(
+                lambda: all(
+                    not o.osdmap.is_down(victim)
+                    for o in cluster.osds.values()
+                ),
+                timeout=60,
+            )
+            assert (await img.object_map_check()) == []
+            img2 = await Image.open(ioctx, "tvol")
+            assert (await img2.object_map_check()) == []
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_fast_diff_from_object_maps():
+    """rbd fast-diff: changed objects between a snap and the head come
+    from the bitmaps (exists XOR + clean bits), with pessimism — never
+    a missed change — against older snaps."""
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        try:
+            rados = Rados("client.fd", cluster.monmap,
+                          config=cluster.cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+            ioctx = rados.io_ctx(REP_POOL)
+            img = await Image.create(
+                ioctx, "dv", size=64 * 1024, order=12
+            )
+            await img.write(0, b"a" * 4096)        # obj 0
+            await img.write(8192, b"b" * 4096)     # obj 2
+            await img.snap_create("s1")
+            # no changes yet: empty diff
+            assert await img.diff("s1") == []
+            await img.write(8192, b"B" * 10)       # rewrite obj 2
+            await img.write(16384, b"c" * 100)     # create obj 4
+            changed = await img.diff("s1")
+            assert changed == [2, 4]
+            # a second snap: diff against IT is empty, against the
+            # older one stays pessimistically superset-correct
+            await img.snap_create("s2")
+            assert await img.diff("s2") == []
+            older = await img.diff("s1")
+            assert {2, 4} <= set(older)
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())
