@@ -3496,6 +3496,19 @@ class OSDService(Dispatcher):
                         except StoreError:
                             pass
                 result = {str(k): v for k, v in stats.items()}
+            elif cmd == "pg ls":
+                # PGLS (the rados `ls` primitive): head objects of this
+                # pool's PGs we lead (clones/snapdirs stay internal)
+                objects = []
+                for (pid, ps), pg in self.pgs.items():
+                    if pid != p["pool"]:
+                        continue
+                    if self.acting_of(pid, ps)[1] != self.id:
+                        continue
+                    for name, e in pg.latest_objects().items():
+                        if e["kind"] != "delete" and "\x1f" not in name:
+                            objects.append(name)
+                result = {"objects": sorted(objects)}
             elif cmd == "log dump":
                 result = {"entries": self.logs.dump_recent()}
             elif cmd == "dump_ops_in_flight":
