@@ -43,6 +43,10 @@ class CephFSClient:
         self._session_open = False
         #: ino -> cached file bytes, valid while we hold a cap
         self._cache: dict[int, bytes] = {}
+        #: ino -> revoke count: IO that was in flight when a revoke
+        #: landed must not repopulate the cache afterwards (the revoke
+        #: already acked "nothing cached" to the MDS)
+        self._revoked: dict[int, int] = {}
         self.revokes_seen = 0
 
     # -- session / transport ---------------------------------------------------
@@ -57,6 +61,9 @@ class CephFSClient:
             # nothing dirty (write-through); drop the cache and ack
             self.revokes_seen += 1
             self._cache.pop(p["ino"], None)
+            self._revoked[p["ino"]] = (
+                self._revoked.get(p["ino"], 0) + 1
+            )
             conn.send_message(Message(
                 type="mds_cap_release",
                 data=json.dumps({"ino": p["ino"]}).encode(),
@@ -182,8 +189,10 @@ class CephFSClient:
     async def write_file(self, path: str, data: bytes) -> int:
         got = await self.open(path, mode="w")
         ino = got["ino"]
+        epoch = self._revoked.get(ino, 0)
         await self.striper.write(_file_soid(ino), data)
-        self._cache[ino] = data
+        if self._revoked.get(ino, 0) == epoch:
+            self._cache[ino] = data  # no revoke raced the write
         return ino
 
     async def read_file(self, path: str) -> bytes:
@@ -192,11 +201,13 @@ class CephFSClient:
         cached = self._cache.get(ino)
         if cached is not None:
             return cached  # cap-protected cache: revoke drops it
+        epoch = self._revoked.get(ino, 0)
         try:
             data = await self.striper.read(_file_soid(ino))
         except ObjectNotFound:
             data = b""
-        self._cache[ino] = data
+        if self._revoked.get(ino, 0) == epoch:
+            self._cache[ino] = data  # no revoke raced the read
         return data
 
     async def unlink(self, path: str) -> None:

@@ -84,6 +84,15 @@ class MDSService:
         #: ino -> {client_name: "r"|"w"} granted capabilities
         self.caps: dict[int, dict[str, str]] = {}
         self._cap_acks: dict[tuple[int, str], asyncio.Future] = {}
+        #: per-ino grant serialization: concurrent conflicting opens
+        #: must run their revoke round-trips one at a time or they
+        #: clobber each other's ack futures and both "win" exclusivity
+        self._cap_locks: dict[int, asyncio.Lock] = {}
+        #: (client, tid) -> minimal ack, rebuilt from journal replay at
+        #: takeover: a resend of an op the DEAD active completed must
+        #: ack, not re-execute (the completed-tid contract survives
+        #: failover because mutations journal their reqid)
+        self._replayed: dict[tuple[str, int], dict] = {}
         self._applied_pos = 0
         self._stopped = False
         self._tasks: list[asyncio.Task] = []
@@ -150,10 +159,17 @@ class MDSService:
         pos = rep.get("commit", 0)
         for ev in rep["entries"]:
             pos = ev["pos"]
+            event = ev["event"]
             try:
-                await self._apply(ev["event"])
+                await self._apply(event)
             except Exception:
                 pass  # idempotent re-apply: conflicts mean "already done"
+            if event.get("client") is not None:
+                ack = {"tid": event["tid"], "ok": True,
+                       "replayed": True}
+                if "ino" in event:
+                    ack["ino"] = event["ino"]
+                self._replayed[(event["client"], event["tid"])] = ack
         self._applied_pos = pos
         if pos:
             await self.journaler.commit_and_trim(pos)
@@ -176,8 +192,15 @@ class MDSService:
         op = ev["op"]
         if op == "mkfs":
             await self.ioctx.write_full(_dir_obj(ROOT_INO), b"")
+            # NEVER rewind the inotable: a replayed mkfs must not hand
+            # out inos that live allocations already took
+            try:
+                cur = int((await self.ioctx.read("fs.inotable")).decode())
+            except Exception:
+                cur = 0
             await self.ioctx.write_full(
-                "fs.inotable", str(max(ROOT_INO, ev["ino"])).encode()
+                "fs.inotable",
+                str(max(ROOT_INO, ev["ino"], cur)).encode(),
             )
         elif op == "mkdir":
             await self.ioctx.write_full(_dir_obj(ev["ino"]), b"")
@@ -277,7 +300,15 @@ class MDSService:
         self, session: _Session, ino: int, mode: str
     ) -> None:
         """Grant after revoking conflicting holders: 'w' conflicts with
-        everything, 'r' conflicts with a held 'w'."""
+        everything, 'r' conflicts with a held 'w'. Grants on one ino
+        serialize: concurrent conflicting opens would otherwise clobber
+        each other's ack futures and both claim exclusivity."""
+        async with self._cap_locks.setdefault(ino, asyncio.Lock()):
+            await self._grant_cap_locked(session, ino, mode)
+
+    async def _grant_cap_locked(
+        self, session: _Session, ino: int, mode: str
+    ) -> None:
         holders = self.caps.setdefault(ino, {})
         conflicting = [
             (client, held) for client, held in holders.items()
@@ -357,6 +388,9 @@ class MDSService:
             return {"tid": tid, "ok": False, "no_session": True}
         if tid in session.completed:
             return session.completed[tid]
+        replayed = self._replayed.get((conn.peer_name, tid))
+        if replayed is not None:
+            return replayed  # the dead active completed this op
         try:
             result = await self._execute(session, p)
             reply = {"tid": tid, "ok": True, **result}
@@ -371,11 +405,18 @@ class MDSService:
                 del session.completed[old]
         return reply
 
+    @staticmethod
+    def _reqid(session: _Session, p: dict) -> dict:
+        return {"client": session.name, "tid": p.get("tid", 0)}
+
     async def _execute(self, session: _Session, p: dict) -> dict:
         op = p["op"]
+        rid = self._reqid(session, p)
         if op == "mkfs":
             ino = ROOT_INO
-            await self._journal_and_apply({"op": "mkfs", "ino": ino})
+            await self._journal_and_apply(
+                {"op": "mkfs", "ino": ino, **rid}
+            )
             return {}
         if op == "mkdir":
             parent, name = await self._parent_and_name(p["path"])
@@ -384,7 +425,7 @@ class MDSService:
             ino = await self._alloc_ino()
             await self._journal_and_apply({
                 "op": "mkdir", "parent": parent, "name": name,
-                "ino": ino,
+                "ino": ino, **rid,
             })
             return {"ino": ino}
         if op == "readdir":
@@ -406,7 +447,7 @@ class MDSService:
                 ino = await self._alloc_ino()
                 await self._journal_and_apply({
                     "op": "create", "parent": parent, "name": name,
-                    "ino": ino,
+                    "ino": ino, **rid,
                 })
             elif entry["type"] != "file":
                 raise MDSError("EISDIR", f"{p['path']!r} is a dir")
@@ -424,7 +465,7 @@ class MDSService:
                 raise MDSError("ENOENT", f"no file {p['path']!r}")
             await self._journal_and_apply({
                 "op": "unlink", "parent": parent, "name": name,
-                "ino": entry["ino"],
+                "ino": entry["ino"], **rid,
             })
             self.caps.pop(entry["ino"], None)
             return {}
@@ -439,7 +480,7 @@ class MDSService:
                 )
             await self._journal_and_apply({
                 "op": "rmdir", "parent": parent, "name": name,
-                "ino": entry["ino"],
+                "ino": entry["ino"], **rid,
             })
             return {}
         if op == "rename":
@@ -451,7 +492,7 @@ class MDSService:
             await self._journal_and_apply({
                 "op": "rename", "sparent": sparent, "sname": sname,
                 "dparent": dparent, "dname": dname,
-                "ino": entry["ino"], "type": entry["type"],
+                "ino": entry["ino"], "type": entry["type"], **rid,
             })
             return {}
         raise MDSError("EINVAL", f"unknown mds op {op!r}")
