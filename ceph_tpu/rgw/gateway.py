@@ -67,6 +67,91 @@ def _index_stat(ctx, inp):
     return {"count": len(ctx.omap_get_vals())}
 
 
+def _index_stack_push(ctx, inp):
+    """Atomically push a version (or delete marker) onto a key's
+    version stack and make it current — the cls-side mutation that
+    keeps concurrent gateways from losing versions to read-modify-write
+    races (cls_rgw's bucket-index transaction role). `version_id`
+    "null" REPLACES an existing null entry (the S3 suspended-bucket
+    rule) and reports the displaced object for reclamation."""
+    key = inp["key"].encode()
+    raw = ctx.omap_get_val(key)
+    meta = json.loads(raw) if raw is not None else None
+    ver = dict(inp["version"])
+    versions = list(meta.get("versions", [])) if meta else []
+    if meta is not None and not versions and not inp.get(
+        "require_absent", False
+    ):
+        # adopt a pre-versioning head as version 'null'
+        versions = [{
+            "version_id": "null",
+            "obj": inp["head_obj"],
+            "size": meta.get("size", 0),
+            "etag": meta.get("etag", ""),
+            "delete_marker": False,
+        }]
+    displaced = None
+    if ver["version_id"] == "null":
+        for old in versions:
+            if old["version_id"] == "null":
+                displaced = old.get("obj")
+        versions = [
+            v for v in versions if v["version_id"] != "null"
+        ]
+    versions.append(ver)
+    ctx.omap_set({key: json.dumps({
+        "size": ver["size"], "etag": ver["etag"],
+        "version_id": ver["version_id"],
+        "delete_marker": ver["delete_marker"],
+        "versions": versions,
+    }).encode()})
+    return {"displaced": displaced}
+
+
+def _index_stack_pop(ctx, inp):
+    """Atomically remove ONE version from a key's stack; the newest
+    remaining version becomes current, and popping the last one drops
+    the key. Returns the removed entry so the gateway can reclaim its
+    data object."""
+    key = inp["key"].encode()
+    raw = ctx.omap_get_val(key)
+    if raw is None:
+        raise ClsError("ENOENT", f"no index entry {inp['key']!r}")
+    meta = json.loads(raw)
+    versions = list(meta.get("versions", []))
+    if not versions and inp["version_id"] == "null":
+        # never-versioned key addressed by its advertised null id
+        ctx.omap_rm([key])
+        return {"removed": {
+            "version_id": "null", "obj": inp.get("head_obj"),
+            "delete_marker": False,
+        }}
+    doomed = next(
+        (v for v in versions
+         if v["version_id"] == inp["version_id"]),
+        None,
+    )
+    if doomed is None:
+        raise ClsError(
+            "ENOENT", f"no version {inp['version_id']!r}"
+        )
+    versions = [
+        v for v in versions
+        if v["version_id"] != inp["version_id"]
+    ]
+    if not versions:
+        ctx.omap_rm([key])
+        return {"removed": doomed}
+    cur = versions[-1]
+    ctx.omap_set({key: json.dumps({
+        "size": cur["size"], "etag": cur["etag"],
+        "version_id": cur["version_id"],
+        "delete_marker": cur["delete_marker"],
+        "versions": versions,
+    }).encode()})
+    return {"removed": doomed}
+
+
 def register_rgw_classes(osd_service) -> None:
     """Install the rgw_index class on a daemon (its __cls_init analogue)."""
     h = osd_service.cls
@@ -74,6 +159,8 @@ def register_rgw_classes(osd_service) -> None:
     h.register("rgw_index", "remove", RD | WR, _index_remove)
     h.register("rgw_index", "list", RD, _index_list)
     h.register("rgw_index", "stat", RD, _index_stat)
+    h.register("rgw_index", "stack_push", RD | WR, _index_stack_push)
+    h.register("rgw_index", "stack_pop", RD | WR, _index_stack_pop)
 
 
 # -- the gateway --------------------------------------------------------------
@@ -115,22 +202,166 @@ class ObjectGateway:
         except ObjectNotFound:
             return False
 
-    async def put_object(self, bucket: str, key: str, data: bytes) -> str:
-        """Store data, then index it atomically server-side; returns the
-        ETag."""
+    # -- versioning (RGWBucketInfo flags + rgw_obj_key instances:
+    # -- version objects are separate RADOS objects, the index entry's
+    # -- meta carries the version stack with the newest as current) -----
+
+    _VERSIONING_XATTR = "rgw.versioning"
+
+    def _ver_obj(self, bucket: str, key: str, vid: str) -> str:
+        return f"{bucket}/{key}.__v_{vid}"
+
+    async def set_versioning(self, bucket: str, enabled: bool) -> None:
         if not await self.bucket_exists(bucket):
             raise GatewayError(f"no bucket {bucket!r}")
+        await self.index_ioctx.setxattr(
+            self._index_obj(bucket), self._VERSIONING_XATTR,
+            b"Enabled" if enabled else b"Suspended",
+        )
+
+    async def get_versioning(self, bucket: str) -> bool:
+        if not await self.bucket_exists(bucket):
+            raise GatewayError(f"no bucket {bucket!r}")
+        try:
+            raw = await self.index_ioctx.getxattr(
+                self._index_obj(bucket), self._VERSIONING_XATTR
+            )
+        except (ObjectNotFound, RadosError):
+            return False
+        return raw == b"Enabled"
+
+    async def _has_stack(self, bucket: str, key: str) -> bool:
+        try:
+            meta = await self.head_object(bucket, key)
+        except ObjectNotFound:
+            return False
+        return bool(meta.get("versions"))
+
+    async def put_object(self, bucket: str, key: str, data: bytes) -> str:
+        etag, _vid = await self.put_object2(bucket, key, data)
+        return etag
+
+    async def put_object2(
+        self, bucket: str, key: str, data: bytes
+    ) -> tuple[str, str | None]:
+        """Store data, then index it atomically server-side; returns
+        (etag, version_id). Versioning-enabled buckets stack a NEW
+        version; a SUSPENDED bucket with an existing stack writes the
+        'null' version, preserving every real version (the S3
+        suspension rule). The stack mutation is one cls call at the
+        index primary, so concurrent gateways never lose versions."""
+        if not await self.bucket_exists(bucket):
+            raise GatewayError(f"no bucket {bucket!r}")
+        etag = f"{ceph_crc32c(0xFFFFFFFF, data):08x}"
+        enabled = await self.get_versioning(bucket)
+        if enabled or await self._has_stack(bucket, key):
+            if await self._multipart_meta(bucket, key):
+                raise GatewayError(
+                    "versioned overwrite of a multipart object is "
+                    "not supported"
+                )
+            import uuid
+
+            vid = uuid.uuid4().hex[:16] if enabled else "null"
+            obj = self._ver_obj(bucket, key, vid)
+            await self.ioctx.write_full(obj, data)
+            rep = await self.index_ioctx.exec(
+                self._index_obj(bucket), "rgw_index", "stack_push",
+                {"key": key, "head_obj": self._data_obj(bucket, key),
+                 "version": {
+                     "version_id": vid, "obj": obj,
+                     "size": len(data), "etag": etag,
+                     "delete_marker": False,
+                 }},
+            )
+            displaced = rep.get("displaced")
+            if displaced and displaced != obj:
+                try:
+                    await self.ioctx.remove(displaced)
+                except ObjectNotFound:
+                    pass
+            return etag, vid
         if await self._multipart_meta(bucket, key):
             # overwriting an assembled multipart object must reclaim its
             # parts, or every re-upload leaks them forever
             await self._reclaim_parts(bucket, key)
-        etag = f"{ceph_crc32c(0xFFFFFFFF, data):08x}"
         await self.ioctx.write_full(self._data_obj(bucket, key), data)
         await self.index_ioctx.exec(
             self._index_obj(bucket), "rgw_index", "insert",
             {"key": key, "meta": {"size": len(data), "etag": etag}},
         )
-        return etag
+        return etag, None
+
+    async def get_object_version(
+        self, bucket: str, key: str, version_id: str
+    ) -> bytes:
+        meta = await self.head_object(bucket, key)
+        versions = meta.get("versions", [])
+        if not versions and version_id == "null":
+            # never-versioned key addressed by its advertised null id
+            return await self.ioctx.read(self._data_obj(bucket, key))
+        for v in versions:
+            if v["version_id"] == version_id:
+                if v["delete_marker"]:
+                    raise GatewayError(
+                        f"{key!r}@{version_id} is a delete marker"
+                    )
+                return await self.ioctx.read(v["obj"])
+        raise ObjectNotFound(f"{bucket}/{key}@{version_id}")
+
+    async def list_versions(
+        self, bucket: str, prefix: str = "", marker: str = "",
+        max_keys: int = 1000,
+    ) -> dict:
+        """One PAGE of {key: [versions, newest last]}, riding the
+        index's ranged pagination like list_objects does."""
+        listing = await self.list_objects(
+            bucket, prefix=prefix, marker=marker,
+            max_entries=max_keys,
+        )
+        out = {}
+        for key, meta in listing["entries"].items():
+            if meta.get("versions"):
+                out[key] = meta["versions"]
+            else:
+                out[key] = [{
+                    "version_id": "null",
+                    "obj": self._data_obj(bucket, key),
+                    "size": meta.get("size", 0),
+                    "etag": meta.get("etag", ""),
+                    "delete_marker": False,
+                }]
+        return {
+            "versions": out,
+            "truncated": listing.get("truncated", False),
+            "next_marker": listing.get("next_marker", ""),
+        }
+
+    async def delete_object_version(
+        self, bucket: str, key: str, version_id: str
+    ) -> None:
+        """Permanent removal of ONE version (the S3 versioned delete):
+        a single atomic cls stack_pop at the index primary promotes the
+        newest remaining version; the gateway reclaims the popped data
+        object afterwards."""
+        try:
+            rep = await self.index_ioctx.exec(
+                self._index_obj(bucket), "rgw_index", "stack_pop",
+                {"key": key, "version_id": version_id,
+                 "head_obj": self._data_obj(bucket, key)},
+            )
+        except RadosError as e:
+            if "ENOENT" in str(e) or isinstance(e, ObjectNotFound):
+                raise ObjectNotFound(
+                    f"{bucket}/{key}@{version_id}"
+                ) from e
+            raise
+        removed = rep["removed"]
+        if not removed.get("delete_marker") and removed.get("obj"):
+            try:
+                await self.ioctx.remove(removed["obj"])
+            except ObjectNotFound:
+                pass
 
     async def _multipart_meta(self, bucket: str, key: str):
         """The index entry IS the authority on whether a key is multipart
@@ -143,7 +374,15 @@ class ObjectGateway:
         return meta if meta.get("multipart") else None
 
     async def get_object(self, bucket: str, key: str) -> bytes:
-        if await self._multipart_meta(bucket, key):
+        meta = await self.head_object(bucket, key)
+        if meta.get("versions"):
+            cur = meta["versions"][-1]
+            if cur["delete_marker"]:
+                raise ObjectNotFound(
+                    f"{bucket}/{key} (current is a delete marker)"
+                )
+            return await self.ioctx.read(cur["obj"])
+        if meta.get("multipart"):
             m = json.loads(
                 await self.ioctx.read(self._data_obj(bucket, key))
             )["__manifest__"]
@@ -181,7 +420,34 @@ class ObjectGateway:
             except ObjectNotFound:
                 pass
 
-    async def delete_object(self, bucket: str, key: str) -> None:
+    async def delete_object(
+        self, bucket: str, key: str
+    ) -> str | None:
+        """Plain delete — except on a versioning-enabled bucket (or a
+        suspended one whose key has a stack), where it stacks a DELETE
+        MARKER as the new current version via one atomic cls call (data
+        stays; returns the marker's version id). Per S3, a versioned
+        delete of a NONEXISTENT key still succeeds with a marker."""
+        enabled = await self.get_versioning(bucket)
+        if enabled or await self._has_stack(bucket, key):
+            import uuid
+
+            vid = uuid.uuid4().hex[:16] if enabled else "null"
+            rep = await self.index_ioctx.exec(
+                self._index_obj(bucket), "rgw_index", "stack_push",
+                {"key": key, "head_obj": self._data_obj(bucket, key),
+                 "version": {
+                     "version_id": vid, "obj": None, "size": 0,
+                     "etag": "", "delete_marker": True,
+                 }},
+            )
+            displaced = rep.get("displaced")
+            if displaced:
+                try:
+                    await self.ioctx.remove(displaced)
+                except ObjectNotFound:
+                    pass
+            return vid
         multipart = await self._multipart_meta(bucket, key)
         await self.index_ioctx.exec(
             self._index_obj(bucket), "rgw_index", "remove", {"key": key}
@@ -189,6 +455,7 @@ class ObjectGateway:
         if multipart:
             await self._reclaim_parts(bucket, key)
         await self.ioctx.remove(self._data_obj(bucket, key))
+        return None
 
     async def list_objects(
         self,
@@ -223,6 +490,11 @@ class ObjectGateway:
     async def initiate_multipart(self, bucket: str, key: str) -> str:
         if not await self.bucket_exists(bucket):
             raise GatewayError(f"no bucket {bucket!r}")
+        if await self.get_versioning(bucket):
+            raise GatewayError(
+                "multipart upload to a versioning-enabled bucket is "
+                "not supported (stated reduction)"
+            )
         import uuid
 
         return uuid.uuid4().hex[:16]

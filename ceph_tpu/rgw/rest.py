@@ -210,6 +210,11 @@ class S3Frontend:
         try:
             self._authenticate(method, url, query, headers, body)
             return await self._route(method, path, query, headers, body)
+        except ElementTree.ParseError as e:
+            return (
+                400, {"Content-Type": "application/xml"},
+                self._error_xml("MalformedXML", str(e)),
+            )
         except S3Error as e:
             return (
                 e.status,
@@ -288,6 +293,71 @@ class S3Frontend:
             raise S3Error(400, "InvalidRequest", "bucket required")
         ok_xml = {"Content-Type": "application/xml"}
         if not key:
+            if method == "PUT" and "versioning" in query:
+                root = ElementTree.fromstring(body.decode())
+                ns = ""
+                if root.tag.startswith("{"):
+                    ns = root.tag[: root.tag.index("}") + 1]
+                status = root.find(f"{ns}Status")
+                await self.gw.set_versioning(
+                    bucket,
+                    status is not None and status.text == "Enabled",
+                )
+                return 200, {}, b""
+            if method == "GET" and "versioning" in query:
+                enabled = await self.gw.get_versioning(bucket)
+                xml = (
+                    "<?xml version=\"1.0\" encoding=\"UTF-8\"?>"
+                    "<VersioningConfiguration>"
+                    f"<Status>{'Enabled' if enabled else 'Suspended'}"
+                    "</Status></VersioningConfiguration>"
+                )
+                return 200, ok_xml, xml.encode()
+            if method == "GET" and "versions" in query:
+                page = await self.gw.list_versions(
+                    bucket, prefix=query.get("prefix", ""),
+                    marker=query.get("key-marker", ""),
+                    max_keys=int(query.get("max-keys", "1000")),
+                )
+                xml = [
+                    "<?xml version=\"1.0\" encoding=\"UTF-8\"?>",
+                    "<ListVersionsResult>",
+                    f"<Name>{escape(bucket)}</Name>",
+                    f"<IsTruncated>{str(bool(page['truncated'])).lower()}"
+                    "</IsTruncated>",
+                ]
+                if page["truncated"]:
+                    xml.append(
+                        f"<NextKeyMarker>{escape(page['next_marker'])}"
+                        "</NextKeyMarker>"
+                    )
+                for k, versions in sorted(page["versions"].items()):
+                    for v in reversed(versions):  # newest first
+                        latest = str(
+                            v is versions[-1]
+                        ).lower()
+                        if v["delete_marker"]:
+                            xml.append(
+                                "<DeleteMarker>"
+                                f"<Key>{escape(k)}</Key>"
+                                f"<VersionId>{v['version_id']}"
+                                "</VersionId>"
+                                f"<IsLatest>{latest}</IsLatest>"
+                                "</DeleteMarker>"
+                            )
+                        else:
+                            xml.append(
+                                "<Version>"
+                                f"<Key>{escape(k)}</Key>"
+                                f"<VersionId>{v['version_id']}"
+                                "</VersionId>"
+                                f"<IsLatest>{latest}</IsLatest>"
+                                f"<Size>{v['size']}</Size>"
+                                f"<ETag>&quot;{v['etag']}&quot;</ETag>"
+                                "</Version>"
+                            )
+                xml.append("</ListVersionsResult>")
+                return 200, ok_xml, "".join(xml).encode()
             if method == "PUT":
                 await self.gw.create_bucket(bucket)
                 return 200, {}, b""
@@ -324,6 +394,8 @@ class S3Frontend:
                     "</IsTruncated>",
                 ]
                 for k, meta in sorted(entries["entries"].items()):
+                    if meta.get("delete_marker"):
+                        continue  # current is a marker: hidden from ls
                     xml.append(
                         "<Contents>"
                         f"<Key>{escape(k)}</Key>"
@@ -386,9 +458,22 @@ class S3Frontend:
             return 204, {}, b""
 
         if method == "PUT":
-            etag = await self.gw.put_object(bucket, key, body)
-            return 200, {"ETag": f'"{etag}"'}, b""
+            etag, vid = await self.gw.put_object2(bucket, key, body)
+            hdrs = {"ETag": f'"{etag}"'}
+            if vid is not None:
+                hdrs["x-amz-version-id"] = vid
+            return 200, hdrs, b""
         if method == "GET":
+            if "versionId" in query:
+                data = await self.gw.get_object_version(
+                    bucket, key, query["versionId"]
+                )
+                return (
+                    200,
+                    {"Content-Type": "application/octet-stream",
+                     "x-amz-version-id": query["versionId"]},
+                    data,
+                )
             data = await self.gw.get_object(bucket, key)
             meta = await self.gw.head_object(bucket, key)
             return (
@@ -399,6 +484,8 @@ class S3Frontend:
             )
         if method == "HEAD":
             meta = await self.gw.head_object(bucket, key)
+            if meta.get("delete_marker"):
+                raise S3Error(404, "NoSuchKey", key)
             return (
                 200,
                 {"Content-Length": str(meta.get("size", 0)),
@@ -406,6 +493,15 @@ class S3Frontend:
                 b"",
             )
         if method == "DELETE":
-            await self.gw.delete_object(bucket, key)
-            return 204, {}, b""
+            if "versionId" in query:
+                await self.gw.delete_object_version(
+                    bucket, key, query["versionId"]
+                )
+                return 204, {}, b""
+            marker = await self.gw.delete_object(bucket, key)
+            hdrs = {}
+            if marker is not None:
+                hdrs = {"x-amz-delete-marker": "true",
+                        "x-amz-version-id": marker}
+            return 204, hdrs, b""
         raise S3Error(400, "MethodNotAllowed", method)
