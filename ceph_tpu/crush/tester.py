@@ -112,7 +112,7 @@ class CrushTester:
                 [crush_hash32_2(int(x), self.pool_id) for x in xs],
                 dtype=np.int64,
             )
-        if jm.supports(self.cmap):
+        if jm.supports(self.cmap, ruleno):
             if self._compiled is None:
                 self._compiled = jm.compile_map(self.cmap)
             compiled = self._compiled

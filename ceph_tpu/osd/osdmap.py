@@ -312,6 +312,17 @@ class OSDMap:
         ruleno = self.find_rule(pool.crush_rule, pool.type, pool.size)
         if ruleno < 0:
             return np.full((pool.pg_num, pool.size), CRUSH_ITEM_NONE, np.int32)
+        if not jax_mapper.supports(self.crush, ruleno):
+            # PER-RULE scope gate: only rules that reach legacy buckets
+            # pay the scalar path — straw2 rules keep the batched 10x
+            # even on a map that has a legacy bucket somewhere else
+            out = np.full(
+                (pool.pg_num, pool.size), CRUSH_ITEM_NONE, np.int32
+            )
+            for pg_ord in range(pool.pg_num):
+                up, *_ = self.pg_to_up_acting_osds(pool_id, pg_ord)
+                out[pg_ord, : len(up)] = up
+            return out
         raw = jax_mapper.map_rule(
             self._compile(), ruleno, pps.astype(np.int32), self.osd_weight,
             pool.size,

@@ -300,3 +300,35 @@ def test_firstn_multi_emit():
         RuleStep(RuleOp.EMIT),
     ])
     compare(cmap, 0, [0x10000] * cmap.max_devices, 4)
+
+
+def test_per_rule_scope_gating_mixed_map():
+    """A legacy bucket elsewhere in the map must not cost straw2 rules
+    the fast path (per-rule scoping, VERDICT r3 weak #7): the straw2
+    rule batch-maps bit-exactly while a rule reaching the legacy
+    subtree is refused by map_rule and served by the scalar oracle."""
+    cmap = build_two_level_map(BucketAlg.STRAW2, seed=3)
+    cb.make_simple_rule(cmap, 0, -1, 1, "firstn", 0)
+    # graft a LEGACY (list) host under its own root with its own rule
+    legacy_host = cb.make_bucket(
+        cmap, -90, BucketAlg.LIST, 1, [100, 101], [0x10000, 0x10000]
+    )
+    cb.make_bucket(
+        cmap, -91, BucketAlg.STRAW2, 10, [legacy_host.id],
+        [legacy_host.weight],
+    )
+    legacy_rule = 1
+    cb.make_simple_rule(cmap, legacy_rule, -91, 1, "firstn", 0)
+    cmap.max_devices = max(cmap.max_devices, 102)
+
+    assert not jm.supports(cmap)            # whole-map gate: mixed
+    assert jm.supports(cmap, 0)             # straw2 rule: fast path
+    assert not jm.supports(cmap, legacy_rule)
+
+    weight = [0x10000] * cmap.max_devices
+    # the straw2 rule still compiles + batch-maps bit-exactly
+    compare(cmap, 0, weight, 3)
+    # the legacy rule is refused loudly, never silently diverged
+    compiled = jm.compile_map(cmap)
+    with pytest.raises(ValueError):
+        jm.map_rule(compiled, legacy_rule, np.arange(8), weight, 2)
