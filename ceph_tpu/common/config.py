@@ -127,6 +127,10 @@ SCHEMA: dict[str, Option] = {
              "concurrent recovery ops per OSD"),
         _opt("osd_op_queue", TYPE_STR, LEVEL_ADVANCED, "wpq",
              "op scheduler inside each OSD op shard: wpq | mclock"),
+        _opt("osd_objectstore", TYPE_STR, LEVEL_BASIC, "kstore-file",
+             "backing store a daemon-main OSD boots with: kstore-file "
+             "(crash-safe WAL FileDB, the default) | memstore "
+             "(reference vstart.sh --memstore analogue for benching)"),
         _opt("osd_min_pg_log_entries", TYPE_UINT, LEVEL_ADVANCED, 500,
              "log entries retained per PG; peers further behind than "
              "this take a full backfill instead of log recovery"),

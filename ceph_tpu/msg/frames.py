@@ -124,19 +124,26 @@ class Message:
     epoch: int = 0  #: sender's map epoch (stale-op fencing)
     data: bytes = b""
     raw: bytes = b""  #: bulk data segment (bufferlist payload analogue)
+    #: cumulative piggybacked ack: highest peer seq seen when this frame
+    #: was encoded (ceph_msg_header ack_seq role). Standalone ACK frames
+    #: only fire on idle connections — request/response traffic acks for
+    #: free, halving frame count (each frame is a context switch when
+    #: daemons are separate processes)
+    ack: int = 0
 
     def encode(self) -> bytes:
         return (
             Encoder()
             .struct(
-                2,
+                3,
                 1,
                 lambda b: b.string(self.type)
                 .u64(self.tid)
                 .u64(self.seq)
                 .u64(self.epoch)
                 .blob(self.data)
-                .blob(self.raw),
+                .blob(self.raw)
+                .u64(self.ack),
             )
             .bytes()
         )
@@ -151,6 +158,7 @@ class Message:
                 epoch=b.u64(),
                 data=b.blob(),
                 raw=b.blob() if version >= 2 else b"",
+                ack=b.u64() if version >= 3 else 0,
             )
 
         return Decoder(raw).struct(1, body)

@@ -195,6 +195,13 @@ class Connection:
         self._closed = False
         self._ready = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
+        #: ack coalescing: highest peer seq received / highest ack we have
+        #: actually communicated. Any outgoing message piggybacks the
+        #: current owed ack; a short timer covers idle connections, so
+        #: request/response traffic never pays a standalone ACK frame.
+        self._ack_owed = 0
+        self._ack_sent = 0
+        self._ack_timer: asyncio.TimerHandle | None = None
 
     # -- public API -----------------------------------------------------------
 
@@ -224,6 +231,9 @@ class Connection:
 
     async def close(self) -> None:
         self._closed = True
+        if self._ack_timer is not None:
+            self._ack_timer.cancel()
+            self._ack_timer = None
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
@@ -397,9 +407,47 @@ class Connection:
 
     # -- shared loops ---------------------------------------------------------
 
+    def _note_ack_owed(self, seq: int) -> None:
+        """Record a received seq; piggyback it on the next outgoing
+        message, or flush a standalone ACK after a short idle delay.
+        A hard cap of 8 owed messages bounds the peer's resend window
+        even under replay storms (the window must shrink a little per
+        reconnect attempt or injected-failure runs never converge)."""
+        if seq <= self._ack_owed:
+            return
+        self._ack_owed = seq
+        if seq - self._ack_sent >= 8:
+            if self._ack_timer is not None:
+                self._ack_timer.cancel()
+                self._ack_timer = None
+            self._flush_ack()
+        elif self._ack_timer is None:
+            self._ack_timer = asyncio.get_event_loop().call_later(
+                0.01, self._flush_ack
+            )
+
+    def _flush_ack(self) -> None:
+        self._ack_timer = None
+        if self._ack_owed > self._ack_sent and not self._closed:
+            self._ack_sent = self._ack_owed
+            self._send_q.put_nowait(
+                ("frame",
+                 Frame(Tag.ACK, Encoder().u64(self._ack_owed).bytes()))
+            )
+
+    def _apply_peer_ack(self, acked: int) -> None:
+        # in place: accepted connections share this list with the
+        # messenger's per-peer-instance window (_peer_unacked)
+        self._unacked[:] = [
+            mm for mm in self._unacked if mm.seq > acked
+        ]
+
     def _encode_msg_frame(self, msg: Message) -> Frame:
         """MESSAGE frame, compressed above the configured floor (the
         msgr2 compression mode via the compressor registry)."""
+        if not self.policy.lossy and self._ack_owed > self._ack_sent:
+            msg.ack = self._ack_owed
+            self._ack_sent = self._ack_owed
         payload = msg.encode()
         algo = self.messenger.config.get("ms_compress_mode")
         floor = self.messenger.config.get("ms_compress_min_size")
@@ -442,16 +490,14 @@ class Connection:
                 )
             if frame.tag == Tag.MESSAGE:
                 msg = Message.decode(frame.payload)
-                # ack on receipt, then dedup by per-peer in_seq
                 if not self.policy.lossy:
-                    self._send_q.put_nowait(
-                        (
-                            "frame",
-                            Frame(
-                                Tag.ACK, Encoder().u64(msg.seq).bytes()
-                            ),
-                        )
-                    )
+                    # coalesced ack-on-receipt: note what we owe and let
+                    # the next outgoing message piggyback it (a timer
+                    # covers idle connections); acks are cumulative so
+                    # one frame covers any number of messages
+                    self._note_ack_owed(msg.seq)
+                    if msg.ack:
+                        self._apply_peer_ack(msg.ack)
                     # dedup state is per (peer instance, session
                     # direction): the session we dialed and the one the
                     # peer dialed carry independent seq streams, and a
@@ -459,7 +505,16 @@ class Connection:
                     key = (self.peer_name, self.peer_nonce, self.outgoing)
                     last = m._peer_in_seq.get(key, 0)
                     if msg.seq <= last:
-                        continue  # duplicate from a resend window
+                        # duplicate from a resend window: the peer is
+                        # replaying because it never saw our ack (the
+                        # frame carrying it died with a connection) —
+                        # re-ack IMMEDIATELY or its window never drains
+                        self._ack_sent = 0
+                        if self._ack_timer is not None:
+                            self._ack_timer.cancel()
+                            self._ack_timer = None
+                        self._flush_ack()
+                        continue
                     m._peer_in_seq[key] = msg.seq
                 size = max(1, len(msg.data))
                 await m.dispatch_throttle.get(size)
@@ -468,12 +523,7 @@ class Connection:
                 finally:
                     await m.dispatch_throttle.put(size)
             elif frame.tag == Tag.ACK:
-                acked = Decoder(frame.payload).u64()
-                # in place: accepted connections share this list with the
-                # messenger's per-peer-instance window (_peer_unacked)
-                self._unacked[:] = [
-                    mm for mm in self._unacked if mm.seq > acked
-                ]
+                self._apply_peer_ack(Decoder(frame.payload).u64())
             elif frame.tag == Tag.KEEPALIVE:
                 pass
             elif frame.tag == Tag.RESET:

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 import zlib
 
 from ceph_tpu.common.config import Config
@@ -399,6 +400,22 @@ class OSDService(Dispatcher):
             ("heartbeat_failures", "peer failures reported to the mon"),
         ):
             self.perf.add_u64_counter(key, desc)
+        # write-path leg timings (the l_* time_avg family the reference
+        # keeps in l_osd_op_w_process_lat etc.): where a client op's
+        # wall time goes, for `perf dump` + the latency investigations
+        # multi-process deployment makes meaningful
+        for key, desc in (
+            ("l_op_total", "whole primary-side client op"),
+            ("l_load_state", "EC RMW read leg (_load_state_ec)"),
+            ("l_encode", "batch-encode service wait"),
+            ("l_fan", "sub-write fan-out gather (RTT + shard apply)"),
+            ("l_subop_apply", "shard-side sub-write apply"),
+            ("l_txn", "store.queue_transaction on the shard"),
+            ("l_subop_transit", "sub-write wire transit (send->dispatch)"),
+            ("l_subop_queue", "sub-write shard queue wait (dispatch->pick)"),
+            ("l_loop_lag", "event-loop scheduling overshoot (watchdog)"),
+        ):
+            self.perf.add_time_avg(key, desc)
         self._codecs: dict[int, object] = {}
         self._tids = iter(range(1, 1 << 62))
         self._waiters: dict[int, asyncio.Future] = {}
@@ -500,6 +517,7 @@ class OSDService(Dispatcher):
         if (d := self.dlog.dout(1)) is not None:
             d(f"osd.{self.id} booted at {self.messenger.my_addr}, "
               f"epoch {self.osdmap.epoch}")
+        self._tasks.append(asyncio.create_task(self._loop_lag_watchdog()))
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._peering_loop()))
         self._tasks.append(asyncio.create_task(self._resub_loop()))
@@ -521,6 +539,17 @@ class OSDService(Dispatcher):
                 asyncio.create_task(self._op_shard_worker(shard))
             )
         self._note_map(self.osdmap)
+
+    async def _loop_lag_watchdog(self) -> None:
+        """Samples how late a 10ms sleep fires: the single cheapest
+        signal for 'something blocked the event loop' (jax dispatch, a
+        long callback) — the latency killer multi-process deployment
+        surfaces as mysterious wire-transit time."""
+        loop = asyncio.get_event_loop()
+        while not self._stopped:
+            t0 = loop.time()
+            await asyncio.sleep(0.01)
+            self.perf.tinc("l_loop_lag", max(0.0, loop.time() - t0 - 0.01))
 
     def _spawn(self, coro) -> None:
         """Short-lived task that prunes itself on completion (notifies,
@@ -599,6 +628,7 @@ class OSDService(Dispatcher):
         payload = dict(payload)
         payload["tid"] = tid
         payload["reply_to"] = self.id
+        payload["_sent_at"] = time.time()
         fut = asyncio.get_event_loop().create_future()
         self._waiters[tid] = fut
         try:
@@ -1945,6 +1975,10 @@ class OSDService(Dispatcher):
         self._enqueue_subop(p, self._do_ec_sub_write, conn)
 
     async def _do_ec_sub_write(self, conn, p) -> None:
+        with self.perf.time("l_subop_apply"):
+            await self._do_ec_sub_write_inner(conn, p)
+
+    async def _do_ec_sub_write_inner(self, conn, p) -> None:
         """ECBackend::handle_sub_write for our shard."""
         pg = self._pg_of(p["pgid"])
         e = p["entry"]
@@ -1980,7 +2014,8 @@ class OSDService(Dispatcher):
                         attrs=_attrs_from(p),
                     )
                 pg.append_log(txn, e)
-                self.store.queue_transaction(txn)
+                with self.perf.time("l_txn"):
+                    self.store.queue_transaction(txn)
                 self.perf.inc("subop_w")
         self._reply_peer(conn, p["tid"], {"ok": True})
 
@@ -1998,11 +2033,18 @@ class OSDService(Dispatcher):
         if pg.subop_task is None or pg.subop_task.done():
             pg.subop_task = asyncio.create_task(self._subop_worker(pg))
             self._tasks.append(pg.subop_task)
+        if "_sent_at" in p:
+            self.perf.tinc("l_subop_transit", time.time() - p["_sent_at"])
+        p["_queued_at"] = time.time()
         pg.subop_q.put_nowait((fn, conn, p))
 
     async def _subop_worker(self, pg: PG) -> None:
         while not self._stopped:
             fn, conn, p = await pg.subop_q.get()
+            if "_queued_at" in p:
+                self.perf.tinc(
+                    "l_subop_queue", time.time() - p["_queued_at"]
+                )
             try:
                 await fn(conn, p)
             except asyncio.CancelledError:
@@ -2099,7 +2141,7 @@ class OSDService(Dispatcher):
         with self.op_tracker.track(
             f"osd_op({p.get('op')} {pool_id}/{name} "
             f"from {conn.peer_name})"
-        ) as tracked:
+        ) as tracked, self.perf.time("l_op_total"):
             await self._do_osd_op(conn, p, pool_id, name, tracked)
 
     async def _do_osd_op(self, conn, p, pool_id, name, tracked) -> None:
@@ -2441,9 +2483,10 @@ class OSDService(Dispatcher):
                 need_data = any(
                     op["op"] in ("read", "stat") for op in ops
                 )
-            state = await self._load_state_ec(
-                pg, acting, name, need_data=need_data
-            )
+            with self.perf.time("l_load_state"):
+                state = await self._load_state_ec(
+                    pg, acting, name, need_data=need_data
+                )
         pre_snapset = load_snapset(state.xattrs)
         if mutating and snapc:
             if not state.exists:
@@ -2512,7 +2555,8 @@ class OSDService(Dispatcher):
                 and osd not in pg.backfill_targets
             ]
             if waits:
-                await asyncio.gather(*waits)
+                with self.perf.time("l_fan"):
+                    await asyncio.gather(*waits)
         elif state.deleted:
             await self._fan_ec_delete(pg, acting, entry)
         else:
@@ -2716,7 +2760,8 @@ class OSDService(Dispatcher):
         if pre_encoded is not None:
             encoded = pre_encoded
         else:
-            encoded = await self.encode_service.encode(ec, data)
+            with self.perf.time("l_encode"):
+                encoded = await self.encode_service.encode(ec, data)
         hinfo = HashInfo.from_shards(encoded, ec.get_chunk_count())
         attrs = {"ver": entry["obj_ver"], "hinfo": hinfo,
                  "size": len(data)}
@@ -2749,7 +2794,8 @@ class OSDService(Dispatcher):
                 )
             )
         if waits:
-            await asyncio.gather(*waits)
+            with self.perf.time("l_fan"):
+                await asyncio.gather(*waits)
 
     # -- sub-stripe EC overwrite (start_rmw / ExtentCache analogue) -----------
 
@@ -3094,7 +3140,8 @@ class OSDService(Dispatcher):
                 and osd not in pg.backfill_targets
             ]
             if waits:
-                await asyncio.gather(*waits)
+                with self.perf.time("l_fan"):
+                    await asyncio.gather(*waits)
             return
         await self._fan_ec_write(
             pg, acting, name, data, entry, user_blob=user_blob

@@ -1,0 +1,421 @@
+"""vstart: boot a REAL multi-process cluster.
+
+This is the role of the reference's ``src/vstart.sh`` (1357 lines of shell
+whose only job is to start N ceph-mon + M ceph-osd + mds/rgw as separate OS
+processes on one machine) together with the daemon ``main()``s it execs
+(``src/ceph_osd.cc:106``, ``src/ceph_mon.cc``).  Every daemon here is a real
+``fork+exec``'d Python interpreter running exactly one Monitor / OSDService /
+MDS / RGW on its own event loop; they find each other over the TCP messenger
+through a shared **cluster spec** file — the monmap + config the reference
+distributes via ``ceph.conf`` + the monmap file.
+
+Layout of a run directory (``--run-dir``):
+
+    cluster_spec.json      monmap + n_osds + config overrides
+    mon.0.kv / osd.3.kv    per-daemon FileDB stores (WAL, crash-safe)
+    mon.0.log / osd.3.log  daemon stdout+stderr
+
+The spec is deterministic: every mon builds the identical initial OSDMap
+from it (the reference's ``monmaptool --create`` + ``osdmaptool
+--createsimple`` seed), so independently-booted mons agree on epoch 1
+without talking.
+
+Why this exists: through round 4 every "live" test hosted all daemons in ONE
+interpreter on one loop — fine for correctness, but a single GIL serialised
+the whole data path (~27 MB/s).  Real processes give each OSD its own
+interpreter, so daemon-path throughput can scale with the process count;
+``tools/daemon_bench.py --multiprocess`` measures exactly that and
+``tests/test_multiprocess.py`` proves kill/revive correctness across real
+PIDs (SIGKILL, not cooperative ``stop()``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+# ---------------------------------------------------------------------------
+# Cluster spec
+
+
+@dataclass
+class ClusterSpec:
+    """Everything a daemon needs to boot: the monmap + deterministic seed.
+
+    The reference splits this across ceph.conf, the monmap file, and the
+    mon store's initial osdmap; one JSON file carries all three here.
+    """
+
+    mon_addrs: list  # [[host, port], ...] — rank r binds mon_addrs[r]
+    n_osds: int
+    run_dir: str
+    config: dict = field(default_factory=dict)
+    keyring: dict = field(default_factory=dict)  # entity -> hex secret
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "mon_addrs": [list(a) for a in self.mon_addrs],
+                    "n_osds": self.n_osds,
+                    "run_dir": self.run_dir,
+                    "config": self.config,
+                    "keyring": self.keyring,
+                },
+                f,
+                indent=1,
+            )
+
+    @classmethod
+    def load(cls, path: str) -> "ClusterSpec":
+        with open(path) as f:
+            d = json.load(f)
+        return cls(
+            mon_addrs=[tuple(a) for a in d["mon_addrs"]],
+            n_osds=d["n_osds"],
+            run_dir=d["run_dir"],
+            config=d.get("config", {}),
+            keyring=d.get("keyring", {}),
+        )
+
+    # -- deterministic seeds --------------------------------------------------
+
+    def monmap(self):
+        from ceph_tpu.mon import MonMap
+
+        return MonMap(addrs=[tuple(a) for a in self.mon_addrs])
+
+    def build_config(self):
+        from ceph_tpu.common.config import Config
+
+        cfg = Config()
+        for k, v in self.config.items():
+            cfg.set(k, v)
+        return cfg
+
+    def initial_osdmap(self):
+        return initial_osdmap(self.n_osds)
+
+    def bytes_keyring(self) -> dict | None:
+        if not self.keyring:
+            return None
+        return {k: bytes.fromhex(v) for k, v in self.keyring.items()}
+
+
+def initial_osdmap(n_osds: int):
+    """THE deterministic epoch-1 seed: one host per OSD (failures cross
+    failure domains), straw2 root, rule 0 = indep (EC), rule 1 = firstn
+    (replicated). Every mon of a cluster must build this identically from
+    the spec alone, and the in-process live tier + daemon bench import it
+    too, so single-process and multi-process behavior stay comparable."""
+    from ceph_tpu.crush import builder as cb
+    from ceph_tpu.crush.types import BucketAlg, CrushMap, Tunables
+    from ceph_tpu.osd import OSDMap
+
+    cmap = CrushMap(tunables=Tunables.jewel())
+    host_ids, host_ws = [], []
+    for h in range(n_osds):
+        b = cb.make_bucket(
+            cmap, -(h + 2), BucketAlg.STRAW2, 1, [h], [0x10000]
+        )
+        host_ids.append(b.id)
+        host_ws.append(b.weight)
+    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, host_ids, host_ws)
+    cb.make_simple_rule(cmap, 0, -1, 1, "indep", 0)
+    cb.make_simple_rule(cmap, 1, -1, 1, "firstn", 0)
+    return OSDMap(crush=cmap, max_osd=n_osds)
+
+
+def pick_ports(n: int) -> list[int]:
+    """Reserve n distinct kernel-assigned loopback ports.
+
+    All sockets stay open until every port is collected so the kernel can't
+    hand the same port out twice; the (tiny, loopback-only) close->bind race
+    is accepted, as vstart.sh accepts it with its fixed port ranges.
+    """
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            socks.append(s)
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+# ---------------------------------------------------------------------------
+# Daemon mains (exec'd via python -m ceph_tpu.mon / ceph_tpu.osd / ...)
+
+
+def _install_term_handler(loop, stopper) -> None:
+    """SIGTERM -> clean daemon stop (the reference's handle_osd_signal);
+    SIGKILL needs no handler — that's the crash path tests exercise."""
+
+    def _term():
+        asyncio.ensure_future(stopper())
+
+    loop.add_signal_handler(signal.SIGTERM, _term)
+
+
+async def _run_forever(stop_evt: asyncio.Event) -> None:
+    await stop_evt.wait()
+
+
+def daemon_main(kind: str, ident: int, spec_path: str) -> None:
+    """Shared entry point behind ``python -m ceph_tpu.{mon,osd}``."""
+    # The axon TPU plugin ignores JAX_PLATFORMS; the platform must be forced
+    # through jax.config before the backend initializes.  Test/bench parents
+    # ask their daemon children for CPU this way (a single real TPU chip
+    # can't be opened by N daemon processes at once anyway).
+    plat = os.environ.get("CEPH_TPU_JAX_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    spec = ClusterSpec.load(spec_path)
+    from ceph_tpu.common.kv import FileDB
+
+    async def amain() -> None:
+        loop = asyncio.get_event_loop()
+        stop_evt = asyncio.Event()
+        cfg = spec.build_config()
+        keyring = spec.bytes_keyring()
+        if kind == "osd" and cfg.get("osd_objectstore") == "memstore":
+            from ceph_tpu.common.kv import MemDB
+
+            db = MemDB()
+        else:
+            db = FileDB(
+                os.path.join(spec.run_dir, f"{kind}.{ident}.kv")
+            )
+        if kind == "mon":
+            from ceph_tpu.mon import Monitor
+
+            mon = Monitor(
+                ident,
+                spec.monmap(),
+                spec.initial_osdmap(),
+                db=db,
+                config=cfg,
+                keyring=keyring,
+            )
+            await mon.start()
+
+            async def _stop():
+                await mon.stop()
+                stop_evt.set()
+
+            _install_term_handler(loop, _stop)
+            print(f"mon.{ident} up at {spec.mon_addrs[ident]}", flush=True)
+        elif kind == "osd":
+            from ceph_tpu.osd.daemon import OSDService
+
+            osd = OSDService(
+                ident, spec.monmap(), db=db, config=cfg, keyring=keyring
+            )
+            await osd.start()
+
+            async def _stop():
+                await osd.stop()
+                stop_evt.set()
+
+            _install_term_handler(loop, _stop)
+            print(f"osd.{ident} up at {osd.messenger.my_addr}", flush=True)
+        else:  # pragma: no cover - guarded by argparse choices
+            raise SystemExit(f"unknown daemon kind {kind!r}")
+        await _run_forever(stop_evt)
+
+    if os.environ.get("CEPH_TPU_PROFILE"):
+        import cProfile
+
+        prof = cProfile.Profile()
+        prof.enable()
+        try:
+            asyncio.run(amain())
+        finally:
+            prof.disable()
+            prof.dump_stats(
+                os.path.join(spec.run_dir, f"{kind}.{ident}.prof")
+            )
+    else:
+        asyncio.run(amain())
+
+
+# ---------------------------------------------------------------------------
+# The launcher
+
+
+class VStart:
+    """Boot + manage a multi-process cluster from the test/bench process.
+
+    ``start()`` spawns one interpreter per daemon; ``kill_osd`` delivers a
+    real signal (default SIGKILL — the crash the thrasher wants);
+    ``start_osd`` boots a fresh process for an id over the daemon's
+    surviving FileDB, which is the reference's restart-with-intact-store
+    path.
+    """
+
+    def __init__(
+        self,
+        run_dir: str,
+        n_mons: int = 3,
+        n_osds: int = 4,
+        config: dict | None = None,
+        env: dict | None = None,
+    ):
+        os.makedirs(run_dir, exist_ok=True)
+        cfg = {
+            "mon_lease": 0.25,
+            "mon_election_timeout": 1.0,
+            "osd_heartbeat_interval": 0.25,
+            # daemons no longer share a loop: grace can be much tighter
+            # than the in-process tier's jit-compile-absorbing 2s
+            "osd_heartbeat_grace": 3,
+        }
+        cfg.update(config or {})
+        ports = pick_ports(n_mons)
+        self.spec = ClusterSpec(
+            mon_addrs=[("127.0.0.1", p) for p in ports],
+            n_osds=n_osds,
+            run_dir=run_dir,
+            config=cfg,
+        )
+        self.spec_path = os.path.join(run_dir, "cluster_spec.json")
+        self.spec.save(self.spec_path)
+        self.env = dict(os.environ)
+        self.env.update(env or {})
+        self.mons: dict[int, subprocess.Popen] = {}
+        self.osds: dict[int, subprocess.Popen] = {}
+        self._logs: list = []
+
+    # -- process management ---------------------------------------------------
+
+    def _spawn(self, kind: str, ident: int) -> subprocess.Popen:
+        log = open(
+            os.path.join(self.spec.run_dir, f"{kind}.{ident}.log"), "ab"
+        )
+        self._logs.append(log)
+        return subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                f"ceph_tpu.{kind}",
+                "--id",
+                str(ident),
+                "--spec",
+                self.spec_path,
+            ],
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            env=self.env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+
+    def start(self) -> None:
+        for r in range(len(self.spec.mon_addrs)):
+            self.mons[r] = self._spawn("mon", r)
+        for i in range(self.spec.n_osds):
+            self.osds[i] = self._spawn("osd", i)
+
+    def start_osd(self, osd_id: int) -> None:
+        self.osds[osd_id] = self._spawn("osd", osd_id)
+
+    def kill_osd(self, osd_id: int, sig: int = signal.SIGKILL) -> None:
+        p = self.osds.pop(osd_id)
+        p.send_signal(sig)
+        p.wait(timeout=30)
+
+    def kill_mon(self, rank: int, sig: int = signal.SIGKILL) -> None:
+        p = self.mons.pop(rank)
+        p.send_signal(sig)
+        p.wait(timeout=30)
+
+    def stop(self) -> None:
+        procs = list(self.mons.values()) + list(self.osds.values())
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                p.kill()
+        for log in self._logs:
+            log.close()
+        self.mons.clear()
+        self.osds.clear()
+
+    # -- client-side helpers --------------------------------------------------
+
+    def client(self, name: str = "client.admin"):
+        from ceph_tpu.rados.client import Rados
+
+        return Rados(name, self.spec.monmap(), config=self.spec.build_config())
+
+    async def wait_healthy(
+        self, rados=None, osds: set | None = None, timeout: float = 60.0
+    ):
+        """Wait until the committed osdmap shows every expected OSD up."""
+        own = rados is None
+        if own:
+            rados = self.client()
+            await rados.connect()
+        want = osds if osds is not None else set(range(self.spec.n_osds))
+        loop = asyncio.get_event_loop()
+        end = loop.time() + timeout
+        try:
+            while True:
+                m = rados.objecter.osdmap
+                if m is not None and all(
+                    i < m.max_osd and m.osd_up[i] for i in want
+                ):
+                    return m
+                if loop.time() > end:
+                    raise TimeoutError(
+                        f"osds {want} not up; map={None if m is None else m.epoch}"
+                    )
+                await asyncio.sleep(0.1)
+        finally:
+            if own:
+                await rados.shutdown()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="boot a multi-process cluster (vstart.sh role)"
+    )
+    ap.add_argument("--run-dir", default="./vstart-run")
+    ap.add_argument("--mons", type=int, default=3)
+    ap.add_argument("--osds", type=int, default=4)
+    args = ap.parse_args(argv)
+    v = VStart(args.run_dir, n_mons=args.mons, n_osds=args.osds)
+    v.start()
+    print(f"spec: {v.spec_path}")
+    print(f"mons: {[p.pid for p in v.mons.values()]}")
+    print(f"osds: {[p.pid for p in v.osds.values()]}")
+    try:
+        asyncio.run(v.wait_healthy())
+        print("HEALTH_OK: all osds up — ^C to tear down")
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        v.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -9,8 +9,6 @@ import asyncio
 import pytest
 
 from ceph_tpu.common.config import Config
-from ceph_tpu.crush import builder as cb
-from ceph_tpu.crush.types import BucketAlg, CrushMap, Tunables
 from ceph_tpu.mon import MonMap, Monitor
 from ceph_tpu.osd import OSDMap
 from ceph_tpu.osd.daemon import OSDService
@@ -37,19 +35,11 @@ def live_config() -> Config:
 
 
 def initial_osdmap() -> OSDMap:
-    """One osd per host so failures cross failure domains."""
-    cmap = CrushMap(tunables=Tunables.jewel())
-    host_ids, host_ws = [], []
-    for h in range(N_OSDS):
-        b = cb.make_bucket(
-            cmap, -(h + 2), BucketAlg.STRAW2, 1, [h], [0x10000]
-        )
-        host_ids.append(b.id)
-        host_ws.append(b.weight)
-    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, host_ids, host_ws)
-    cb.make_simple_rule(cmap, 0, -1, 1, "indep", 0)
-    cb.make_simple_rule(cmap, 1, -1, 1, "firstn", 0)
-    return OSDMap(crush=cmap, max_osd=N_OSDS)
+    """One osd per host so failures cross failure domains (the shared
+    deterministic seed — single home in ceph_tpu.vstart)."""
+    from ceph_tpu.vstart import initial_osdmap as seed
+
+    return seed(N_OSDS)
 
 
 class Cluster:

@@ -37,13 +37,26 @@ def parse_args():
     ap.add_argument("--m", type=int, default=2)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (tests/dev)")
+    ap.add_argument("--multiprocess", action="store_true",
+                    help="every daemon a real OS process (vstart) + "
+                         "--clients client worker processes")
+    ap.add_argument("--clients", type=int, default=4,
+                    help="client worker processes (multiprocess mode)")
+    ap.add_argument("--objectstore", default="memstore",
+                    choices=("memstore", "kstore-file"),
+                    help="OSD store in multiprocess mode; memstore matches "
+                         "the single-process bench (MemDB), kstore-file "
+                         "adds a per-txn fsync'd WAL")
+    ap.add_argument("--run-dir", default=None)
+    # internal: this invocation is one client worker of a multiprocess run
+    ap.add_argument("--client-worker", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--worker-id", type=int, default=0,
+                    help=argparse.SUPPRESS)
     return ap.parse_args()
 
 
 async def main(args) -> dict:
     from ceph_tpu.common.config import Config
-    from ceph_tpu.crush import builder as cb
-    from ceph_tpu.crush.types import BucketAlg, CrushMap, Tunables
     from ceph_tpu.mon import MonMap, Monitor
     from ceph_tpu.osd import OSDMap
     from ceph_tpu.osd.daemon import OSDService
@@ -55,17 +68,9 @@ async def main(args) -> dict:
     cfg.set("osd_heartbeat_interval", 0.5)
     cfg.set("osd_heartbeat_grace", 5)
 
-    cmap = CrushMap(tunables=Tunables.jewel())
-    host_ids, host_ws = [], []
-    for h in range(args.osds):
-        b = cb.make_bucket(
-            cmap, -(h + 2), BucketAlg.STRAW2, 1, [h], [0x10000]
-        )
-        host_ids.append(b.id)
-        host_ws.append(b.weight)
-    cb.make_bucket(cmap, -1, BucketAlg.STRAW2, 10, host_ids, host_ws)
-    cb.make_simple_rule(cmap, 0, -1, 1, "indep", 0)
-    base = OSDMap(crush=cmap, max_osd=args.osds)
+    from ceph_tpu.vstart import initial_osdmap
+
+    base = initial_osdmap(args.osds)
 
     monmap = MonMap(addrs=[("127.0.0.1", 0)] * 3)
     mons = [Monitor(r, monmap, base, config=cfg) for r in range(3)]
@@ -136,6 +141,8 @@ async def main(args) -> dict:
     for m in mons:
         await m.stop()
     return {
+        "mode": "single-process",
+        "ncores": os.cpu_count(),
         "write_gbps": total_bytes / elapsed / 1e9,
         "read_gbps": total_bytes / read_elapsed / 1e9,
         "objects": objects,
@@ -148,13 +155,150 @@ async def main(args) -> dict:
     }
 
 
+async def client_worker(args) -> dict:
+    """One client process of a multiprocess run: write then read its own
+    object range against the already-created pool, report wall windows."""
+    from ceph_tpu.rados.client import Rados
+    from ceph_tpu.vstart import ClusterSpec
+
+    spec = ClusterSpec.load(args.client_worker)
+    rados = Rados(
+        f"client.bench{args.worker_id}", spec.monmap(),
+        config=spec.build_config(),
+    )
+    await rados.connect()
+    io = rados.io_ctx(1)
+    payload = bytes(range(256)) * (args.size // 256)
+    names = [
+        f"o-{args.worker_id}-{j}" for j in range(args.objects)
+    ]
+
+    async def stream(chunk):
+        for name in chunk:
+            await io.write_full(name, payload)
+
+    lanes = max(1, args.concurrency)
+    chunks = [names[i::lanes] for i in range(lanes)]
+    w0 = time.time()
+    await asyncio.gather(*(stream(c) for c in chunks))
+    w1 = time.time()
+
+    async def stream_r(chunk):
+        for name in chunk:
+            await io.read(name)
+
+    r0 = time.time()
+    await asyncio.gather(*(stream_r(c) for c in chunks))
+    r1 = time.time()
+    await rados.shutdown()
+    return {
+        "bytes": len(payload) * len(names),
+        "write_window": [w0, w1],
+        "read_window": [r0, r1],
+    }
+
+
+async def main_multiprocess(args) -> dict:
+    """The scaling measurement VERDICT r4 asked for: N OSD processes +
+    C client processes, no shared interpreter anywhere on the data path."""
+    import subprocess
+    import tempfile
+
+    from ceph_tpu.vstart import VStart
+
+    run_dir = args.run_dir or tempfile.mkdtemp(prefix="daemon-bench-")
+    v = VStart(
+        run_dir, n_mons=3, n_osds=args.osds,
+        config={"osd_objectstore": args.objectstore},
+        env={"CEPH_TPU_JAX_PLATFORM": "cpu"},
+    )
+    v.start()
+    try:
+        rados = v.client()
+        await rados.connect()
+        await v.wait_healthy(rados=rados, timeout=120)
+        await rados.mon_command(
+            "osd erasure-code-profile set",
+            {"name": "bench",
+             "profile": {"plugin": "tpu", "k": str(args.k),
+                         "m": str(args.m)}},
+        )
+        await rados.mon_command(
+            "osd pool create",
+            {"pool_id": 1, "crush_rule": 0,
+             "erasure_code_profile": "bench", "pg_num": 32},
+        )
+        io = rados.io_ctx(1)
+        payload = bytes(range(256)) * (args.size // 256)
+        # warm: peering + per-OSD first-compile at this shape
+        for i in range(2 * args.osds):
+            await io.write_full(f"warm-{i}", payload)
+        await rados.shutdown()
+
+        per_client = max(1, args.objects // args.clients)
+        lanes = max(1, args.concurrency // args.clients)
+        env = dict(os.environ)
+        env["CEPH_TPU_JAX_PLATFORM"] = "cpu"
+        procs = [
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--client-worker", v.spec_path,
+                 "--worker-id", str(w),
+                 "--objects", str(per_client),
+                 "--size", str(args.size),
+                 "--concurrency", str(lanes)],
+                stdout=subprocess.PIPE, env=env,
+            )
+            for w in range(args.clients)
+        ]
+        raw_outs = [p.communicate(timeout=600)[0] for p in procs]
+        for p in procs:
+            if p.returncode:
+                raise RuntimeError(
+                    f"client worker pid {p.pid} failed "
+                    f"(rc={p.returncode})"
+                )
+        outs = [json.loads(o) for o in raw_outs]
+        total = sum(o["bytes"] for o in outs)
+        w_span = max(o["write_window"][1] for o in outs) - min(
+            o["write_window"][0] for o in outs
+        )
+        r_span = max(o["read_window"][1] for o in outs) - min(
+            o["read_window"][0] for o in outs
+        )
+        return {
+            "mode": "multiprocess",
+            "ncores": os.cpu_count(),
+            "write_gbps": total / w_span / 1e9,
+            "read_gbps": total / r_span / 1e9,
+            "object_size": args.size,
+            "objects": per_client * args.clients,
+            "k": args.k,
+            "m": args.m,
+            "osds": args.osds,
+            "clients": args.clients,
+        }
+    finally:
+        v.stop()
+
+
 if __name__ == "__main__":
     args = parse_args()
-    if args.cpu:
+    # every branch touches jax (CRUSH targeting in the client); force the
+    # platform BEFORE backend init (the axon plugin ignores JAX_PLATFORMS)
+    plat = os.environ.get("CEPH_TPU_JAX_PLATFORM")
+    if args.cpu or args.multiprocess or args.client_worker:
+        plat = plat or "cpu"
+    if plat:
         import jax
 
-        jax.config.update("jax_platforms", "cpu")
-    result = asyncio.run(asyncio.wait_for(main(args), 600))
+        jax.config.update("jax_platforms", plat)
+    if args.client_worker:
+        result = asyncio.run(asyncio.wait_for(client_worker(args), 600))
+    elif args.multiprocess:
+        result = asyncio.run(asyncio.wait_for(main_multiprocess(args), 900))
+    else:
+        result = asyncio.run(asyncio.wait_for(main(args), 600))
     json.dump({k: (round(v, 3) if isinstance(v, float) else v)
                for k, v in result.items()}, sys.stdout)
     print()
