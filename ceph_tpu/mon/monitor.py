@@ -1082,6 +1082,42 @@ class Monitor(Dispatcher):
             return await self._cmd_auth(cmd, args, conn)
         if cmd == "osd pool create":
             return await self._cmd_pool_create(args)
+        if cmd == "osd blocklist":
+            # OSDMonitor's `osd blocklist add|rm|ls` (the fencing lever:
+            # src/osd/OSDMap.h:579 blacklist + options.cc
+            # mon_osd_blacklist_default_expire). Entities are
+            # "client.name" (all instances) or "client.name/nonce".
+            import time as _time
+
+            op = args.get("op", "add")
+            if op == "ls":
+                now = _time.time()
+                return {"blocklist": {
+                    k: v for k, v in self.osdmap.blocklist.items()
+                    if v > now
+                }}
+            entity = args["entity"]
+            if op == "add":
+                expire = float(args.get("expire", 3600.0))
+                await self._propose_osdmap(
+                    Incremental(
+                        epoch=self.osdmap.epoch + 1,
+                        new_blocklist={
+                            entity: _time.time() + expire
+                        },
+                    )
+                )
+            elif op == "rm":
+                if entity in self.osdmap.blocklist:
+                    await self._propose_osdmap(
+                        Incremental(
+                            epoch=self.osdmap.epoch + 1,
+                            old_blocklist=[entity],
+                        )
+                    )
+            else:
+                raise ValueError(f"osd blocklist: unknown op {op!r}")
+            return {}
         if cmd == "osd erasure-code-profile set":
             profile = dict(args["profile"])
             # validate by instantiating the codec (OSDMonitor.cc:6814)

@@ -2060,6 +2060,24 @@ class OSDService(Dispatcher):
         object name so same-object ops keep their arrival order, and
         within a shard the WPQ's deficit round-robin over client klasses
         fair-shares service by op cost."""
+        if self.osdmap.is_blocklisted(conn.peer_name, conn.peer_nonce):
+            # fencing (OSD::ms_verify_authorizer + op blacklist check):
+            # an evicted/blocklisted entity's ops — including writes that
+            # were in flight when the blocklist committed — are refused
+            # with a terminal errno at EVERY osd, so it can never race
+            # the client that took over its caps/locks
+            conn.send_message(
+                Message(
+                    type="osd_op_reply", tid=p["tid"],
+                    epoch=self.osdmap.epoch,
+                    data=json.dumps(
+                        {"tid": p["tid"], "ok": False,
+                         "errno": "EBLOCKLISTED",
+                         "error": f"{conn.peer_name} is blocklisted"}
+                    ).encode(),
+                )
+            )
+            return
         shard = self._op_shards[
             zlib.crc32(p["name"].encode()) % len(self._op_shards)
         ]

@@ -68,6 +68,13 @@ class OSDMap:
     #: osd -> (host, port) public address (OSDMap::osd_addrs) — how clients
     #: and peers reach a daemon; registered at boot via the mon
     osd_addrs: dict[int, tuple[str, int]] = field(default_factory=dict)
+    #: fencing (OSDMap.h:579 blacklist map): entity identity -> unix expiry.
+    #: Identities are "client.name" (every instance of the entity) or
+    #: "client.name/nonce" (one messenger instance). OSDs refuse ops from
+    #: blocklisted identities; the MDS blocklists before re-granting an
+    #: evicted client's caps (mds_session_blacklist_on_evict) so stale
+    #: direct-RADOS writes can never race the new cap holder.
+    blocklist: dict[str, float] = field(default_factory=dict)
 
     def __post_init__(self):
         n = self.max_osd
@@ -111,6 +118,24 @@ class OSDMap:
 
     def exists(self, osd: int) -> bool:
         return 0 <= osd < self.max_osd and bool(self.osd_exists[osd])
+
+    def is_blocklisted(
+        self, name: str, nonce: int = 0, now: float | None = None
+    ) -> bool:
+        """OSDMap::is_blacklisted: entity-wide entry fences every
+        instance; an entity/nonce entry fences one messenger instance.
+        Entries expire by wall clock (utime expiry in the reference)."""
+        if not self.blocklist:
+            return False
+        if now is None:
+            import time as _time
+
+            now = _time.time()
+        for key in (name, f"{name}/{nonce}"):
+            exp = self.blocklist.get(key)
+            if exp is not None and exp > now:
+                return True
+        return False
 
     # -- rule lookup (CrushWrapper::find_rule) ---------------------------------
 
@@ -571,6 +596,10 @@ class Incremental:
     new_pool_snap_seq: dict = _field(default_factory=dict)
     #: pool -> snap ids to append to removed_snaps (snap deletion)
     new_removed_snaps: dict = _field(default_factory=dict)
+    #: entity identity -> unix expiry (blocklist add)
+    new_blocklist: dict = _field(default_factory=dict)
+    #: entity identities to un-blocklist
+    old_blocklist: list = _field(default_factory=list)
 
     def encode(self) -> bytes:
         def body(b):
@@ -613,8 +642,11 @@ class Incremental:
                 self.new_removed_snaps, lambda e, k: e.u64(k),
                 lambda e, v: e.list(sorted(v), lambda ee, s: ee.u64(s)),
             )
+            b.mapping(self.new_blocklist, lambda e, k: e.string(k),
+                      lambda e, v: e.f64(v))
+            b.list(sorted(self.old_blocklist), lambda e, v: e.string(v))
 
-        return _Encoder().struct(2, 1, body).bytes()
+        return _Encoder().struct(3, 1, body).bytes()
 
     @staticmethod
     def decode(raw: bytes) -> "Incremental":
@@ -660,9 +692,14 @@ class Incremental:
                     lambda d: d.u64(),
                     lambda d: d.list(lambda dd: dd.u64()),
                 )
+            if version >= 3:
+                inc.new_blocklist = b.mapping(
+                    lambda d: d.string(), lambda d: d.f64()
+                )
+                inc.old_blocklist = b.list(lambda d: d.string())
             return inc
 
-        return _Decoder(raw).struct(2, body)
+        return _Decoder(raw).struct(3, body)
 
 
 def apply_incremental(self, inc: Incremental) -> None:
@@ -740,6 +777,9 @@ def apply_incremental(self, inc: Incremental) -> None:
             cur = set(self.pools[pid].removed_snaps)
             cur.update(snaps)
             self.pools[pid].removed_snaps = sorted(cur)
+    self.blocklist.update(inc.new_blocklist)
+    for entity in inc.old_blocklist:
+        self.blocklist.pop(entity, None)
     self.epoch = inc.epoch
 
 
@@ -778,8 +818,10 @@ def encode_osdmap(self) -> bytes:
         b.mapping(self.primary_temp, _enc_pg, lambda e, v: e.s32(v))
         b.mapping(self.osd_addrs, lambda e, k: e.u32(k),
                   lambda e, v: e.string(v[0]).u32(v[1]))
+        b.mapping(self.blocklist, lambda e, k: e.string(k),
+                  lambda e, v: e.f64(v))
 
-    return _Encoder().struct(1, 1, body).bytes()
+    return _Encoder().struct(2, 1, body).bytes()
 
 
 def decode_osdmap(raw: bytes) -> "OSDMap":
@@ -821,9 +863,13 @@ def decode_osdmap(raw: bytes) -> "OSDMap":
         m.osd_addrs = b.mapping(
             lambda d: d.u32(), lambda d: (d.string(), d.u32())
         )
+        if version >= 2:
+            m.blocklist = b.mapping(
+                lambda d: d.string(), lambda d: d.f64()
+            )
         return m
 
-    return _Decoder(raw).struct(1, body)
+    return _Decoder(raw).struct(2, body)
 
 
 # bound here so the dataclass body above stays focused on placement; these
