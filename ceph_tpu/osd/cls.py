@@ -213,6 +213,26 @@ def _lock_info(ctx: MethodContext, inp: dict):
             "type": None if not state else state["type"]}
 
 
+def _break_lock(ctx: MethodContext, inp: dict):
+    """cls_lock break_lock: remove a NAMED holder without being it —
+    the recovery path after the holder died (the caller blocklists the
+    holder first so its in-flight ops can't outlive the break)."""
+    name = inp["name"]
+    state = ctx.getxattr(_lock_key(name))
+    owner = inp["owner"]
+    if not state:
+        raise ClsError("ENOENT", f"lock {name!r} not held")
+    keep = [h for h in state["holders"] if h["owner"] != owner]
+    if len(keep) == len(state["holders"]):
+        raise ClsError("ENOENT", f"{owner!r} does not hold {name!r}")
+    if keep:
+        state["holders"] = keep
+        ctx.setxattr(_lock_key(name), state)
+    else:
+        ctx.rmxattr(_lock_key(name))
+    return {"ok": True}
+
+
 # -- cls_version (src/cls/version/cls_version.cc) -----------------------------
 
 def _version_read(ctx: MethodContext, inp: dict):
@@ -243,6 +263,7 @@ def default_handler() -> ClassHandler:
     h.register("lock", "lock", RD | WR, _lock_op)
     h.register("lock", "unlock", RD | WR, _unlock_op)
     h.register("lock", "get_info", RD, _lock_info)
+    h.register("lock", "break_lock", RD | WR, _break_lock)
     h.register("version", "read", RD, _version_read)
     h.register("version", "check", RD, _version_check)
     return h

@@ -42,16 +42,101 @@ from ceph_tpu.rados.client import IoCtx, ObjectNotFound, RadosError
 
 DEFAULT_ORDER = 22  # 4 MiB objects, the reference default (rbd_default_order)
 
-#: per-(pool, image) maintenance lock: clone/flatten/unprotect update the
-#: parent header read-modify-write, and two handles racing would lose a
-#: children-count update (the in-process slice of librbd's exclusive-lock
-#: feature; cross-process exclusion would ride watch/notify like the
-#: reference's managed lock)
-_header_locks: dict[tuple, asyncio.Lock] = {}
+RBD_LOCK_NAME = "rbd_lock"  # the reference's RBD_LOCK_NAME
 
 
-def _header_lock(pool_id: int, name: str) -> asyncio.Lock:
-    return _header_locks.setdefault((pool_id, name), asyncio.Lock())
+class _ClsHeaderLock:
+    """Cluster-side image lock on the header object via cls_lock (the
+    librbd ManagedLock/ExclusiveLock role, src/librbd/ManagedLock.h:28).
+
+    Replaces round-4's in-process `_header_locks` dict: exclusion now
+    lives IN the cluster (an atomic cls op on the header at its primary
+    OSD), so two clients in different processes — the deployment that
+    exists since the vstart work — serialize clone/flatten/unprotect
+    header RMWs and open-for-write ownership correctly.
+
+    Owner identity is "entity/nonce" (this messenger instance), which is
+    exactly the OSDMap blocklist's per-instance key: `break_lock`
+    blocklists the dead holder BEFORE removing its lock, so its delayed
+    writes are refused at every OSD (blacklist_on_break_lock).
+    """
+
+    def __init__(self, ioctx: IoCtx, header_name: str):
+        self.ioctx = ioctx
+        self.header = header_name
+        m = ioctx.objecter.messenger
+        self.owner = f"{ioctx.objecter.name}/{m.instance_nonce}"
+        self.cookie = "rbd"
+
+    async def acquire(self, timeout: float = 10.0) -> None:
+        """Bounded-retry exclusive acquire (maintenance ops hold the
+        lock briefly; open-for-write holders keep it until release)."""
+        loop = asyncio.get_event_loop()
+        end = loop.time() + timeout
+        while True:
+            try:
+                await self.ioctx.exec(
+                    self.header, "lock", "lock",
+                    {"name": RBD_LOCK_NAME, "type": "exclusive",
+                     "owner": self.owner, "cookie": self.cookie},
+                )
+                return
+            except RadosError as e:
+                if "EBUSY" not in str(e) or loop.time() > end:
+                    raise
+                await asyncio.sleep(0.05)
+
+    async def release(self) -> None:
+        try:
+            await self.ioctx.exec(
+                self.header, "lock", "unlock",
+                {"name": RBD_LOCK_NAME, "owner": self.owner,
+                 "cookie": self.cookie},
+            )
+        except RadosError:
+            pass  # already broken/expired: release is best-effort
+
+    async def holders(self) -> list:
+        info = await self.ioctx.exec(
+            self.header, "lock", "get_info", {"name": RBD_LOCK_NAME}
+        )
+        return info.get("holders", [])
+
+    async def break_lock(
+        self, owner: str, blocklist: bool = True,
+        blocklist_expire: float = 3600.0,
+    ) -> None:
+        """Take a dead holder's lock away: blocklist its messenger
+        instance in the OSDMap FIRST (its in-flight writes die at every
+        OSD), then remove the holder entry."""
+        if blocklist:
+            await self.ioctx.objecter.mon.command(
+                "osd blocklist",
+                {"op": "add", "entity": owner,
+                 "expire": blocklist_expire},
+            )
+        await self.ioctx.exec(
+            self.header, "lock", "break_lock",
+            {"name": RBD_LOCK_NAME, "owner": owner},
+        )
+
+
+class _HeaderLockCtx:
+    """`async with` sugar for a brief maintenance hold."""
+
+    def __init__(self, ioctx: IoCtx, header_name: str):
+        self.lock = _ClsHeaderLock(ioctx, header_name)
+
+    async def __aenter__(self):
+        await self.lock.acquire()
+        return self.lock
+
+    async def __aexit__(self, *exc):
+        await self.lock.release()
+
+
+def _header_lock(ioctx: IoCtx, image_name: str) -> _HeaderLockCtx:
+    return _HeaderLockCtx(ioctx, f"rbd_header.{image_name}")
 
 
 class ImageNotFound(RadosError):
@@ -121,16 +206,47 @@ class Image:
         return cls(ioctx, name, size, order)
 
     @classmethod
-    async def open(cls, ioctx: IoCtx, name: str) -> "Image":
+    async def open(
+        cls, ioctx: IoCtx, name: str, exclusive: bool = False
+    ) -> "Image":
+        """`exclusive=True` = open-for-write under the cluster-side
+        exclusive lock (librbd's exclusive-lock feature): held until
+        `close()`/`lock_release()`, visible to every other client via
+        `lock_holders()`, breakable with `break_lock` when the holder
+        died (which blocklists it first)."""
         try:
             header = json.loads(await ioctx.read(cls._header_name(name)))
         except ObjectNotFound as e:
             raise ImageNotFound(f"no image {name!r}") from e
-        return cls(ioctx, name, header["size"], header["order"],
-                   snaps=header.get("snaps"),
-                   parent=header.get("parent"),
-                   protected=header.get("protected"),
-                   children=header.get("children", 0))
+        img = cls(ioctx, name, header["size"], header["order"],
+                  snaps=header.get("snaps"),
+                  parent=header.get("parent"),
+                  protected=header.get("protected"),
+                  children=header.get("children", 0))
+        if exclusive:
+            await img.lock_acquire()
+        return img
+
+    # -- the exclusive lock (ManagedLock.h:28 surface) -------------------------
+
+    @property
+    def _lock(self) -> _ClsHeaderLock:
+        return _ClsHeaderLock(self.ioctx, self._header_name(self.name))
+
+    async def lock_acquire(self, timeout: float = 10.0) -> None:
+        await self._lock.acquire(timeout=timeout)
+
+    async def lock_release(self) -> None:
+        await self._lock.release()
+
+    async def lock_holders(self) -> list:
+        return await self._lock.holders()
+
+    async def break_lock(self, owner: str, blocklist: bool = True) -> None:
+        await self._lock.break_lock(owner, blocklist=blocklist)
+
+    async def close(self) -> None:
+        await self.lock_release()
 
     async def _save_header(self) -> None:
         # the header itself is never snapshotted: strip the snapc
@@ -343,7 +459,7 @@ class Image:
             await self._save_header()
 
     async def snap_unprotect(self, snap_name: str) -> None:
-        async with _header_lock(self.ioctx.pool_id, self.name):
+        async with _header_lock(self.ioctx, self.name):
             await self._refresh()
             if self.children:
                 raise RadosError(
@@ -361,7 +477,7 @@ class Image:
         """Snapshot-backed copy-on-write child (librbd::CloneRequest):
         the child starts with NO data objects; reads fall through to the
         parent's protected snap within the overlap, writes copy-up."""
-        async with _header_lock(parent_ioctx.pool_id, parent_name):
+        async with _header_lock(parent_ioctx, parent_name):
             parent = await cls.open(parent_ioctx, parent_name)
             meta = parent.snaps.get(snap_name)
             if meta is None:
@@ -413,9 +529,8 @@ class Image:
         return inherited if inherited is not None else b""
 
     async def _detach_parent(self) -> None:
-        async with _header_lock(
-            self.parent["pool"], self.parent["image"]
-        ):
+        pioctx = IoCtx(self.ioctx.objecter, self.parent["pool"])
+        async with _header_lock(pioctx, self.parent["image"]):
             parent = await self._open_parent()
             await parent._refresh()
             parent.children = max(0, parent.children - 1)
