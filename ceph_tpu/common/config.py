@@ -127,6 +127,20 @@ SCHEMA: dict[str, Option] = {
              "concurrent recovery ops per OSD"),
         _opt("osd_op_queue", TYPE_STR, LEVEL_ADVANCED, "wpq",
              "op scheduler inside each OSD op shard: wpq | mclock"),
+        _opt("osd_statfs_total_bytes", TYPE_UINT, LEVEL_ADVANCED,
+             1 << 34,
+             "advertised store capacity per OSD (the role of the real "
+             "disk size BlueStore reads; configurable so tests can fill "
+             "a tiny OSD to the full ratios)"),
+        _opt("mon_osd_nearfull_ratio", TYPE_FLOAT, LEVEL_BASIC, 0.85,
+             "usage ratio above which an OSD is NEARFULL "
+             "(OSDMonitor.cc:365)"),
+        _opt("mon_osd_backfillfull_ratio", TYPE_FLOAT, LEVEL_BASIC, 0.90,
+             "usage ratio above which an OSD refuses to be a backfill "
+             "target"),
+        _opt("mon_osd_full_ratio", TYPE_FLOAT, LEVEL_BASIC, 0.95,
+             "usage ratio above which client writes are refused with "
+             "ENOSPC (deletes still allowed)"),
         _opt("osd_objectstore", TYPE_STR, LEVEL_BASIC, "kstore-file",
              "backing store a daemon-main OSD boots with: kstore-file "
              "(crash-safe WAL FileDB, the default) | memstore "

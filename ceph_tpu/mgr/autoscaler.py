@@ -44,6 +44,15 @@ class PgAutoscaler:
     async def run_once(self, apply: bool = False) -> dict:
         """One autoscale pass: per-pool {current, ideal, action}."""
         osdmap = self.objecter.osdmap
+        # capacity gate: pg splits multiply object placements; growing
+        # pg_num into NEARFULL/FULL osds makes the squeeze worse (the
+        # module's own full-cluster guard)
+        health = await self.objecter.mon.command("health")
+        if any(
+            k in health.get("checks", {})
+            for k in ("OSD_NEARFULL", "OSD_BACKFILLFULL", "OSD_FULL")
+        ):
+            return {"skipped": "cluster near capacity"}
         stats = await self._gather_pool_stats()
         n_up = int(osdmap.max_osd - sum(
             1 for o in range(osdmap.max_osd) if osdmap.is_down(o)

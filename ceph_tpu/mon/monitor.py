@@ -1394,11 +1394,48 @@ class Monitor(Dispatcher):
         now = asyncio.get_event_loop().time()
         agg = {"degraded": 0, "undersized": 0, "backfilling": 0,
                "peering": 0, "inconsistent": 0}
+        nearfull, backfillfull, full = [], [], []
+        near_r = self.config.get("mon_osd_nearfull_ratio")
+        bf_r = self.config.get("mon_osd_backfillfull_ratio")
+        full_r = self.config.get("mon_osd_full_ratio")
         for osd, (t, stats) in list(self._pg_stats.items()):
             if now - t > 30 or self.osdmap.is_down(osd):
                 continue
             for key in agg:
                 agg[key] += int(stats.get(key, 0))
+            st = stats.get("statfs")
+            if st and st.get("total"):
+                ratio = st["used"] / st["total"]
+                if ratio >= full_r:
+                    full.append(osd)
+                elif ratio >= bf_r:
+                    backfillfull.append(osd)
+                elif ratio >= near_r:
+                    nearfull.append(osd)
+        # capacity checks (OSDMonitor.cc:365 full_ratio family): the
+        # reference's OSD_FULL is HEALTH_ERR — writes are being refused
+        if full:
+            checks["OSD_FULL"] = {
+                "severity": "HEALTH_ERR",
+                "summary": f"{len(full)} full osd(s)",
+                "count": len(full),
+                "detail": [f"osd.{o} is full" for o in sorted(full)],
+            }
+        if backfillfull:
+            checks["OSD_BACKFILLFULL"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(backfillfull)} backfillfull osd(s)",
+                "count": len(backfillfull),
+            }
+        if nearfull:
+            checks["OSD_NEARFULL"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(nearfull)} nearfull osd(s)",
+                "count": len(nearfull),
+                "detail": [
+                    f"osd.{o} is near full" for o in sorted(nearfull)
+                ],
+            }
         for key, name, sev, noun in (
             ("degraded", "PG_DEGRADED", "HEALTH_WARN",
              "pgs degraded"),

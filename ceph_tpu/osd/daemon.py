@@ -71,6 +71,12 @@ from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE
 
 _NONE = CRUSH_ITEM_NONE
 
+#: mutations still allowed on a FULL osd: space-freeing ops only
+#: (the reference lets deletes through so the operator can recover)
+_FULL_OK_OPS = {
+    "delete", "rmxattr", "omap_rm", "omap_clear", "unwatch",
+}
+
 
 class _StalePartial(Exception):
     """A prepared sub-stripe RMW found its base superseded by a
@@ -540,6 +546,30 @@ class OSDService(Dispatcher):
             )
         self._note_map(self.osdmap)
 
+    def statfs(self) -> dict:
+        """Store utilization (ObjectStore::statfs): advertised capacity
+        comes from config (the disk-size role), used bytes from the live
+        KV footprint. Cached briefly — the scan is O(rows)."""
+        loop = asyncio.get_event_loop()
+        cached = getattr(self, "_statfs_cache", None)
+        if cached is not None and loop.time() - cached[0] < 0.5:
+            return cached[1]
+        total = self.config.get("osd_statfs_total_bytes")
+        used = self.store.used_bytes()
+        st = {
+            "total": int(total),
+            "used": int(used),
+            "available": max(0, int(total) - int(used)),
+        }
+        self._statfs_cache = (loop.time(), st)
+        return st
+
+    def _is_full(self) -> bool:
+        st = self.statfs()
+        return st["used"] >= st["total"] * self.config.get(
+            "mon_osd_full_ratio"
+        )
+
     async def _loop_lag_watchdog(self) -> None:
         """Samples how late a 10ms sleep fires: the single cheapest
         signal for 'something blocked the event loop' (jax dispatch, a
@@ -924,7 +954,8 @@ class OSDService(Dispatcher):
                 self.config.get("osd_mon_report_interval")
             )
             stats = {"num_pgs": 0, "degraded": 0, "undersized": 0,
-                     "backfilling": 0, "peering": 0, "inconsistent": 0}
+                     "backfilling": 0, "peering": 0, "inconsistent": 0,
+                     "statfs": self.statfs()}
             for (pool_id, ps), pg in list(self.pgs.items()):
                 pool = self.osdmap.pools.get(pool_id)
                 if pool is None:
@@ -2204,6 +2235,24 @@ class OSDService(Dispatcher):
                 reqid = (
                     f"{conn.peer_name}.{conn.peer_nonce}:{p['tid']}"
                 )
+                if (
+                    is_mutating(ops)
+                    and not all(
+                        o["op"] in _FULL_OK_OPS for o in ops
+                    )
+                    and self._is_full()
+                ):
+                    # full handling (OSD::check_full_status / the
+                    # FAILSAFE path of PrimaryLogPG): space-consuming
+                    # writes are refused with ENOSPC once usage crosses
+                    # mon_osd_full_ratio; deletes still run so the
+                    # operator can dig the cluster out
+                    raise OpError(
+                        "ENOSPC",
+                        f"osd.{self.id} is full "
+                        f"({self.statfs()['used']} of "
+                        f"{self.statfs()['total']} bytes)",
+                    )
                 if is_mutating(ops):
                     # EC writes do their heavy lifting BEFORE the PG
                     # lock: full-object writes pre-encode (concurrent

@@ -160,6 +160,15 @@ class KStore:
     def __init__(self, db: KeyValueDB | None = None):
         self.db = db if db is not None else MemDB()
 
+    def used_bytes(self) -> int:
+        """Current store footprint (ObjectStore::statfs 'used' role):
+        live keys + values, so deletes genuinely free space — unlike the
+        WAL's cumulative bytes_logged. O(rows); fine at test scale, a
+        maintained counter when stores grow."""
+        return sum(
+            len(k[1]) + len(v) for k, v in self.db.table.items()
+        )
+
     # -- transactions ---------------------------------------------------------
 
     def queue_transaction(self, txn: Transaction) -> None:
