@@ -156,6 +156,12 @@ SCHEMA: dict[str, Option] = {
         _opt("auth_service_ticket_ttl", TYPE_FLOAT, LEVEL_ADVANCED,
              3600.0,
              "cephx service ticket lifetime; clients renew at half-life"),
+        _opt("mgr_beacon_interval", TYPE_FLOAT, LEVEL_ADVANCED, 0.5,
+             "seconds between mgr liveness beacons to the mon "
+             "(MgrMonitor beacon cadence)"),
+        _opt("mgr_beacon_grace", TYPE_FLOAT, LEVEL_ADVANCED, 3.0,
+             "silence after which the active mgr is considered dead "
+             "and a standby promotes"),
         _opt("mds_beacon_interval", TYPE_FLOAT, LEVEL_ADVANCED, 0.5,
              "seconds between MDS beacons to the mon"),
         _opt("mds_blocklist_expire", TYPE_FLOAT, LEVEL_ADVANCED, 3600.0,

@@ -12,6 +12,9 @@ command path so every daemon and client re-targets on the next epoch.
 
 from ceph_tpu.mgr.autoscaler import PgAutoscaler
 from ceph_tpu.mgr.balancer import BalancerModule
+from ceph_tpu.mgr.daemon import MgrService
 from ceph_tpu.mgr.prometheus import PrometheusExporter
 
-__all__ = ["BalancerModule", "PgAutoscaler", "PrometheusExporter"]
+__all__ = [
+    "BalancerModule", "MgrService", "PgAutoscaler", "PrometheusExporter",
+]

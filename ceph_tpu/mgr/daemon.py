@@ -1,0 +1,94 @@
+"""MgrService: the manager DAEMON (ceph-mgr, src/mgr + MgrMonitor).
+
+Round 4's module tier (balancer / autoscaler / prometheus) ran as
+client-side library code with no lifecycle. Now the modules are hosted
+by a daemon with a mon-governed identity: every mgr beacons to the mon
+(MgrMonitor's beacon flow, the same admit/promote shape as MDS
+beacons), exactly one is ACTIVE in the paxos-replicated MgrMap, and
+when the active goes silent past mgr_beacon_grace a standby's next
+beacon promotes it. Only the active runs module work; a demoted/revived
+mgr re-admits as standby.
+
+Reference: src/mon/MgrMonitor.cc (map + failover), src/mgr/MgrStandby.cc
+(active/standby daemon states), src/pybind/mgr (the hosted module tier).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.rados.client import Objecter
+
+
+class MgrService:
+    def __init__(
+        self, name: str, monmap, config: Config | None = None,
+        keyring: dict[str, bytes] | None = None,
+    ):
+        self.name = name
+        self.config = config if config is not None else Config()
+        self.objecter = Objecter(
+            name, monmap, config=self.config, keyring=keyring
+        )
+        self.active = False
+        self._stopped = False
+        self._tasks: list[asyncio.Task] = []
+        #: lazily built when active: module name -> instance
+        self.modules: dict[str, object] = {}
+
+    async def start(self) -> None:
+        await self.objecter.start()
+        self._tasks.append(asyncio.create_task(self._beacon_loop()))
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.objecter.close()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def _beacon_loop(self) -> None:
+        interval = self.config.get("mgr_beacon_interval")
+        while not self._stopped:
+            try:
+                rep = await self.objecter.mon.command(
+                    "mgr beacon", {"name": self.name}, timeout=5.0
+                )
+                was = self.active
+                self.active = (
+                    rep["mgrmap"].get("active") == self.name
+                )
+                if self.active and not was:
+                    self._activate()
+            except Exception:
+                pass  # mon churn: next beacon retries
+            await asyncio.sleep(interval)
+
+    def _activate(self) -> None:
+        """Instantiate the module tier (MgrStandby::handle_mgr_map's
+        active transition). Modules are plain objects over our objecter;
+        operators drive them through this daemon from now on."""
+        from ceph_tpu.mgr.autoscaler import PgAutoscaler
+        from ceph_tpu.mgr.balancer import BalancerModule
+        from ceph_tpu.mgr.prometheus import PrometheusExporter
+
+        self.modules = {
+            "balancer": BalancerModule(self.objecter.mon),
+            "pg_autoscaler": PgAutoscaler(self.objecter),
+            "prometheus": PrometheusExporter(self.objecter),
+        }
+
+    # -- module surface --------------------------------------------------------
+
+    async def prometheus_scrape(self) -> str:
+        """The /metrics endpoint body (only the active serves it)."""
+        if not self.active:
+            raise RuntimeError(f"{self.name} is standby")
+        return await self.modules["prometheus"].collect()

