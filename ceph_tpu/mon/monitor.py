@@ -1092,6 +1092,62 @@ class Monitor(Dispatcher):
             return await self._cmd_auth(cmd, args, conn)
         if cmd == "osd pool create":
             return await self._cmd_pool_create(args)
+        if cmd.startswith("osd tier "):
+            # OSDMonitor's tier command family (`osd tier add|cache-mode|
+            # set-overlay|remove-overlay|remove`): wires a CACHE pool in
+            # front of a BASE pool (PrimaryLogPG.cc promote/flush paths
+            # consume these pg_pool_t fields)
+            import copy as _copy
+
+            sub = cmd[len("osd tier "):]
+            pools = self.osdmap.pools
+            new_pools: dict = {}
+
+            def edited(pid):
+                if pid not in new_pools:
+                    if pid not in pools:
+                        raise ValueError(f"no pool {pid}")
+                    new_pools[pid] = _copy.deepcopy(pools[pid])
+                return new_pools[pid]
+
+            if sub == "add":
+                base, cache = int(args["base"]), int(args["cache"])
+                if edited(cache).is_erasure():
+                    raise ValueError("cache pool must be replicated")
+                edited(cache).tier_of = base
+            elif sub == "cache-mode":
+                mode = args["mode"]
+                if mode not in ("", "none", "writeback"):
+                    raise ValueError(f"unsupported cache mode {mode!r}")
+                pool = edited(int(args["pool"]))
+                if pool.tier_of < 0:
+                    raise ValueError("pool is not a tier")
+                pool.cache_mode = "" if mode == "none" else mode
+            elif sub == "set-overlay":
+                base, cache = int(args["base"]), int(args["cache"])
+                if edited(cache).tier_of != base:
+                    raise ValueError("cache is not a tier of base")
+                edited(base).read_tier = cache
+                edited(base).write_tier = cache
+            elif sub == "remove-overlay":
+                base = int(args["base"])
+                edited(base).read_tier = -1
+                edited(base).write_tier = -1
+            elif sub == "remove":
+                base, cache = int(args["base"]), int(args["cache"])
+                if (pools[base].read_tier == cache
+                        or pools[base].write_tier == cache):
+                    raise ValueError("remove the overlay first")
+                edited(cache).tier_of = -1
+                edited(cache).cache_mode = ""
+            else:
+                raise ValueError(f"unknown tier command {sub!r}")
+            await self._propose_osdmap(
+                Incremental(
+                    epoch=self.osdmap.epoch + 1, new_pools=new_pools
+                )
+            )
+            return {}
         if cmd == "osd blocklist":
             # OSDMonitor's `osd blocklist add|rm|ls` (the fencing lever:
             # src/osd/OSDMap.h:579 blacklist + options.cc

@@ -404,6 +404,11 @@ class OSDService(Dispatcher):
              "helper bytes read via fractional sub-chunk repair"),
             ("scrub_errors", "inconsistencies found by scrub"),
             ("heartbeat_failures", "peer failures reported to the mon"),
+            ("tier_hit", "cache-pool ops served from the cache"),
+            ("tier_promote", "objects promoted from the base pool"),
+            ("tier_miss", "cache misses with no base object either"),
+            ("tier_flush", "dirty objects flushed to the base pool"),
+            ("tier_evict", "clean objects evicted from the cache"),
         ):
             self.perf.add_u64_counter(key, desc)
         # write-path leg timings (the l_* time_avg family the reference
@@ -2050,6 +2055,315 @@ class OSDService(Dispatcher):
                 self.perf.inc("subop_w")
         self._reply_peer(conn, p["tid"], {"ok": True})
 
+    # -- cache tiering (PrimaryLogPG promote/flush/proxy, .cc:2341/2305) ------
+
+    TIER_DIRTY_XATTR = "_cache_dirty"
+
+    async def _h_obj_copy_get(self, conn, p) -> None:
+        self._enqueue_subop(p, self._do_obj_copy_get, conn)
+
+    async def _do_obj_copy_get(self, conn, p) -> None:
+        """Full object state for copy-from/promote/flush (the
+        object_copy_data_t GET side, PrimaryLogPG::do_copy_get)."""
+        pg = self._pg_of(p["pgid"])
+        name = p["name"]
+        ec = self.codec(pg.pool)
+        async with pg.lock:
+            acting, _primary = self.acting_of(pg.pool, pg.ps)
+            if ec is None:
+                state = self._load_state_local(pg, name)
+            else:
+                state = await self._load_state_ec(
+                    pg, acting, name, need_data=True
+                )
+            if not state.exists:
+                self._reply_peer(
+                    conn, p["tid"], {"ok": False, "errno": "ENOENT"}
+                )
+                return
+            omap = {}
+            if ec is None:
+                try:
+                    omap = self.store.omap_get(pg.coll, name)
+                except StoreError:
+                    omap = {}
+            self._reply_peer(
+                conn, p["tid"],
+                {"ok": True,
+                 "xattrs": {k: v.hex()
+                            for k, v in state.xattrs.items()},
+                 "omap": {k.hex(): v.hex()
+                          for k, v in (omap or {}).items()}},
+                raw=bytes(state.data),
+            )
+
+    async def _h_tier_put(self, conn, p) -> None:
+        self._enqueue_subop(p, self._do_tier_put, conn)
+
+    async def _do_tier_put(self, conn, p) -> None:
+        """Apply a full-object state at this (base-pool) primary — the
+        flush/copy-from WRITE side. Runs the normal primary mutation so
+        it replicates/EC-encodes like any client write."""
+        pg = self._pg_of(p["pgid"])
+        try:
+            if self.codec(pg.pool) is not None and p.get("omap"):
+                p = dict(p)
+                p.pop("omap")  # EC base: omap cannot land, drop it
+            async with pg.lock:
+                acting, _primary = self.acting_of(pg.pool, pg.ps)
+                await self._primary_ops(
+                    pg, acting, p["name"],
+                    self._state_put_ops(p), [p["_raw"]], None,
+                )
+            self._reply_peer(conn, p["tid"], {"ok": True})
+        except Exception as e:
+            self._reply_peer(
+                conn, p["tid"], {"ok": False, "error": str(e)}
+            )
+
+    async def _h_tier_delete(self, conn, p) -> None:
+        self._enqueue_subop(p, self._do_tier_delete, conn)
+
+    async def _do_tier_delete(self, conn, p) -> None:
+        pg = self._pg_of(p["pgid"])
+        try:
+            async with pg.lock:
+                acting, _primary = self.acting_of(pg.pool, pg.ps)
+                await self._primary_ops(
+                    pg, acting, p["name"], [{"op": "delete"}], [], None,
+                )
+            self._reply_peer(conn, p["tid"], {"ok": True})
+        except OpError as e:
+            ok = e.code == "ENOENT"  # deleting the never-flushed is fine
+            self._reply_peer(
+                conn, p["tid"], {"ok": ok, "errno": e.code}
+            )
+        except Exception as e:
+            self._reply_peer(
+                conn, p["tid"], {"ok": False, "error": str(e)}
+            )
+
+    @staticmethod
+    def _state_put_ops(p) -> list[dict]:
+        """write_full + xattr/omap restore vector from a copy payload;
+        the cache's own dirty flag never travels to the base."""
+        ops = [{"op": "write_full"}]
+        for k, vhex in (p.get("xattrs") or {}).items():
+            if k == OSDService.TIER_DIRTY_XATTR:
+                continue
+            ops.append({"op": "setxattr", "name": k, "value": vhex})
+        if p.get("omap"):
+            ops.append({"op": "omap_set", "kv": dict(p["omap"])})
+        return ops
+
+    async def _expand_copy_from(
+        self, pool_id: int, ops: list[dict], datas: list[bytes]
+    ) -> tuple[list[dict], list[bytes]]:
+        out_ops, out_datas, di = [], [], 0
+        consuming = {"write", "write_full", "append"}
+        for op in ops:
+            if op["op"] != "copy_from":
+                out_ops.append(op)
+                if op["op"] in consuming:
+                    out_datas.append(datas[di])
+                    di += 1
+                continue
+            src = await self._tier_get(
+                int(op.get("src_pool", pool_id)), op["src_name"]
+            )
+            if src is None:
+                raise OpError(
+                    "ENOENT", f"copy_from: no object {op['src_name']!r}"
+                )
+            if self.codec(pool_id) is not None:
+                # EC destinations have no omap (ECBackend's EOPNOTSUPP);
+                # data + xattrs travel, omap is dropped like the
+                # reference's copy-get omap gate
+                src = dict(src)
+                src.pop("omap", None)
+            out_ops.extend(self._state_put_ops(src))
+            out_datas.append(src["_raw"])
+        return out_ops, out_datas
+
+    def _tier_primary_of(self, pool_id: int, name: str) -> int:
+        ps = self.object_pg(pool_id, name)
+        _acting, primary = self.acting_of(pool_id, ps)
+        return primary
+
+    async def _tier_call(
+        self, pool_id: int, name: str, mtype: str, payload: dict,
+        raw: bytes = b"",
+    ) -> dict:
+        """Internal op against another pool's primary (which may be this
+        very daemon — then the handler runs locally via a loopback
+        conn-less path to keep one code path)."""
+        primary = self._tier_primary_of(pool_id, name)
+        ps = self.object_pg(pool_id, name)
+        payload = dict(payload)
+        payload["pgid"] = [pool_id, ps]
+        payload["name"] = name
+        return await self._peer_call(
+            primary, mtype, payload, timeout=10.0, raw=raw
+        )
+
+    async def _tier_get(self, pool_id: int, name: str) -> dict | None:
+        rep = await self._tier_call(pool_id, name, "obj_copy_get", {})
+        if not rep.get("ok"):
+            if rep.get("errno") == "ENOENT":
+                return None
+            raise RuntimeError(rep.get("error", "copy-get failed"))
+        return rep
+
+    def _tier_dirty_set(self, pg: PG) -> dict:
+        if not hasattr(pg, "tier_dirty"):
+            pg.tier_dirty = {}  # name -> True, insertion-ordered
+        return pg.tier_dirty
+
+    def _tier_exists_here(self, pg: PG, name: str) -> bool:
+        e = pg.latest_objects().get(name)
+        return e is not None and e["kind"] != "delete"
+
+    async def _tier_promote(
+        self, pool, pg: PG, acting, name: str
+    ) -> bool:
+        """Copy the base pool's object into the cache PG (clean).
+        Returns False when the base has no such object either."""
+        src = await self._tier_get(pool.tier_of, name)
+        if src is None:
+            self.perf.inc("tier_miss")
+            return False
+        async with pg.lock:
+            if not self._tier_exists_here(pg, name):  # re-check: raced
+                await self._primary_ops(
+                    pg, acting, name,
+                    self._state_put_ops(src), [src["_raw"]], None,
+                )
+        self.perf.inc("tier_promote")
+        return True
+
+    async def _tier_flush(
+        self, pool, pg: PG, acting, name: str, evict: bool = False
+    ) -> None:
+        """Write the cached object back to the base pool, clear its
+        dirty mark (and optionally evict the now-clean copy)."""
+        async with pg.lock:
+            state = self._load_state_local(pg, name)
+            if not state.exists:
+                self._tier_dirty_set(pg).pop(name, None)
+                return
+            payload = {
+                "xattrs": {k: v.hex() for k, v in state.xattrs.items()},
+            }
+            try:
+                omap = self.store.omap_get(pg.coll, name)
+            except StoreError:
+                omap = {}
+            if omap:
+                payload["omap"] = {
+                    k.hex(): v.hex() for k, v in omap.items()
+                }
+            data = bytes(state.data)
+        dirty = self.TIER_DIRTY_XATTR in payload["xattrs"]
+        if dirty:
+            rep = await self._tier_call(
+                pool.tier_of, name, "tier_put", payload, raw=data
+            )
+            if not rep.get("ok"):
+                raise RuntimeError(rep.get("error", "tier flush failed"))
+            async with pg.lock:
+                await self._primary_ops(
+                    pg, acting, name,
+                    [{"op": "rmxattr",
+                      "name": self.TIER_DIRTY_XATTR}], [], None,
+                )
+            self.perf.inc("tier_flush")
+        self._tier_dirty_set(pg).pop(name, None)
+        if evict:
+            async with pg.lock:
+                await self._primary_ops(
+                    pg, acting, name, [{"op": "delete"}], [], None,
+                )
+            self.perf.inc("tier_evict")
+
+    async def _tier_agent(self, pool, pg: PG, acting) -> None:
+        """Flush oldest dirty objects once the PG exceeds the pool's
+        dirty budget (the tier agent's dirty_ratio trigger). One agent
+        per PG: a concurrent pair would pick the same oldest name and
+        flush it twice."""
+        if getattr(pg, "tier_agent_busy", False):
+            return
+        pg.tier_agent_busy = True
+        try:
+            dirty = self._tier_dirty_set(pg)
+            while len(dirty) > pool.cache_target_dirty_max:
+                name = next(iter(dirty))
+                try:
+                    await self._tier_flush(pool, pg, acting, name)
+                except Exception:
+                    dirty.pop(name, None)  # retried on the next trigger
+        finally:
+            pg.tier_agent_busy = False
+
+    async def _tier_before_op(
+        self, conn, p, pool, pg: PG, acting, name: str
+    ) -> bool:
+        """Writeback-cache behavior in front of the normal op dispatch.
+        Returns True when the op was fully handled (replied) here."""
+        op = p.get("op")
+        if op in ("cache_flush", "cache_evict"):
+            # explicit per-object flush/evict (the rados cache-flush /
+            # cache-evict commands; the test's determinism lever)
+            try:
+                await self._tier_flush(
+                    pool, pg, acting, name, evict=(op == "cache_evict")
+                )
+                reply = {"tid": p["tid"], "ok": True}
+            except Exception as e:
+                reply = {"tid": p["tid"], "ok": False,
+                         "error": str(e)}
+            conn.send_message(
+                Message(type="osd_op_reply", tid=p["tid"],
+                        epoch=self.osdmap.epoch,
+                        data=json.dumps(reply).encode())
+            )
+            return True
+        if op == "delete":
+            # deletes write through: cache copy AND base object go
+            # (mini semantics — the reference caches a whiteout)
+            try:
+                await self._tier_call(
+                    pool.tier_of, name, "tier_delete", {}
+                )
+            except Exception:
+                pass
+            self._tier_dirty_set(pg).pop(name, None)
+            return False
+        if not self._tier_exists_here(pg, name):
+            await self._tier_promote(pool, pg, acting, name)
+        else:
+            self.perf.inc("tier_hit")
+        # mutating vectors mark the cached object dirty atomically
+        if op == "write":
+            p["op"] = "ops"
+            p["ops"] = [
+                {"op": "write_full"},
+                {"op": "setxattr", "name": self.TIER_DIRTY_XATTR,
+                 "value": b"1".hex()},
+            ]
+            p["data_lens"] = [len(p["_raw"])]
+        elif op == "ops" and is_mutating(p.get("ops") or []):
+            p["ops"] = list(p["ops"]) + [
+                {"op": "setxattr", "name": self.TIER_DIRTY_XATTR,
+                 "value": b"1".hex()},
+            ]
+        else:
+            return False
+        dirty = self._tier_dirty_set(pg)
+        dirty.pop(name, None)
+        dirty[name] = True
+        self._spawn(self._tier_agent(pool, pg, acting))
+        return False
+
     def _pg_of(self, pgid) -> PG:
         key = (pgid[0], pgid[1])
         if key not in self.pgs:
@@ -2218,6 +2532,17 @@ class OSDService(Dispatcher):
                 raise RuntimeError(
                     f"pg {pool_id}.{ps} is peering"
                 )  # retryable: no errno, the client resends
+            pool = self.osdmap.pools.get(pool_id)
+            if (
+                pool is not None
+                and pool.tier_of >= 0
+                and pool.cache_mode == "writeback"
+            ):
+                handled = await self._tier_before_op(
+                    conn, p, pool, pg, acting, name
+                )
+                if handled:
+                    return
             reply_raw = b""
             if p["op"] in ("ops", "write", "delete"):
                 if p["op"] == "ops":
@@ -2229,6 +2554,15 @@ class OSDService(Dispatcher):
                     ops, datas = [{"op": "write_full"}], [p["_raw"]]
                 else:
                     ops, datas = [{"op": "delete"}], []
+                if any(o["op"] == "copy_from" for o in ops):
+                    # CEPH_OSD_OP_COPY_FROM (PrimaryLogPG.cc:5622): the
+                    # DEST primary fetches the source object server-side
+                    # (any pool, its own included) and applies it as a
+                    # normal mutation vector — so it replicates/encodes
+                    # exactly like a client write
+                    ops, datas = await self._expand_copy_from(
+                        pool_id, ops, datas
+                    )
                 # instance nonce distinguishes a restarted client whose
                 # fresh tid counter would otherwise collide with its old
                 # reqids (osd_reqid_t carries the client instance too)

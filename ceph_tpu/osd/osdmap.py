@@ -513,7 +513,7 @@ def _dec_pg(d) -> tuple:
 
 def _enc_pool(e, p: PgPool) -> None:
     e.struct(
-        2,
+        3,
         1,
         lambda b: b.u32(p.pg_num)
         .u32(p.pgp_num)
@@ -524,7 +524,12 @@ def _enc_pool(e, p: PgPool) -> None:
         .u64(p.flags)
         .string(p.erasure_code_profile)
         .u64(p.snap_seq)
-        .list(sorted(p.removed_snaps), lambda ee, s: ee.u64(s)),
+        .list(sorted(p.removed_snaps), lambda ee, s: ee.u64(s))
+        .s32(p.tier_of)
+        .s32(p.read_tier)
+        .s32(p.write_tier)
+        .string(p.cache_mode)
+        .u32(p.cache_target_dirty_max),
     )
 
 
@@ -543,9 +548,15 @@ def _dec_pool(d) -> PgPool:
         if version >= 2:
             p.snap_seq = b.u64()
             p.removed_snaps = b.list(lambda dd: dd.u64())
+        if version >= 3:
+            p.tier_of = b.s32()
+            p.read_tier = b.s32()
+            p.write_tier = b.s32()
+            p.cache_mode = b.string()
+            p.cache_target_dirty_max = b.u32()
         return p
 
-    return d.struct(2, body)
+    return d.struct(3, body)
 
 
 def _enc_profile(e, prof: dict) -> None:

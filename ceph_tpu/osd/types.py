@@ -59,6 +59,18 @@ class PgPool:
     #: deleted snap ids (pg_pool_t::removed_snaps interval_set, as a flat
     #: list at mini scale); OSDs trim clones covered only by removed snaps
     removed_snaps: list = field(default_factory=list)
+    #: cache tiering (pg_pool_t::tier_of / read_tier / write_tier /
+    #: cache_mode, osd_types.h): `tier_of` on the CACHE pool names its
+    #: base; `read_tier`/`write_tier` on the BASE pool name the overlay
+    #: the Objecter redirects to; cache_mode "" | "writeback"
+    tier_of: int = -1
+    read_tier: int = -1
+    write_tier: int = -1
+    cache_mode: str = ""
+    #: dirty objects a cache PG primary tolerates before the tier agent
+    #: flushes to the base pool (cache_target_dirty_ratio's object-count
+    #: role at mini scale)
+    cache_target_dirty_max: int = 8
 
     def __post_init__(self):
         if not self.pgp_num:

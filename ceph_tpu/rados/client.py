@@ -213,8 +213,19 @@ class Objecter(Dispatcher):
 
     # -- targeting ------------------------------------------------------------
 
+    def _effective_pool(self, pool_id: int) -> int:
+        """Cache-tier overlay redirect (Objecter::_calc_target's
+        read_tier/write_tier handling): IO aimed at a base pool with an
+        overlay goes to the cache pool; the cache PG promotes/flushes
+        against the base."""
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is not None and pool.read_tier >= 0:
+            return pool.read_tier
+        return pool_id
+
     def _calc_target(self, pool_id: int, name: str) -> int:
         """pool -> ps -> up/acting -> primary (Objecter::_calc_target)."""
+        pool_id = self._effective_pool(pool_id)
         pool = self.osdmap.pools.get(pool_id)
         if pool is None:
             raise RadosError(f"no pool {pool_id}")
@@ -250,6 +261,7 @@ class Objecter(Dispatcher):
         tid = next(self._tids)
         while asyncio.get_event_loop().time() < deadline:
             try:
+                eff_pool = self._effective_pool(pool_id)
                 primary = self._calc_target(pool_id, name)
                 addr = self.osdmap.osd_addrs.get(primary)
                 if addr is None:
@@ -258,7 +270,8 @@ class Objecter(Dispatcher):
                 last_error = str(e)
                 await self._refresh_map()
                 continue
-            payload = {"tid": tid, "pool": pool_id, "name": name, "op": op}
+            payload = {"tid": tid, "pool": eff_pool, "name": name,
+                       "op": op}
             if extra:
                 payload.update(extra)
             fut = asyncio.get_event_loop().create_future()
@@ -415,6 +428,31 @@ class IoCtx:
         await self.objecter.op_submit(
             self.pool_id, name, "delete", extra=extra
         )
+
+    async def copy_from(
+        self, dst_name: str, src_name: str,
+        src_pool: int | None = None,
+    ) -> None:
+        """Server-side object copy (CEPH_OSD_OP_COPY_FROM,
+        rados_write_op copy_from): the destination primary pulls the
+        source object — data + xattrs + omap — itself; the bytes never
+        visit this client."""
+        await self.operate(
+            dst_name,
+            [{"op": "copy_from", "src_name": src_name,
+              "src_pool": (self.pool_id if src_pool is None
+                           else src_pool)}],
+        )
+
+    async def cache_flush(self, name: str) -> None:
+        """Flush a dirty cache-tier object to its base pool (the
+        `rados cache-flush` op)."""
+        await self.objecter.op_submit(self.pool_id, name, "cache_flush")
+
+    async def cache_evict(self, name: str) -> None:
+        """Flush if dirty, then drop the cached copy (`rados
+        cache-evict`)."""
+        await self.objecter.op_submit(self.pool_id, name, "cache_evict")
 
     async def stat(self, name: str) -> dict:
         st = await self.objecter.op_submit(self.pool_id, name, "stat")

@@ -1,0 +1,189 @@
+"""copy-from + writeback cache tiering (VERDICT r4 missing #3).
+
+The last whole op family missing from the data path: CEPH_OSD_OP_COPY_FROM
+(PrimaryLogPG.cc:5622) — server-side object copy the destination primary
+performs itself — and the writeback tier built on it
+(PrimaryLogPG.cc:2341 promote_object / the tier agent's flush+evict):
+a replicated CACHE pool in front of an EC BASE pool, Objecter IO
+redirected by the overlay, misses promoted from the base, writes marked
+dirty and flushed back, clean copies evicted.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados.client import Rados, RadosError
+from tests.test_cluster_live import (
+    EC_POOL,
+    REP_POOL,
+    Cluster,
+    wait_until,
+)
+
+CACHE_POOL = 7
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 240))
+
+
+def counters(cluster, key) -> int:
+    return sum(
+        o.perf.dump().get(key, 0) for o in cluster.osds.values()
+    )
+
+
+async def setup_tier(cluster, admin):
+    await cluster.create_pools(admin)
+    await admin.mon_command(
+        "osd pool create",
+        {"pool_id": CACHE_POOL, "crush_rule": 1, "size": 3, "pg_num": 8},
+    )
+    await admin.mon_command(
+        "osd tier add", {"base": EC_POOL, "cache": CACHE_POOL}
+    )
+    await admin.mon_command(
+        "osd tier cache-mode",
+        {"pool": CACHE_POOL, "mode": "writeback"},
+    )
+    await admin.mon_command(
+        "osd tier set-overlay",
+        {"base": EC_POOL, "cache": CACHE_POOL},
+    )
+    # every OSD must see the overlay before IO starts
+    epoch = admin.objecter.osdmap.epoch
+    await wait_until(
+        lambda: all(
+            o.osdmap.epoch >= epoch for o in cluster.osds.values()
+        ),
+        timeout=30,
+    )
+
+
+def test_copy_from_between_pools():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        admin = Rados("client.admin", cluster.monmap, config=cluster.cfg)
+        await admin.connect()
+        await cluster.create_pools(admin)
+        rep = admin.io_ctx(REP_POOL)
+        ec = admin.io_ctx(EC_POOL)
+
+        payload = b"copy me" * 500
+        await rep.write_full("src", payload)
+        await rep.setxattr("src", "color", b"blue")
+        await rep.omap_set("src", {b"k1": b"v1"})
+
+        # same-pool server-side copy
+        await rep.copy_from("dst", "src")
+        assert await rep.read("dst") == payload
+        assert await rep.getxattr("dst", "color") == b"blue"
+        assert (await rep.omap_get("dst")).get(b"k1") == b"v1"
+
+        # cross-pool: replicated -> EC (no omap on EC, data+xattr travel)
+        await ec.copy_from("dst-ec", "src", src_pool=REP_POOL)
+        assert await ec.read("dst-ec") == payload
+        assert await ec.getxattr("dst-ec", "color") == b"blue"
+
+        # missing source is a typed error (ENOENT -> ObjectNotFound)
+        from ceph_tpu.rados.client import ObjectNotFound
+
+        with pytest.raises(ObjectNotFound, match="no object"):
+            await rep.copy_from("dst2", "no-such-object")
+
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_writeback_tier_promote_flush_evict():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        admin = Rados("client.admin", cluster.monmap, config=cluster.cfg)
+        await admin.connect()
+        await setup_tier(cluster, admin)
+        io = admin.io_ctx(EC_POOL)  # overlay redirects this to the cache
+        payload = b"tiered" * 700
+
+        # write rides the cache pool; the base stays empty until a flush
+        await io.write_full("obj", payload)
+        assert await io.read("obj") == payload
+        assert counters(cluster, "tier_hit") >= 1  # the read hit cache
+
+        some_osd = next(iter(cluster.osds.values()))
+        assert await some_osd._tier_get(EC_POOL, "obj") is None
+
+        # flush: the EC base pool now holds the object; cache stays
+        await io.cache_flush("obj")
+        assert counters(cluster, "tier_flush") == 1
+        base_copy = await some_osd._tier_get(EC_POOL, "obj")
+        assert base_copy is not None and base_copy["_raw"] == payload
+
+        # evict: drop the (now clean) cached copy...
+        await io.cache_evict("obj")
+        assert counters(cluster, "tier_evict") == 1
+
+        # ...and the next read MISSES the cache and promotes from base
+        before = counters(cluster, "tier_promote")
+        assert await io.read("obj") == payload
+        assert counters(cluster, "tier_promote") == before + 1
+
+        # overwrite after promote: dirty again, flush carries the new
+        # version to the base
+        await io.write_full("obj", b"v2" * 100)
+        await io.cache_flush("obj")
+        base_copy = await some_osd._tier_get(EC_POOL, "obj")
+        assert base_copy["_raw"] == b"v2" * 100
+
+        # delete writes through: cache AND base both drop it
+        await io.remove("obj")
+        with pytest.raises(RadosError, match="no such object"):
+            await io.read("obj")
+        assert await some_osd._tier_get(EC_POOL, "obj") is None
+
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_tier_agent_flushes_past_dirty_budget():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        admin = Rados("client.admin", cluster.monmap, config=cluster.cfg)
+        await admin.connect()
+        await setup_tier(cluster, admin)
+        io = admin.io_ctx(EC_POOL)
+
+        # enough dirty objects that some PG exceeds its budget (8):
+        # the agent must flush the overflow to the base on its own
+        for i in range(120):
+            await io.write_full(f"agent-{i}", b"d" * 256)
+        await wait_until(
+            lambda: counters(cluster, "tier_flush") > 0, timeout=60
+        )
+        # let the agent settle (flush counter stable for a second)
+        loop = asyncio.get_event_loop()
+        stable_since, last = loop.time(), counters(cluster, "tier_flush")
+        while loop.time() - stable_since < 1.0:
+            await asyncio.sleep(0.2)
+            cur = counters(cluster, "tier_flush")
+            if cur != last:
+                stable_since, last = loop.time(), cur
+        # flushed objects really are in the base pool
+        some_osd = next(iter(cluster.osds.values()))
+        found = 0
+        for i in range(120):
+            if await some_osd._tier_get(EC_POOL, f"agent-{i}"):
+                found += 1
+        assert found == counters(cluster, "tier_flush") == last
+
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
