@@ -190,7 +190,15 @@ class CephFSClient:
         got = await self.open(path, mode="w")
         ino = got["ino"]
         epoch = self._revoked.get(ino, 0)
-        await self.striper.write(_file_soid(ino), data)
+        # the open reply carries the realm chain's snap context
+        # (SnapRealm propagation with the cap): writes apply it so the
+        # OSD clones objects on first-write-after-snap
+        saved = self.ioctx.snapc
+        self.ioctx.snapc = got.get("snapc")
+        try:
+            await self.striper.write(_file_soid(ino), data)
+        finally:
+            self.ioctx.snapc = saved
         if self._revoked.get(ino, 0) == epoch:
             self._cache[ino] = data  # no revoke raced the write
         return ino
@@ -198,6 +206,17 @@ class CephFSClient:
     async def read_file(self, path: str) -> bytes:
         got = await self.open(path, mode="r")
         ino = got["ino"]
+        if got.get("snapid") is not None:
+            # a .snap path: read the striped objects AT the snapid;
+            # never cached (past data has no cap protection to need)
+            saved = self.ioctx.read_snap
+            self.ioctx.read_snap = got["snapid"]
+            try:
+                return await self.striper.read(_file_soid(ino))
+            except ObjectNotFound:
+                return b""
+            finally:
+                self.ioctx.read_snap = saved
         cached = self._cache.get(ino)
         if cached is not None:
             return cached  # cap-protected cache: revoke drops it
@@ -209,6 +228,19 @@ class CephFSClient:
         if self._revoked.get(ino, 0) == epoch:
             self._cache[ino] = data  # no revoke raced the read
         return data
+
+    async def mksnap(self, dirpath: str, name: str) -> int:
+        """mkdir <dir>/.snap/<name> (the .snap pseudo-directory)."""
+        base = dirpath.rstrip("/")
+        return (await self._request(
+            {"op": "mkdir", "path": f"{base}/.snap/{name}"}
+        ))["snapid"]
+
+    async def rmsnap(self, dirpath: str, name: str) -> None:
+        base = dirpath.rstrip("/")
+        await self._request(
+            {"op": "rmdir", "path": f"{base}/.snap/{name}"}
+        )
 
     async def unlink(self, path: str) -> None:
         await self._request({"op": "unlink", "path": path})

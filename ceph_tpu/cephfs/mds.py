@@ -227,11 +227,39 @@ class MDSService:
                 try:
                     from ceph_tpu.rados.striper import RadosStriper
 
-                    await RadosStriper(self.ioctx).remove(
-                        _file_soid(ev["ino"])
-                    )
+                    # deletes carry the realm's snap context so clones
+                    # under live snapshots survive the head removal
+                    saved = self.ioctx.snapc
+                    self.ioctx.snapc = ev.get("snapc")
+                    try:
+                        await RadosStriper(self.ioctx).remove(
+                            _file_soid(ev["ino"])
+                        )
+                    finally:
+                        self.ioctx.snapc = saved
                 except (ObjectNotFound, RadosError):
                     pass
+        elif op == "mksnap":
+            realm = await self._realm(ev["dir"])
+            realm[ev["name"]] = {
+                "snapid": ev["snapid"], "children": ev["children"],
+            }
+            await self.ioctx.setxattr(
+                _dir_obj(ev["dir"]), "snaps",
+                json.dumps(realm, sort_keys=True).encode(),
+            )
+        elif op == "rmsnap":
+            realm = await self._realm(ev["dir"])
+            if ev["name"] in realm:
+                del realm[ev["name"]]
+                await self.ioctx.setxattr(
+                    _dir_obj(ev["dir"]), "snaps",
+                    json.dumps(realm, sort_keys=True).encode(),
+                )
+            try:
+                await self.ioctx.selfmanaged_snap_remove(ev["snapid"])
+            except RadosError:
+                pass  # replay: already removed from the pool
         elif op == "rmdir":
             try:
                 await self.ioctx.exec(
@@ -259,6 +287,49 @@ class MDSService:
                 pass
         else:
             raise MDSError("EINVAL", f"unknown journal op {op!r}")
+
+    # -- snapshots (SnapRealm-lite, src/mds/SnapRealm.h:27) --------------------
+    #
+    # A directory is a realm root: `mkdir D/.snap/<name>` allocates a
+    # pool snapid (the selfmanaged allocator), captures D's entries, and
+    # journals the record into D's dir-object xattr — so realms live in
+    # RADOS (surviving failover) and replay idempotently. File DATA
+    # versioning rides the existing selfmanaged-snap machinery: `open`
+    # replies carry the path's accumulated snap context, client writes
+    # apply it, and the OSD clones objects on first-write-after-snap.
+    # Reads at `D/.snap/<name>/file` resolve to (ino, snapid) and the
+    # client reads the striped objects at that snapid. Mini reductions
+    # (documented): captured listings are one level deep, and a write
+    # whose open predates a concurrent mksnap carries the older context.
+
+    async def _realm(self, ino: int) -> dict:
+        """{snapname: {"snapid": N, "children": {...}}} for a dir."""
+        try:
+            raw = await self.ioctx.getxattr(_dir_obj(ino), "snaps")
+        except (ObjectNotFound, RadosError):
+            return {}
+        return json.loads(raw)
+
+    async def _path_snaps(self, parts: list[str]) -> tuple[int, list]:
+        """Resolve a dir path accumulating every ancestor realm's
+        snapids (the realm-chain walk clients get with their caps)."""
+        ino = ROOT_INO
+        snaps = [s["snapid"] for s in (await self._realm(ino)).values()]
+        for name in parts:
+            entry = (await self._entries(ino)).get(name)
+            if entry is None or entry["type"] != "dir":
+                raise MDSError("ENOENT", f"no directory {name!r}")
+            ino = entry["ino"]
+            snaps += [
+                s["snapid"] for s in (await self._realm(ino)).values()
+            ]
+        return ino, sorted(snaps)
+
+    @staticmethod
+    def _snapc_of(snaps: list) -> dict | None:
+        if not snaps:
+            return None
+        return {"seq": max(snaps), "snaps": sorted(snaps, reverse=True)}
 
     # -- namespace helpers -----------------------------------------------------
 
@@ -438,6 +509,64 @@ class MDSService:
                 {"op": "mkfs", "ino": ino, **rid}
             )
             return {}
+        parts = self._split(p["path"]) if "path" in p else []
+        if op == "mkdir" and len(parts) >= 2 and parts[-2] == ".snap":
+            # mkdir D/.snap/<name> = snapshot creation (mksnap)
+            dir_ino = await self._resolve_dir(parts[:-2])
+            realm = await self._realm(dir_ino)
+            if parts[-1] in realm:
+                raise MDSError("EEXIST", f"snap {parts[-1]!r} exists")
+            snapid = await self.ioctx.selfmanaged_snap_create()
+            children = await self._entries(dir_ino)
+            await self._journal_and_apply({
+                "op": "mksnap", "dir": dir_ino, "name": parts[-1],
+                "snapid": snapid, "children": children, **rid,
+            })
+            return {"snapid": snapid}
+        if op == "rmdir" and len(parts) >= 2 and parts[-2] == ".snap":
+            dir_ino = await self._resolve_dir(parts[:-2])
+            realm = await self._realm(dir_ino)
+            if parts[-1] not in realm:
+                raise MDSError("ENOENT", f"no snap {parts[-1]!r}")
+            await self._journal_and_apply({
+                "op": "rmsnap", "dir": dir_ino, "name": parts[-1],
+                "snapid": realm[parts[-1]]["snapid"], **rid,
+            })
+            return {}
+        if op == "readdir" and parts and parts[-1] == ".snap":
+            dir_ino = await self._resolve_dir(parts[:-1])
+            realm = await self._realm(dir_ino)
+            return {"entries": {
+                name: {"type": "snap", "snapid": s["snapid"]}
+                for name, s in realm.items()
+            }}
+        if op == "readdir" and len(parts) >= 2 and parts[-2] == ".snap":
+            dir_ino = await self._resolve_dir(parts[:-2])
+            realm = await self._realm(dir_ino)
+            snap = realm.get(parts[-1])
+            if snap is None:
+                raise MDSError("ENOENT", f"no snap {parts[-1]!r}")
+            return {"entries": snap["children"]}
+        if op in ("open", "stat") and len(parts) >= 3 and (
+            parts[-3] == ".snap"
+        ):
+            # D/.snap/<name>/file: read-only access to the past
+            dir_ino = await self._resolve_dir(parts[:-3])
+            realm = await self._realm(dir_ino)
+            snap = realm.get(parts[-2])
+            if snap is None:
+                raise MDSError("ENOENT", f"no snap {parts[-2]!r}")
+            entry = snap["children"].get(parts[-1])
+            if entry is None or entry["type"] != "file":
+                raise MDSError(
+                    "ENOENT", f"no file {parts[-1]!r} in snap"
+                )
+            if op == "stat":
+                return {"entry": {**entry, "snapid": snap["snapid"]}}
+            if p.get("mode", "r") != "r":
+                raise MDSError("EROFS", "snapshots are read-only")
+            return {"ino": entry["ino"], "cap": "r",
+                    "snapid": snap["snapid"]}
         if op == "mkdir":
             parent, name = await self._parent_and_name(p["path"])
             if name in await self._entries(parent):
@@ -474,7 +603,12 @@ class MDSService:
             else:
                 ino = entry["ino"]
             await self._grant_cap(session, ino, mode)
-            return {"ino": ino, "cap": mode}
+            # the realm chain's snap context rides with the cap: the
+            # client's direct-RADOS writes must carry it so the OSD
+            # clones objects on first-write-after-snap
+            _dino, snaps = await self._path_snaps(parts[:-1])
+            return {"ino": ino, "cap": mode,
+                    "snapc": self._snapc_of(snaps)}
         if op == "release":
             self.caps.get(p["ino"], {}).pop(session.name, None)
             return {}
@@ -483,9 +617,11 @@ class MDSService:
             entry = (await self._entries(parent)).get(name)
             if entry is None or entry["type"] != "file":
                 raise MDSError("ENOENT", f"no file {p['path']!r}")
+            _dino, snaps = await self._path_snaps(parts[:-1])
             await self._journal_and_apply({
                 "op": "unlink", "parent": parent, "name": name,
-                "ino": entry["ino"], **rid,
+                "ino": entry["ino"],
+                "snapc": self._snapc_of(snaps), **rid,
             })
             self.caps.pop(entry["ino"], None)
             return {}

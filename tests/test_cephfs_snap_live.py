@@ -1,0 +1,114 @@
+"""CephFS snapshots (VERDICT r4 missing #4: SnapRealm-lite).
+
+`mkdir D/.snap/<name>` journals a realm record at the MDS (stored in the
+dir object's xattr, so it survives failover), captures the listing, and
+file DATA versioning rides the selfmanaged-snap machinery: opens carry
+the realm's snap context, client writes apply it, the OSD clones data
+objects on first-write-after-snap, and `D/.snap/<name>/file` reads the
+striped objects at that snapid. Reference: src/mds/SnapRealm.h:27.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cephfs import CephFSClient, CephFSError
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import wait_until
+from tests.test_mds_live import start_fs_cluster
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 240))
+
+
+def test_snap_create_overwrite_read_both_failover():
+    async def main():
+        cluster, admin, mdss = await start_fs_cluster()
+        r = Rados("client.fs", cluster.monmap, config=cluster.cfg)
+        await r.connect()
+        from tests.test_cluster_live import REP_POOL
+
+        fs = CephFSClient(r, REP_POOL)
+        await fs.mount()
+        await fs.mkfs()
+
+        await fs.mkdir("/proj")
+        await fs.write_file("/proj/report", b"version one")
+        await fs.write_file("/proj/const", b"never rewritten")
+
+        # snapshot the directory
+        snapid = await fs.mksnap("/proj", "s1")
+        assert snapid > 0
+
+        # overwrite AFTER the snap: head changes, the snap must not
+        await fs.write_file("/proj/report", b"version TWO, longer")
+        assert await fs.read_file("/proj/report") == (
+            b"version TWO, longer"
+        )
+        assert await fs.read_file("/proj/.snap/s1/report") == (
+            b"version one"
+        )
+        # a file never touched since the snap reads through to the head
+        assert await fs.read_file("/proj/.snap/s1/const") == (
+            b"never rewritten"
+        )
+
+        # .snap listing + snapped listing
+        snaps = await fs.listdir("/proj/.snap")
+        assert snaps["s1"]["type"] == "snap"
+        captured = await fs.listdir("/proj/.snap/s1")
+        assert set(captured) == {"report", "const"}
+
+        # snapshots are read-only
+        with pytest.raises(CephFSError, match="read-only"):
+            await fs.open("/proj/.snap/s1/report", "w")
+
+        # deletion after the snap: the snapped version stays readable
+        await fs.unlink("/proj/const")
+        assert "const" not in await fs.listdir("/proj")
+        assert await fs.read_file("/proj/.snap/s1/const") == (
+            b"never rewritten"
+        )
+
+        # second snapshot captures the current state independently
+        await fs.mksnap("/proj", "s2")
+        await fs.write_file("/proj/report", b"v3")
+        assert await fs.read_file("/proj/.snap/s2/report") == (
+            b"version TWO, longer"
+        )
+        assert await fs.read_file("/proj/.snap/s1/report") == (
+            b"version one"
+        )
+
+        # ACTIVE MDS DIES: the standby replays the journal; realms and
+        # snap reads survive because they live in RADOS
+        active = next(m for m in mdss if m.active)
+        standby = next(m for m in mdss if not m.active)
+        await active.stop()
+        await wait_until(lambda: standby.active, timeout=30)
+
+        assert await fs.read_file("/proj/.snap/s1/report") == (
+            b"version one"
+        )
+        assert await fs.read_file("/proj/.snap/s2/report") == (
+            b"version TWO, longer"
+        )
+        assert await fs.read_file("/proj/report") == b"v3"
+        snaps = await fs.listdir("/proj/.snap")
+        assert set(snaps) == {"s1", "s2"}
+
+        # rmsnap removes the realm entry and releases the pool snap
+        await fs.rmsnap("/proj", "s1")
+        assert set(await fs.listdir("/proj/.snap")) == {"s2"}
+        with pytest.raises(CephFSError, match="no snap"):
+            await fs.read_file("/proj/.snap/s1/report")
+
+        await r.shutdown()
+        for m in mdss:
+            if m is not active:
+                await m.stop()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
