@@ -230,6 +230,45 @@ class ObjectGateway:
             return False
         return raw == b"Enabled"
 
+    # -- canned ACLs (rgw_acl_s3.cc floor: private | public-read) -------
+
+    _ACL_XATTR = "rgw.acl"
+
+    async def set_bucket_acl(self, bucket: str, acl: str) -> None:
+        if not await self.bucket_exists(bucket):
+            raise GatewayError(f"no bucket {bucket!r}")
+        await self.index_ioctx.setxattr(
+            self._index_obj(bucket), self._ACL_XATTR, acl.encode()
+        )
+
+    async def get_bucket_acl(self, bucket: str) -> str:
+        if not await self.bucket_exists(bucket):
+            raise GatewayError(f"no bucket {bucket!r}")
+        try:
+            raw = await self.index_ioctx.getxattr(
+                self._index_obj(bucket), self._ACL_XATTR
+            )
+        except (ObjectNotFound, RadosError):
+            return "private"
+        return raw.decode() or "private"
+
+    async def set_object_acl(
+        self, bucket: str, key: str, acl: str
+    ) -> None:
+        meta = await self.head_object(bucket, key)
+        meta["acl"] = acl
+        await self.index_ioctx.exec(
+            self._index_obj(bucket), "rgw_index", "insert",
+            {"key": key, "meta": meta},
+        )
+
+    async def get_object_acl(self, bucket: str, key: str) -> str:
+        meta = await self.head_object(bucket, key)
+        acl = meta.get("acl")
+        if acl is None and meta.get("versions"):
+            acl = meta["versions"][-1].get("acl")
+        return acl or "private"
+
     async def _has_stack(self, bucket: str, key: str) -> bool:
         try:
             meta = await self.head_object(bucket, key)
@@ -242,7 +281,8 @@ class ObjectGateway:
         return etag
 
     async def put_object2(
-        self, bucket: str, key: str, data: bytes
+        self, bucket: str, key: str, data: bytes,
+        acl: str | None = None,
     ) -> tuple[str, str | None]:
         """Store data, then index it atomically server-side; returns
         (etag, version_id). Versioning-enabled buckets stack a NEW
@@ -272,6 +312,7 @@ class ObjectGateway:
                      "version_id": vid, "obj": obj,
                      "size": len(data), "etag": etag,
                      "delete_marker": False,
+                     **({"acl": acl} if acl else {}),
                  }},
             )
             displaced = rep.get("displaced")
@@ -288,7 +329,9 @@ class ObjectGateway:
         await self.ioctx.write_full(self._data_obj(bucket, key), data)
         await self.index_ioctx.exec(
             self._index_obj(bucket), "rgw_index", "insert",
-            {"key": key, "meta": {"size": len(data), "etag": etag}},
+            {"key": key,
+             "meta": {"size": len(data), "etag": etag,
+                      **({"acl": acl} if acl else {})}},
         )
         return etag, None
 

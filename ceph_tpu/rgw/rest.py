@@ -19,10 +19,15 @@ can talk to the cluster:
     POST   /bucket/key?uploadId=U     CompleteMultipartUpload (XML body)
     DELETE /bucket/key?uploadId=U     abort multipart
 
-Auth is AWS SigV4 (the reference's AWS4-HMAC-SHA256 verifier): the
-canonical request is rebuilt from the wire, the signing key derived from
-the registered secret, and a mismatched signature or unknown access key
-is refused with the S3 XML error envelope — no anonymous access.
+Auth is AWS SigV4 (the reference's AWS4-HMAC-SHA256 verifier) in all
+three spec flavors: header signing, query-string signing (presigned
+URLs, expiry-honored), and STREAMING-AWS4-HMAC-SHA256-PAYLOAD chunked
+uploads whose per-chunk signature chain is verified. The canonical
+request is rebuilt from the wire, the signing key derived from the
+registered secret, and a mismatched signature or unknown access key is
+refused with the S3 XML error envelope. Anonymous requests reach only
+public-read resources (canned-ACL floor: private | public-read via
+x-amz-acl / the ?acl subresource, rgw_acl_s3.cc role).
 """
 
 from __future__ import annotations
@@ -31,6 +36,7 @@ import asyncio
 import hashlib
 import hmac
 import re
+import time
 import urllib.parse
 from xml.etree import ElementTree
 from xml.sax.saxutils import escape
@@ -40,6 +46,7 @@ from ceph_tpu.rgw.gateway import GatewayError, ObjectGateway
 
 ALGORITHM = "AWS4-HMAC-SHA256"
 UNSIGNED = "UNSIGNED-PAYLOAD"
+STREAMING = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
 
 
 class S3Error(Exception):
@@ -208,8 +215,12 @@ class S3Frontend:
             urllib.parse.parse_qsl(url.query, keep_blank_values=True)
         )
         try:
-            self._authenticate(method, url, query, headers, body)
-            return await self._route(method, path, query, headers, body)
+            auth = self._authenticate(method, url, query, headers, body)
+            if auth.get("streaming"):
+                body = self._decode_aws_chunks(body, auth)
+            return await self._route(
+                method, path, query, headers, body, auth
+            )
         except ElementTree.ParseError as e:
             return (
                 400, {"Content-Type": "application/xml"},
@@ -240,8 +251,16 @@ class S3Frontend:
 
     # -- SigV4 verification (rgw_auth_s3.cc role) ------------------------------
 
-    def _authenticate(self, method, url, query, headers, body) -> None:
+    def _authenticate(self, method, url, query, headers, body) -> dict:
+        """Three ways in (rgw_auth_s3.cc): header SigV4 (+ the
+        STREAMING-AWS4-HMAC-SHA256-PAYLOAD chunked flavor), query-string
+        SigV4 (presigned URLs, expiry-honored), or anonymous — which the
+        router only admits to public-read resources."""
+        if query.get("X-Amz-Algorithm") == ALGORITHM:
+            return self._auth_presigned(method, url, query, headers)
         auth = headers.get("authorization", "")
+        if not auth:
+            return {"anonymous": True}
         m = _AUTH_RE.match(auth)
         if m is None:
             raise S3Error(
@@ -258,7 +277,12 @@ class S3Frontend:
             raise S3Error(
                 400, "InvalidRequest", "x-amz-content-sha256 required"
             )
-        if payload_hash != UNSIGNED and payload_hash != _sha256(body):
+        streaming = payload_hash == STREAMING
+        if (
+            not streaming
+            and payload_hash != UNSIGNED
+            and payload_hash != _sha256(body)
+        ):
             raise S3Error(
                 400, "XAmzContentSHA256Mismatch",
                 "payload hash does not match body",
@@ -282,17 +306,187 @@ class S3Frontend:
                 403, "SignatureDoesNotMatch",
                 "the request signature we calculated does not match",
             )
+        return {
+            "anonymous": False, "access_key": m["ak"],
+            "streaming": streaming, "signing_key": key,
+            "amz_date": amz_date, "scope": scope, "seed_sig": want,
+        }
+
+    def _auth_presigned(self, method, url, query, headers) -> dict:
+        """Query-string SigV4 (presigned URLs): the signature covers
+        every query param EXCEPT X-Amz-Signature, the payload is
+        unsigned, and X-Amz-Date + X-Amz-Expires bound the lifetime."""
+        cred = query.get("X-Amz-Credential", "")
+        parts = cred.split("/")
+        if len(parts) != 5 or parts[3:] != ["s3", "aws4_request"]:
+            raise S3Error(403, "AccessDenied", "malformed credential")
+        ak, date, region = parts[0], parts[1], parts[2]
+        secret = self.users.get(ak)
+        if secret is None:
+            raise S3Error(
+                403, "InvalidAccessKeyId", f"unknown access key {ak!r}"
+            )
+        amz_date = query.get("X-Amz-Date", "")
+        if not amz_date.startswith(date):
+            raise S3Error(
+                403, "AccessDenied", "credential date mismatch"
+            )
+        try:
+            expires = int(query.get("X-Amz-Expires", "0"))
+            t0 = time.mktime(
+                time.strptime(amz_date, "%Y%m%dT%H%M%SZ")
+            ) - time.timezone
+        except ValueError as e:
+            raise S3Error(403, "AccessDenied", "bad date") from e
+        if time.time() > t0 + expires:
+            raise S3Error(
+                403, "AccessDenied", "Request has expired"
+            )
+        sig = query.get("X-Amz-Signature", "")
+        signed = query.get("X-Amz-SignedHeaders", "host").split(";")
+        q = {k: v for k, v in query.items() if k != "X-Amz-Signature"}
+        creq = canonical_request(
+            method, urllib.parse.unquote(url.path), q, headers,
+            signed, UNSIGNED,
+        )
+        scope = f"{date}/{region}/s3/aws4_request"
+        sts = string_to_sign(amz_date, scope, creq)
+        key = signing_key(secret, date, region)
+        want = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, sig):
+            raise S3Error(
+                403, "SignatureDoesNotMatch",
+                "the request signature we calculated does not match",
+            )
+        return {"anonymous": False, "access_key": ak,
+                "streaming": False}
+
+    _CHUNK_HEAD_RE = re.compile(
+        rb"^([0-9a-fA-F]+);chunk-signature=([0-9a-f]{64})$"
+    )
+
+    def _decode_aws_chunks(self, body: bytes, auth: dict) -> bytes:
+        """STREAMING-AWS4-HMAC-SHA256-PAYLOAD: per-chunk signature chain
+        seeded by the header signature; every chunk must verify, and the
+        stream must end with the signed zero-length chunk."""
+        out = bytearray()
+        prev = auth["seed_sig"]
+        empty = _sha256(b"")
+        off = 0
+        while True:
+            nl = body.find(b"\r\n", off)
+            if nl < 0:
+                raise S3Error(
+                    400, "IncompleteBody", "truncated chunk header"
+                )
+            m = self._CHUNK_HEAD_RE.match(body[off:nl])
+            if m is None:
+                raise S3Error(
+                    400, "InvalidChunkSizeError", "bad chunk header"
+                )
+            size = int(m[1], 16)
+            off = nl + 2
+            data = body[off: off + size]
+            if len(data) != size or body[off + size: off + size + 2] \
+                    != b"\r\n":
+                raise S3Error(
+                    400, "IncompleteBody", "truncated chunk body"
+                )
+            off += size + 2
+            sts = "\n".join([
+                "AWS4-HMAC-SHA256-PAYLOAD", auth["amz_date"],
+                auth["scope"], prev, empty, _sha256(data),
+            ])
+            want = hmac.new(
+                auth["signing_key"], sts.encode(), hashlib.sha256
+            ).hexdigest()
+            if not hmac.compare_digest(want, m[2].decode()):
+                raise S3Error(
+                    403, "SignatureDoesNotMatch",
+                    "chunk signature does not match",
+                )
+            prev = want
+            out += data
+            if size == 0:
+                return bytes(out)
 
     # -- routing --------------------------------------------------------------
 
-    async def _route(self, method, path, query, headers, body):
+    @staticmethod
+    def _acl_xml(acl: str) -> bytes:
+        grants = [
+            "<Grant><Grantee>owner</Grantee>"
+            "<Permission>FULL_CONTROL</Permission></Grant>"
+        ]
+        if acl == "public-read":
+            grants.append(
+                "<Grant><Grantee>AllUsers</Grantee>"
+                "<Permission>READ</Permission></Grant>"
+            )
+        return (
+            "<?xml version=\"1.0\" encoding=\"UTF-8\"?>"
+            "<AccessControlPolicy><AccessControlList>"
+            + "".join(grants)
+            + "</AccessControlList></AccessControlPolicy>"
+        ).encode()
+
+    @staticmethod
+    def _canned_acl(headers) -> str | None:
+        acl = headers.get("x-amz-acl")
+        if acl is None:
+            return None
+        if acl not in ("private", "public-read"):
+            raise S3Error(
+                400, "InvalidArgument", f"unsupported ACL {acl!r}"
+            )
+        return acl
+
+    async def _anonymous_allowed(self, method, bucket, key, query):
+        """The rgw_acl_s3 floor: anonymous requests reach public-read
+        resources read-only; everything else is AccessDenied."""
+        if method not in ("GET", "HEAD") or "acl" in query:
+            return False
+        try:
+            bacl = await self.gw.get_bucket_acl(bucket)
+        except GatewayError:
+            bacl = "private"
+        if not key:
+            return bacl == "public-read" and not (
+                set(query) & {"versioning", "versions"}
+            )
+        if bacl == "public-read":
+            return True
+        try:
+            return (
+                await self.gw.get_object_acl(bucket, key)
+                == "public-read"
+            )
+        except (ObjectNotFound, GatewayError):
+            return False
+
+    async def _route(self, method, path, query, headers, body, auth):
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
         if not bucket:
             raise S3Error(400, "InvalidRequest", "bucket required")
+        if auth.get("anonymous") and not await self._anonymous_allowed(
+            method, bucket, key, query
+        ):
+            raise S3Error(
+                403, "AccessDenied", "anonymous access denied"
+            )
         ok_xml = {"Content-Type": "application/xml"}
         if not key:
+            if method == "PUT" and "acl" in query:
+                await self.gw.set_bucket_acl(
+                    bucket, self._canned_acl(headers) or "private"
+                )
+                return 200, {}, b""
+            if method == "GET" and "acl" in query:
+                return 200, ok_xml, self._acl_xml(
+                    await self.gw.get_bucket_acl(bucket)
+                )
             if method == "PUT" and "versioning" in query:
                 root = ElementTree.fromstring(body.decode())
                 ns = ""
@@ -360,6 +554,9 @@ class S3Frontend:
                 return 200, ok_xml, "".join(xml).encode()
             if method == "PUT":
                 await self.gw.create_bucket(bucket)
+                acl = self._canned_acl(headers)
+                if acl:
+                    await self.gw.set_bucket_acl(bucket, acl)
                 return 200, {}, b""
             if method == "DELETE":
                 try:
@@ -457,8 +654,19 @@ class S3Frontend:
             )
             return 204, {}, b""
 
+        if method == "PUT" and "acl" in query:
+            await self.gw.set_object_acl(
+                bucket, key, self._canned_acl(headers) or "private"
+            )
+            return 200, {}, b""
+        if method == "GET" and "acl" in query:
+            return 200, ok_xml, self._acl_xml(
+                await self.gw.get_object_acl(bucket, key)
+            )
         if method == "PUT":
-            etag, vid = await self.gw.put_object2(bucket, key, body)
+            etag, vid = await self.gw.put_object2(
+                bucket, key, body, acl=self._canned_acl(headers)
+            )
             hdrs = {"ETag": f'"{etag}"'}
             if vid is not None:
                 hdrs["x-amz-version-id"] = vid
