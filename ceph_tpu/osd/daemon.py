@@ -77,6 +77,17 @@ _FULL_OK_OPS = {
     "delete", "rmxattr", "omap_rm", "omap_clear", "unwatch",
 }
 
+#: the active trace id for the op currently executing in this task
+#: (zipkin_trace.h role): set when a traced client op starts, read by
+#: _peer_call so every downstream sub-op hop carries the id — async
+#: context propagates through awaits AND create_task, so the EC write
+#: pipeline's spawned tasks inherit it without plumbing
+import contextvars as _contextvars
+
+_trace_ctx: "_contextvars.ContextVar[str | None]" = (
+    _contextvars.ContextVar("ceph_trace_id", default=None)
+)
+
 
 class _StalePartial(Exception):
     """A prepared sub-stripe RMW found its base superseded by a
@@ -447,6 +458,9 @@ class OSDService(Dispatcher):
         #: (primary-side); feeds the PG_DAMAGED health check and clears
         #: when a rescrub comes back clean
         self._scrub_incons: dict[tuple, int] = {}
+        #: trace id -> [(unix ts, "osd.N", event)] span events
+        #: (ZTracer::Trace spans at mini scale)
+        self.traces: dict[str, list] = {}
         # dout-style subsystem logging with the always-on recent ring
         # (src/log/Log.cc); dumped via the `log dump` admin command
         from ceph_tpu.common.log import LogRegistry
@@ -550,6 +564,22 @@ class OSDService(Dispatcher):
                 asyncio.create_task(self._op_shard_worker(shard))
             )
         self._note_map(self.osdmap)
+
+    # -- cross-daemon tracing (src/common/zipkin_trace.h role) ----------------
+
+    def _trace(self, trace_id: str | None, event: str) -> None:
+        """Record one span event under a trace id. Each daemon keeps its
+        own span store; `dump_trace` on the admin surface hands the
+        events out and the client stitches the full multi-daemon
+        timeline (wall clock: every daemon shares the host's)."""
+        if not trace_id:
+            return
+        import time as _time
+
+        store = self.traces.setdefault(trace_id, [])
+        store.append((_time.time(), f"osd.{self.id}", event))
+        if len(self.traces) > 256:  # bound the span store
+            self.traces.pop(next(iter(self.traces)))
 
     def statfs(self) -> dict:
         """Store utilization (ObjectStore::statfs): advertised capacity
@@ -664,6 +694,10 @@ class OSDService(Dispatcher):
         payload["tid"] = tid
         payload["reply_to"] = self.id
         payload["_sent_at"] = time.time()
+        trace_id = _trace_ctx.get()
+        if trace_id is not None:
+            payload["trace_id"] = trace_id
+            self._trace(trace_id, f"{msg_type} -> osd.{osd}")
         fut = asyncio.get_event_loop().create_future()
         self._waiters[tid] = fut
         try:
@@ -2011,8 +2045,13 @@ class OSDService(Dispatcher):
         self._enqueue_subop(p, self._do_ec_sub_write, conn)
 
     async def _do_ec_sub_write(self, conn, p) -> None:
+        self._trace(
+            p.get("trace_id"),
+            f"ec_sub_write apply shard={p.get('shard')}",
+        )
         with self.perf.time("l_subop_apply"):
             await self._do_ec_sub_write_inner(conn, p)
+        self._trace(p.get("trace_id"), "ec_sub_write acked")
 
     async def _do_ec_sub_write_inner(self, conn, p) -> None:
         """ECBackend::handle_sub_write for our shard."""
@@ -2423,6 +2462,7 @@ class OSDService(Dispatcher):
                 )
             )
             return
+        self._trace(p.get("trace_id"), "op_dispatch")
         shard = self._op_shards[
             zlib.crc32(p["name"].encode()) % len(self._op_shards)
         ]
@@ -2501,11 +2541,20 @@ class OSDService(Dispatcher):
     async def _run_client_op(self, conn, p) -> None:
         pool_id = p["pool"]
         name = p["name"]
-        with self.op_tracker.track(
-            f"osd_op({p.get('op')} {pool_id}/{name} "
-            f"from {conn.peer_name})"
-        ) as tracked, self.perf.time("l_op_total"):
-            await self._do_osd_op(conn, p, pool_id, name, tracked)
+        token = _trace_ctx.set(p.get("trace_id"))
+        self._trace(
+            p.get("trace_id"),
+            f"op_execute {p.get('op')} {pool_id}/{name}",
+        )
+        try:
+            with self.op_tracker.track(
+                f"osd_op({p.get('op')} {pool_id}/{name} "
+                f"from {conn.peer_name})"
+            ) as tracked, self.perf.time("l_op_total"):
+                await self._do_osd_op(conn, p, pool_id, name, tracked)
+            self._trace(p.get("trace_id"), "op_replied")
+        finally:
+            _trace_ctx.reset(token)
 
     async def _do_osd_op(self, conn, p, pool_id, name, tracked) -> None:
         try:
@@ -3442,6 +3491,7 @@ class OSDService(Dispatcher):
         """Replica-side op-vector application (the sub-op carries the ops,
         the reference carries the compiled transaction — both re-apply
         deterministically; _sub_op_persist guarantees in-order arrival)."""
+        self._trace(p.get("trace_id"), "rep_ops apply")
         pg = self._pg_of(p["pgid"])
         e = p["entry"]
         async with pg.lock:
@@ -3959,6 +4009,12 @@ class OSDService(Dispatcher):
                 result = {"objects": sorted(objects)}
             elif cmd == "log dump":
                 result = {"entries": self.logs.dump_recent()}
+            elif cmd == "dump_trace":
+                result = {
+                    "events": list(
+                        self.traces.get(p.get("trace_id", ""), [])
+                    )
+                }
             elif cmd == "dump_ops_in_flight":
                 result = self.op_tracker.dump_ops_in_flight()
             elif cmd == "dump_historic_ops":

@@ -59,6 +59,11 @@ class Objecter(Dispatcher):
         self._rewatch_tasks: set = set()
         self._keyring = keyring
         self._ticket_task: asyncio.Task | None = None
+        #: cross-daemon tracing (zipkin_trace.h): when set, every op
+        #: carries a fresh trace id and collect_trace() stitches the
+        #: multi-daemon timeline from the daemons' span stores
+        self.trace_all = False
+        self.traces: dict[str, list] = {}
         self.mon.on_map_change(self._rewatch_on_map)
 
     async def start(self) -> None:
@@ -211,6 +216,25 @@ class Objecter(Dispatcher):
             raise RadosError(reply.get("error", "admin command failed"))
         return reply.get("result", {})
 
+    async def collect_trace(self, trace_id: str) -> list:
+        """Stitch one traced op's FULL timeline: this client's span
+        events + every up OSD's, merged by wall clock (the role of the
+        zipkin collector UI, flattened to a sorted list of
+        (ts, who, event))."""
+        events = list(self.traces.get(trace_id, []))
+        for osd in range(self.osdmap.max_osd):
+            if self.osdmap.is_down(osd):
+                continue
+            try:
+                rep = await self.osd_admin(
+                    osd, "dump_trace", {"trace_id": trace_id},
+                    timeout=5.0,
+                )
+            except RadosError:
+                continue
+            events.extend(tuple(e) for e in rep.get("events", []))
+        return sorted(events)
+
     # -- targeting ------------------------------------------------------------
 
     def _effective_pool(self, pool_id: int) -> int:
@@ -259,6 +283,18 @@ class Objecter(Dispatcher):
         # must carry the same reqid or the OSD's dup detection can never
         # recognize them and non-idempotent ops would double-apply
         tid = next(self._tids)
+        trace_id = None
+        if self.trace_all:
+            # cross-daemon tracing (zipkin_trace.h role): the id rides
+            # the op and every sub-op hop; daemons record span events
+            # keyed by it, collect_trace() stitches the timeline
+            import time as _time
+            import uuid as _uuid
+
+            trace_id = _uuid.uuid4().hex[:16]
+            self.traces[trace_id] = [(
+                _time.time(), self.name, f"op_submit {op} {name}"
+            )]
         while asyncio.get_event_loop().time() < deadline:
             try:
                 eff_pool = self._effective_pool(pool_id)
@@ -272,6 +308,8 @@ class Objecter(Dispatcher):
                 continue
             payload = {"tid": tid, "pool": eff_pool, "name": name,
                        "op": op}
+            if trace_id is not None:
+                payload["trace_id"] = trace_id
             if extra:
                 payload.update(extra)
             fut = asyncio.get_event_loop().create_future()
@@ -293,6 +331,13 @@ class Objecter(Dispatcher):
             finally:
                 self._waiters.pop(tid, None)
             if reply.get("ok"):
+                if trace_id is not None:
+                    import time as _time
+
+                    self.traces[trace_id].append(
+                        (_time.time(), self.name, "op_reply")
+                    )
+                    reply["trace_id"] = trace_id
                 return reply
             if reply.get("wrong_primary"):
                 # our map was stale; catch up past the OSD's epoch
