@@ -146,7 +146,8 @@ class ImageNotFound(RadosError):
 class Image:
     def __init__(self, ioctx: IoCtx, name: str, size: int, order: int,
                  snaps: dict | None = None, parent: dict | None = None,
-                 protected: list | None = None, children: int = 0):
+                 protected: list | None = None, children: int = 0,
+                 migration: dict | None = None):
         # a private IoCtx: the snap context is per-image state and must
         # not leak onto other users of the caller's pool handle
         self.ioctx = IoCtx(ioctx.objecter, ioctx.pool_id)
@@ -162,7 +163,13 @@ class Image:
         self.protected: list = list(protected or [])
         #: number of clones whose parent is a snap of this image
         self.children = children
+        #: {"pool": id, "image": name} while this image is the TARGET of
+        #: a live migration: holes read through to the source's head and
+        #: writes copy up, exactly the clone machinery minus the snap
+        #: (librbd/api/Migration.cc role)
+        self.migration: dict | None = migration
         self._parent_image: "Image | None" = None
+        self._migration_src: "Image | None" = None
         #: head object map bits (1 = object exists); loaded lazily
         self._omap_bits: bytearray | None = None
         #: fast-diff clean bits (unchanged since the latest snap)
@@ -222,7 +229,8 @@ class Image:
                   snaps=header.get("snaps"),
                   parent=header.get("parent"),
                   protected=header.get("protected"),
-                  children=header.get("children", 0))
+                  children=header.get("children", 0),
+                  migration=header.get("migration"))
         if exclusive:
             await img.lock_acquire()
         return img
@@ -258,7 +266,8 @@ class Image:
                             "snaps": self.snaps,
                             "parent": self.parent,
                             "protected": self.protected,
-                            "children": self.children}).encode(),
+                            "children": self.children,
+                            "migration": self.migration}).encode(),
             )
         finally:
             self.ioctx.snapc = saved
@@ -508,11 +517,31 @@ class Image:
             )
         return self._parent_image
 
+    async def _migration_object(self, objectno: int) -> bytes | None:
+        """Read-through to a migration SOURCE's head (Migration.cc's
+        deep-copy read path): same shape as the clone fall-through but
+        at the live head, clipped to the source size."""
+        if self.migration is None:
+            return None
+        if self._migration_src is None:
+            sioctx = IoCtx(self.ioctx.objecter, self.migration["pool"])
+            self._migration_src = await Image.open(
+                sioctx, self.migration["image"]
+            )
+        src = self._migration_src
+        objsize = 1 << self.order
+        poff = objectno * objsize
+        if poff >= src.size:
+            return None
+        length = min(objsize, src.size - poff)
+        return await src.read(poff, length)
+
     async def _parent_object(self, objectno: int) -> bytes | None:
         """The child object's content as inherited from the parent snap
-        (clipped to the overlap), or None when outside it."""
+        (clipped to the overlap), or None when outside it — or from a
+        migration source's head while a migration is in flight."""
         if self.parent is None:
-            return None
+            return await self._migration_object(objectno)
         objsize = 1 << self.order
         poff = objectno * objsize
         overlap = self.parent["overlap"]
@@ -557,6 +586,99 @@ class Image:
         await self._persist_map()
         await self._detach_parent()
         await self._save_header()
+
+    # -- live migration (librbd/api/Migration.cc, mini) -----------------------
+
+    @classmethod
+    async def migration_prepare(
+        cls, src_ioctx: IoCtx, src_name: str,
+        dst_ioctx: IoCtx, dst_name: str,
+    ) -> "Image":
+        """Stage 1 (`rbd migration prepare`): create the TARGET image
+        linked to the source; clients switch to the target immediately —
+        holes read through to the source, writes copy up. The source is
+        fenced for the whole migration by a cluster-side lock owned by
+        the migration itself (the reference hides the source image)."""
+        src = await cls.open(src_ioctx, src_name)
+        if src.snaps:
+            raise RadosError(
+                "cannot migrate an image with snapshots (flatten its "
+                "history first)"
+            )
+        if src.parent is not None:
+            raise RadosError("flatten the clone before migrating")
+        try:
+            await dst_ioctx.stat(cls._header_name(dst_name))
+            raise RadosError(f"image {dst_name!r} exists")
+        except ObjectNotFound:
+            pass
+        fence = _ClsHeaderLock(src_ioctx, cls._header_name(src_name))
+        fence.owner = f"migration/{dst_ioctx.pool_id}/{dst_name}"
+        await fence.acquire()
+        dst = cls(
+            dst_ioctx, dst_name, src.size, src.order,
+            migration={"pool": src_ioctx.pool_id, "image": src_name},
+        )
+        await dst._save_header()
+        return dst
+
+    def _migration_fence(self) -> _ClsHeaderLock:
+        sioctx = IoCtx(self.ioctx.objecter, self.migration["pool"])
+        fence = _ClsHeaderLock(
+            sioctx, self._header_name(self.migration["image"])
+        )
+        fence.owner = (
+            f"migration/{self.ioctx.pool_id}/{self.name}"
+        )
+        return fence
+
+    async def migration_execute(self) -> int:
+        """Stage 2: deep-copy every still-inherited object into the
+        target (the image stays fully usable throughout). Returns the
+        number of objects copied."""
+        if self.migration is None:
+            return 0
+        objsize = 1 << self.order
+        bits = await self._load_map()
+        copied = 0
+        for objectno in range((self.size + objsize - 1) // objsize):
+            if self._map_get(bits, objectno):
+                continue  # target already owns it
+            data = await self._migration_object(objectno)
+            if data is None:
+                continue  # source hole stays a hole
+            await self.ioctx.write_full(
+                self._data_name(objectno), data
+            )
+            self._set_bit(bits, objectno, True)
+            copied += 1
+        await self._persist_map()
+        return copied
+
+    async def migration_commit(self) -> None:
+        """Stage 3: finish any remaining copy, remove the SOURCE, and
+        sever the link — the target is standalone from here."""
+        if self.migration is None:
+            return
+        await self.migration_execute()
+        fence = self._migration_fence()
+        sioctx = IoCtx(self.ioctx.objecter, self.migration["pool"])
+        src = await Image.open(sioctx, self.migration["image"])
+        await fence.release()
+        await src.remove()
+        self.migration = None
+        self._migration_src = None
+        await self._save_header()
+
+    async def migration_abort(self) -> None:
+        """Back out: drop the target, unfence the source (clients
+        switch back)."""
+        if self.migration is None:
+            return
+        fence = self._migration_fence()
+        self.migration = None
+        await self.remove()
+        await fence.release()
 
     # -- extent algebra (Striper::file_to_extents for the simple layout) ------
 
