@@ -16,6 +16,7 @@ List is served by the index class with (prefix, marker, max) pagination —
 from __future__ import annotations
 
 import json
+import time
 
 from ceph_tpu.common.crc import ceph_crc32c
 from ceph_tpu.osd.cls import RD, WR, ClsError
@@ -187,6 +188,8 @@ class ObjectGateway:
     def _data_obj(bucket: str, key: str) -> str:
         return f"{bucket}/{key}"
 
+    _BUCKETS_OBJ = ".buckets.list"
+
     async def create_bucket(self, bucket: str) -> None:
         try:
             await self.index_ioctx.stat(self._index_obj(bucket))
@@ -194,6 +197,18 @@ class ObjectGateway:
         except ObjectNotFound:
             pass
         await self.index_ioctx.write_full(self._index_obj(bucket), b"")
+        # bucket registry (the rgw metadata-pool bucket list): what
+        # list_buckets() and the lifecycle pass enumerate
+        await self.index_ioctx.omap_set(
+            self._BUCKETS_OBJ, {bucket.encode(): b"1"}
+        )
+
+    async def list_buckets(self) -> list[str]:
+        try:
+            rows = await self.index_ioctx.omap_get(self._BUCKETS_OBJ)
+        except ObjectNotFound:
+            return []
+        return sorted(k.decode() for k in rows)
 
     async def bucket_exists(self, bucket: str) -> bool:
         try:
@@ -251,6 +266,86 @@ class ObjectGateway:
         except (ObjectNotFound, RadosError):
             return "private"
         return raw.decode() or "private"
+
+    # -- lifecycle (RGWLC, src/rgw/rgw_lc.cc at mini scale) -------------
+    #
+    # Rules are stored on the bucket like versioning/ACL state; a
+    # lifecycle PASS walks registered buckets and applies Expiration
+    # rules against each current object's mtime (prefix-filtered).
+    # Deletes go through the normal versioning-aware path, so a
+    # versioned bucket expires into delete markers, exactly S3's
+    # behavior. Reclamation is synchronous everywhere in this gateway
+    # (multipart parts via manifests, displaced versions at push), so
+    # the separate deferred-GC queue (rgw_gc) has no role to play here.
+
+    _LC_XATTR = "rgw.lifecycle"
+
+    async def set_lifecycle(self, bucket: str, rules: list) -> None:
+        if not await self.bucket_exists(bucket):
+            raise GatewayError(f"no bucket {bucket!r}")
+        for r in rules:
+            if "days" not in r:
+                raise GatewayError("lifecycle rule needs Days")
+        await self.index_ioctx.setxattr(
+            self._index_obj(bucket), self._LC_XATTR,
+            json.dumps(rules, sort_keys=True).encode(),
+        )
+
+    async def get_lifecycle(self, bucket: str) -> list:
+        if not await self.bucket_exists(bucket):
+            raise GatewayError(f"no bucket {bucket!r}")
+        try:
+            raw = await self.index_ioctx.getxattr(
+                self._index_obj(bucket), self._LC_XATTR
+            )
+        except (ObjectNotFound, RadosError):
+            return []
+        return json.loads(raw)
+
+    async def delete_lifecycle(self, bucket: str) -> None:
+        try:
+            await self.index_ioctx.rmxattr(
+                self._index_obj(bucket), self._LC_XATTR
+            )
+        except (ObjectNotFound, RadosError):
+            pass
+
+    async def lifecycle_pass(self, now: float | None = None) -> dict:
+        """One LC work cycle over every bucket (RGWLC::process):
+        returns {bucket: [expired keys]}."""
+        now = time.time() if now is None else now
+        expired: dict[str, list] = {}
+        for bucket in await self.list_buckets():
+            rules = [
+                r for r in await self.get_lifecycle(bucket)
+                if r.get("status", "Enabled") == "Enabled"
+            ]
+            if not rules:
+                continue
+            marker = ""
+            while True:
+                page = await self.list_objects(
+                    bucket, marker=marker, max_entries=256
+                )
+                for key, meta in sorted(page["entries"].items()):
+                    if meta.get("delete_marker"):
+                        continue
+                    mtime = meta.get("mtime")
+                    if mtime is None and meta.get("versions"):
+                        mtime = meta["versions"][-1].get("mtime")
+                    if mtime is None:
+                        continue
+                    for r in rules:
+                        if not key.startswith(r.get("prefix", "")):
+                            continue
+                        if now - mtime >= r["days"] * 86400.0:
+                            await self.delete_object(bucket, key)
+                            expired.setdefault(bucket, []).append(key)
+                            break
+                if not page.get("truncated"):
+                    break
+                marker = page["next_marker"]
+        return expired
 
     async def set_object_acl(
         self, bucket: str, key: str, acl: str
@@ -312,6 +407,7 @@ class ObjectGateway:
                      "version_id": vid, "obj": obj,
                      "size": len(data), "etag": etag,
                      "delete_marker": False,
+                     "mtime": time.time(),
                      **({"acl": acl} if acl else {}),
                  }},
             )
@@ -331,6 +427,7 @@ class ObjectGateway:
             self._index_obj(bucket), "rgw_index", "insert",
             {"key": key,
              "meta": {"size": len(data), "etag": etag,
+                      "mtime": time.time(),
                       **({"acl": acl} if acl else {})}},
         )
         return etag, None
@@ -520,6 +617,12 @@ class ObjectGateway:
         if stat["count"]:
             raise GatewayError(f"bucket {bucket!r} not empty")
         await self.index_ioctx.remove(self._index_obj(bucket))
+        try:
+            await self.index_ioctx.omap_rm(
+                self._BUCKETS_OBJ, [bucket.encode()]
+            )
+        except (ObjectNotFound, RadosError):
+            pass
 
     # -- multipart upload (rgw_op.cc RGWInitMultipart / RGWPutObj part /
     # -- RGWCompleteMultipart): parts are separate RADOS objects; complete

@@ -487,6 +487,66 @@ class S3Frontend:
                 return 200, ok_xml, self._acl_xml(
                     await self.gw.get_bucket_acl(bucket)
                 )
+            if method == "PUT" and "lifecycle" in query:
+                root = ElementTree.fromstring(body.decode())
+                ns = ""
+                if root.tag.startswith("{"):
+                    ns = root.tag[: root.tag.index("}") + 1]
+                rules = []
+                for rule in root.findall(f"{ns}Rule"):
+                    status = rule.find(f"{ns}Status")
+                    exp = rule.find(f"{ns}Expiration")
+                    days = (
+                        exp.find(f"{ns}Days") if exp is not None
+                        else None
+                    )
+                    if days is None:
+                        raise S3Error(
+                            400, "MalformedXML",
+                            "Rule needs Expiration/Days",
+                        )
+                    prefix_el = rule.find(f"{ns}Filter/{ns}Prefix")
+                    if prefix_el is None:
+                        prefix_el = rule.find(f"{ns}Prefix")
+                    rid = rule.find(f"{ns}ID")
+                    rules.append({
+                        "id": rid.text if rid is not None else "",
+                        "status": (
+                            status.text if status is not None
+                            else "Enabled"
+                        ),
+                        "days": int(days.text),
+                        "prefix": (
+                            prefix_el.text or ""
+                            if prefix_el is not None else ""
+                        ),
+                    })
+                await self.gw.set_lifecycle(bucket, rules)
+                return 200, {}, b""
+            if method == "GET" and "lifecycle" in query:
+                rules = await self.gw.get_lifecycle(bucket)
+                if not rules:
+                    raise S3Error(
+                        404, "NoSuchLifecycleConfiguration", bucket
+                    )
+                xml = ["<?xml version=\"1.0\" encoding=\"UTF-8\"?>",
+                       "<LifecycleConfiguration>"]
+                for r in rules:
+                    xml.append(
+                        "<Rule>"
+                        f"<ID>{escape(r.get('id', ''))}</ID>"
+                        f"<Status>{escape(r['status'])}</Status>"
+                        "<Filter><Prefix>"
+                        f"{escape(r.get('prefix', ''))}"
+                        "</Prefix></Filter>"
+                        f"<Expiration><Days>{r['days']}</Days>"
+                        "</Expiration></Rule>"
+                    )
+                xml.append("</LifecycleConfiguration>")
+                return 200, ok_xml, "".join(xml).encode()
+            if method == "DELETE" and "lifecycle" in query:
+                await self.gw.delete_lifecycle(bucket)
+                return 204, {}, b""
             if method == "PUT" and "versioning" in query:
                 root = ElementTree.fromstring(body.decode())
                 ns = ""

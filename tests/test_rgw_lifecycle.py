@@ -1,0 +1,120 @@
+"""Bucket lifecycle (VERDICT r4 missing #5 tail: rgw_lc.cc at mini
+scale): ?lifecycle XML config round-trip over the REST frontend, and
+the LC pass expiring prefix-matched objects by mtime — through the
+versioning-aware delete path, so versioned buckets expire into delete
+markers. Reclamation is synchronous in this gateway (manifest-driven
+multipart cleanup, displaced-version removal at push), which is the
+deferred rgw_gc queue's role collapsed into the write path."""
+
+import asyncio
+import time
+
+from ceph_tpu.rados.client import Rados
+from ceph_tpu.rgw import ObjectGateway, register_rgw_classes
+from ceph_tpu.rgw.rest import S3Frontend
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster
+from tests.test_s3_rest import AK, SK, REGION, MiniS3Client
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+LC_XML = (
+    '<?xml version="1.0" encoding="UTF-8"?>'
+    "<LifecycleConfiguration>"
+    "<Rule><ID>tmp-sweeper</ID><Status>Enabled</Status>"
+    "<Filter><Prefix>tmp/</Prefix></Filter>"
+    "<Expiration><Days>7</Days></Expiration></Rule>"
+    "</LifecycleConfiguration>"
+)
+
+
+def test_lifecycle_config_and_expiration_pass():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        for osd in cluster.osds.values():
+            register_rgw_classes(osd)
+        rados = Rados("client.lc", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        gw = ObjectGateway(
+            rados.io_ctx(EC_POOL), index_ioctx=rados.io_ctx(REP_POOL)
+        )
+        front = S3Frontend(gw, users={AK: SK}, region=REGION)
+        port = await front.start()
+        c = MiniS3Client("127.0.0.1", port, AK, SK)
+
+        await c.request("PUT", "/workdir")
+        # config round-trip over the wire
+        st, _, _ = await c.request(
+            "PUT", "/workdir", query={"lifecycle": ""},
+            payload=LC_XML.encode(),
+        )
+        assert st == 200
+        st, _, body = await c.request(
+            "GET", "/workdir", query={"lifecycle": ""}
+        )
+        assert st == 200
+        assert b"tmp-sweeper" in body and b"<Days>7</Days>" in body
+
+        # objects: two under the prefix, one outside
+        await c.request("PUT", "/workdir/tmp/a", payload=b"old a")
+        await c.request("PUT", "/workdir/tmp/b", payload=b"old b")
+        await c.request("PUT", "/workdir/keep", payload=b"kept")
+
+        # a pass NOW expires nothing (everything is fresh)
+        assert await gw.lifecycle_pass() == {}
+
+        # a pass 8 days in the future expires exactly the prefix
+        future = time.time() + 8 * 86400
+        expired = await gw.lifecycle_pass(now=future)
+        assert sorted(expired.get("workdir", [])) == ["tmp/a", "tmp/b"]
+        st, _, _ = await c.request("GET", "/workdir/tmp/a")
+        assert st == 404
+        st, _, body = await c.request("GET", "/workdir/keep")
+        assert st == 200 and body == b"kept"
+
+        # idempotent: nothing left to expire
+        assert await gw.lifecycle_pass(now=future) == {}
+
+        # versioned bucket: expiry lays down a delete marker, the
+        # non-current version survives
+        await c.request("PUT", "/workdir", query={"versioning": ""},
+                        payload=(
+                            b'<VersioningConfiguration><Status>Enabled'
+                            b'</Status></VersioningConfiguration>'
+                        ))
+        st, hd, _ = await c.request(
+            "PUT", "/workdir/tmp/v", payload=b"versioned"
+        )
+        vid = hd.get("x-amz-version-id")
+        assert vid
+        expired = await gw.lifecycle_pass(now=future + 86400)
+        assert "tmp/v" in expired.get("workdir", [])
+        st, _, _ = await c.request("GET", "/workdir/tmp/v")
+        assert st == 404  # current is a delete marker...
+        st, _, body = await c.request(
+            "GET", "/workdir/tmp/v", query={"versionId": vid}
+        )
+        assert st == 200 and body == b"versioned"  # ...data survives
+
+        # DELETE ?lifecycle removes the config; GET 404s
+        st, _, _ = await c.request(
+            "DELETE", "/workdir", query={"lifecycle": ""}
+        )
+        assert st == 204
+        st, _, _ = await c.request(
+            "GET", "/workdir", query={"lifecycle": ""}
+        )
+        assert st == 404
+
+        # list_buckets serves the registry
+        assert await gw.list_buckets() == ["workdir"]
+
+        await front.stop()
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
