@@ -10,10 +10,18 @@ enumeration.
 The mini gateway keeps exactly that shape: `ObjectGateway` stores object
 data as RADOS objects and maintains a per-bucket index through a registered
 `rgw_index` object class (insert/remove/list with marker pagination), with
-ETags (crc32c of content, hex) computed at put. No HTTP frontend — the
-surface is the API the frontends would call.
+ETags (crc32c of content, hex) computed at put. Two HTTP frontends serve
+the SAME gateway, like the reference: `rest.S3Frontend` (SigV4 in all
+three spec flavors, ACLs, versioning, multipart, lifecycle) and
+`swift.SwiftFrontend` (TempAuth + containers/objects) — an object PUT
+through one dialect reads back byte-identical through the other.
 """
 
 from ceph_tpu.rgw.gateway import ObjectGateway, register_rgw_classes
+from ceph_tpu.rgw.rest import S3Frontend
+from ceph_tpu.rgw.swift import SwiftFrontend
 
-__all__ = ["ObjectGateway", "register_rgw_classes"]
+__all__ = [
+    "ObjectGateway", "S3Frontend", "SwiftFrontend",
+    "register_rgw_classes",
+]

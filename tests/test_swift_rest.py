@@ -1,0 +1,139 @@
+"""Swift REST dialect (VERDICT r4 missing #5: rgw_rest_swift.cc).
+
+The defining property of the dual-protocol gateway: one object store,
+two wire dialects — an object PUT through Swift reads back
+byte-identical through S3, and vice versa. TempAuth tokens gate every
+data op; a bad key or missing token is 401.
+"""
+
+import asyncio
+
+from ceph_tpu.rados.client import Rados
+from ceph_tpu.rgw import ObjectGateway, register_rgw_classes
+from ceph_tpu.rgw.rest import S3Frontend
+from ceph_tpu.rgw.swift import SwiftFrontend
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster
+from tests.test_s3_auth_ext import raw_http
+from tests.test_s3_rest import AK, REGION, SK, MiniS3Client
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+def test_swift_dialect_and_s3_interop():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        for osd in cluster.osds.values():
+            register_rgw_classes(osd)
+        rados = Rados("client.sw", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        gw = ObjectGateway(
+            rados.io_ctx(EC_POOL), index_ioctx=rados.io_ctx(REP_POOL)
+        )
+        s3 = S3Frontend(gw, users={AK: SK}, region=REGION)
+        s3_port = await s3.start()
+        sw = SwiftFrontend(gw, users={"acme:ops": "sekrit"})
+        sw_port = await sw.start()
+        host = "127.0.0.1"
+
+        # -- TempAuth: bad key 401, good key issues a token
+        st, _, _ = await raw_http(
+            host, sw_port, "GET", "/auth/v1.0",
+            headers={"x-auth-user": "acme:ops", "x-auth-key": "wrong"},
+        )
+        assert st == 401
+        st, hd, _ = await raw_http(
+            host, sw_port, "GET", "/auth/v1.0",
+            headers={"x-auth-user": "acme:ops",
+                     "x-auth-key": "sekrit"},
+        )
+        assert st == 200
+        token = hd["x-auth-token"]
+        base = hd["x-storage-url"]
+        auth = {"x-auth-token": token}
+
+        # tokenless data access refused
+        st, _, _ = await raw_http(host, sw_port, "GET", base)
+        assert st == 401
+
+        # -- containers
+        st, _, _ = await raw_http(
+            host, sw_port, "PUT", f"{base}/media", headers=auth
+        )
+        assert st == 201
+        st, _, body = await raw_http(
+            host, sw_port, "GET", f"{base}?format=json", headers=auth
+        )
+        assert st == 200 and b'"media"' in body
+
+        # -- objects through Swift
+        st, hd, _ = await raw_http(
+            host, sw_port, "PUT", f"{base}/media/song.flac",
+            headers=auth, body=b"\x00swift bytes\xff" * 100,
+        )
+        assert st == 201 and hd.get("etag")
+        st, _, body = await raw_http(
+            host, sw_port, "GET", f"{base}/media/song.flac",
+            headers=auth,
+        )
+        assert st == 200 and body == b"\x00swift bytes\xff" * 100
+        st, hd, _ = await raw_http(
+            host, sw_port, "HEAD", f"{base}/media/song.flac",
+            headers=auth,
+        )
+        assert st == 200 and hd["content-length"] == str(1300)
+
+        # -- INTEROP: the same object through the S3 dialect
+        c = MiniS3Client(host, s3_port, AK, SK)
+        st, _, body = await c.request("GET", "/media/song.flac")
+        assert st == 200 and body == b"\x00swift bytes\xff" * 100
+
+        # S3 PUT -> Swift GET
+        await c.request("PUT", "/media/from-s3", payload=b"crossed")
+        st, _, body = await raw_http(
+            host, sw_port, "GET", f"{base}/media/from-s3",
+            headers=auth,
+        )
+        assert st == 200 and body == b"crossed"
+
+        # listing shows both, with prefix filtering
+        st, _, body = await raw_http(
+            host, sw_port, "GET", f"{base}/media", headers=auth
+        )
+        assert body == b"from-s3\nsong.flac\n"
+        st, _, body = await raw_http(
+            host, sw_port, "GET", f"{base}/media?prefix=song",
+            headers=auth,
+        )
+        assert body == b"song.flac\n"
+
+        # -- deletes + container lifecycle
+        st, _, _ = await raw_http(
+            host, sw_port, "DELETE", f"{base}/media", headers=auth
+        )
+        assert st == 409  # not empty
+        for key in ("song.flac", "from-s3"):
+            st, _, _ = await raw_http(
+                host, sw_port, "DELETE", f"{base}/media/{key}",
+                headers=auth,
+            )
+            assert st == 204
+        st, _, _ = await raw_http(
+            host, sw_port, "DELETE", f"{base}/media", headers=auth
+        )
+        assert st == 204
+        st, _, _ = await raw_http(
+            host, sw_port, "GET", f"{base}/media/song.flac",
+            headers=auth,
+        )
+        assert st == 404
+
+        await sw.stop()
+        await s3.stop()
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
