@@ -129,6 +129,14 @@ class Monitor(Dispatcher):
         #: source peering consults so a stale quorum can never go active
         #: without contacting a possibly-newer interval's member
         self._acting_archive: dict[tuple, list] = {}
+        #: osd -> sorted committed up_thru values (the osd_info_t
+        #: up_thru history interval math consults): a past interval is
+        #: maybe_went_rw only if its primary confirmed an up_thru
+        #: WITHIN it — rebuilt deterministically at replay
+        self._up_thru_archive: dict[int, list] = {}
+        #: osd -> highest PRUNED up_thru value: intervals at or below it
+        #: cannot be proven write-free and stay conservatively rw
+        self._up_thru_floor: dict[int, int] = {}
         self._last_applied_service = ""
         #: leader-volatile PG stats reports: osd -> (mono time, stats)
         #: — the PGMap/MgrStatMonitor role feeding health checks; a new
@@ -561,6 +569,19 @@ class Monitor(Dispatcher):
             self.mgrmap = new
 
     def _archive_actings(self, inc: Incremental) -> None:
+        for osd, e in inc.new_up_thru.items():
+            hist = self._up_thru_archive.setdefault(int(osd), [])
+            if not hist or hist[-1] < int(e):
+                hist.append(int(e))
+                if len(hist) > 64:
+                    # bounded like the acting archive — but pruning must
+                    # stay SAFE: intervals older than the pruned horizon
+                    # fall back to conservative rw=True via the floor
+                    self._up_thru_floor[int(osd)] = hist[-65]
+                    del hist[:-64]
+        self._archive_actings_inner(inc)
+
+    def _archive_actings_inner(self, inc: Incremental) -> None:
         """Append changed acting sets to the per-PG interval archive.
         Only PGs the inc can affect are recomputed: osd/crush/pool-level
         changes touch everything, pg_temp/upmap incs touch their named
@@ -1266,6 +1287,26 @@ class Monitor(Dispatcher):
                 )
             )
             return {"applied": len(new_items), "removed": len(old_items)}
+        if cmd == "osd up-thru":
+            # OSDMonitor::prepare_alive: a primary confirms it is alive
+            # in its current interval BEFORE serving writes; the commit
+            # is what makes the interval maybe_went_rw for future
+            # peering
+            osd, e = int(args["osd"]), int(args["epoch"])
+            if (
+                0 <= osd < self.osdmap.max_osd
+                and int(self.osdmap.osd_up_thru[osd]) < e
+            ):
+                await self._propose_osdmap(
+                    Incremental(
+                        epoch=self.osdmap.epoch + 1,
+                        new_up_thru={osd: e},
+                    )
+                )
+            return {"up_thru": (
+                int(self.osdmap.osd_up_thru[osd])
+                if 0 <= osd < self.osdmap.max_osd else 0
+            )}
         if cmd == "pg history":
             # acting-set intervals since `from` (+ the one spanning it):
             # the past_intervals feed for peering's stale-quorum gate.
@@ -1276,12 +1317,33 @@ class Monitor(Dispatcher):
                 arch = self._acting_archive.get(key, [])
                 out = []
                 for i, (epoch, acting, primary) in enumerate(arch):
+                    is_last = i + 1 >= len(arch)
                     end = (
-                        arch[i + 1][0] - 1
-                        if i + 1 < len(arch) else self.osdmap.epoch
+                        arch[i + 1][0] - 1 if not is_last
+                        else self.osdmap.epoch
                     )
-                    if end >= frm:
-                        out.append([epoch, acting, primary])
+                    if end < frm:
+                        continue
+                    # maybe_went_rw (osd_types.h:3030 PastIntervals +
+                    # check_new_interval's up_thru reasoning): a CLOSED
+                    # interval whose primary never committed an up_thru
+                    # inside it cannot have acked writes — peering may
+                    # skip its members. The open interval is always
+                    # conservatively rw.
+                    rw = True
+                    if not is_last and primary not in (-1, None):
+                        rw = (
+                            epoch <= self._up_thru_floor.get(
+                                primary, -1
+                            )
+                            or any(
+                                epoch <= v <= end
+                                for v in self._up_thru_archive.get(
+                                    primary, []
+                                )
+                            )
+                        )
+                    out.append([epoch, acting, primary, rw])
                 return out
 
             if "queries" in args:

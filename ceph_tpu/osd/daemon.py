@@ -442,6 +442,9 @@ class OSDService(Dispatcher):
         self._tids = iter(range(1, 1 << 62))
         self._waiters: dict[int, asyncio.Future] = {}
         self._hb_last: dict[int, float] = {}
+        #: highest up_thru epoch already requested from the mon (the
+        #: OSD::up_thru_wanted role; avoids a request per peering pass)
+        self._up_thru_requested = 0
         #: peer -> last failure-report time; reports repeat every grace
         #: interval while the peer stays silent and up-in-map (a one-shot
         #: report can be lost to mon leadership churn, and the mon counts
@@ -894,6 +897,13 @@ class OSDService(Dispatcher):
                 if ivs is None or all(iv[0] <= pg.les for iv in ivs):
                     continue
             pg.active = False
+            # first map epoch at which we saw THIS acting set: the
+            # up_thru value to confirm before activation (it provably
+            # lies within the current interval, which is what makes the
+            # mon's maybe_went_rw computation see it)
+            if getattr(pg, "up_thru_seen_acting", None) != acting:
+                pg.up_thru_seen_acting = list(acting)
+                pg.up_thru_need = m.epoch
             try:
                 async with pg.lock:
                     complete = await self._peer_and_recover(pg, acting)
@@ -909,6 +919,15 @@ class OSDService(Dispatcher):
                     and o not in pg.backfill_targets
                 )
                 if complete and ready >= need:
+                    if not await self._ensure_up_thru(
+                        getattr(pg, "up_thru_need", m.epoch)
+                    ):
+                        # alive-confirmation not committed yet: serving
+                        # writes before up_thru would let this interval
+                        # hold acked data that future peering (which
+                        # skips !maybe_went_rw intervals) could miss
+                        retry_needed = True
+                        continue
                     pg.active = True
                     pg.last_acting = list(acting)
                     pg.set_les(m.epoch)
@@ -1227,7 +1246,15 @@ class OSDService(Dispatcher):
             return False  # no map history without a mon quorum: wait
         pool = self.osdmap.pools[pg.pool]
         contacted = set(infos)
-        for _epoch, acting_h, primary_h in intervals:
+        for interval in intervals:
+            _epoch, acting_h, primary_h = interval[:3]
+            # interval-accurate prior set (PastIntervals maybe_went_rw,
+            # osd_types.h:3030): a closed interval whose primary never
+            # committed up_thru inside it cannot hold acked writes —
+            # skip it instead of blocking on its unreachable members
+            rw = interval[3] if len(interval) > 3 else True
+            if not rw:
+                continue
             live = [o for o in acting_h if o != _NONE]
             if primary_h in (-1, _NONE) or len(live) < pool.min_size:
                 continue  # could not have gone active
@@ -1247,6 +1274,31 @@ class OSDService(Dispatcher):
         }
         pushed = await self._push_missing(pg, acting, member_infos)
         return ok and pushed
+
+    async def _ensure_up_thru(self, need: int) -> bool:
+        """Alive-confirmation gate (OSD::send_alive -> OSDMonitor::
+        prepare_alive): True once the committed map's up_thru for this
+        daemon reaches `need` (the first epoch we saw the activating
+        interval). Serving writes before the commit would create an
+        interval that future peering — which skips !maybe_went_rw
+        intervals — could not know to consult."""
+        m = self.osdmap
+        if self.id < m.max_osd and int(m.osd_up_thru[self.id]) >= need:
+            return True
+        if self._up_thru_requested >= need:
+            # commit in flight; the committed inc will dirty the map and
+            # re-run this pass
+            return False
+        self._up_thru_requested = need
+        try:
+            rep = await self.mon.command(
+                "osd up-thru", {"osd": self.id, "epoch": need},
+                timeout=5.0,
+            )
+            return int(rep.get("up_thru", 0)) >= need
+        except Exception:
+            self._up_thru_requested = 0  # mon churn: re-request
+            return False
 
     async def _pg_history(self, pg: PG):
         """Past intervals for `pg`, fetched in ONE bulk mon command per

@@ -56,6 +56,12 @@ class OSDMap:
     osd_up: np.ndarray | None = None  # bool (max_osd,)
     osd_weight: np.ndarray | None = None  # int64 16.16 in/out weight
     osd_primary_affinity: np.ndarray | None = None  # int64 16.16
+    #: per-osd up_thru epoch (osd_info_t::up_thru): the highest epoch
+    #: the mon has confirmed this OSD was alive-and-primary in. The
+    #: load-bearing bit of interval math: a past interval whose primary
+    #: never got up_thru confirmed inside it CANNOT have served writes
+    #: (maybe_went_rw=false), so peering may skip its members
+    osd_up_thru: np.ndarray | None = None  # int64 (max_osd,)
     pg_upmap: dict[tuple[int, int], list[int]] = field(default_factory=dict)
     pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = field(
         default_factory=dict
@@ -84,6 +90,8 @@ class OSDMap:
             self.osd_up = np.ones(n, dtype=bool)
         if self.osd_weight is None:
             self.osd_weight = np.full(n, 0x10000, dtype=np.int64)
+        if self.osd_up_thru is None:
+            self.osd_up_thru = np.zeros(n, dtype=np.int64)
         self._compiled = None
 
     # -- state transitions (the failure-detection consumer) -------------------
@@ -611,6 +619,8 @@ class Incremental:
     new_blocklist: dict = _field(default_factory=dict)
     #: entity identities to un-blocklist
     old_blocklist: list = _field(default_factory=list)
+    #: osd -> confirmed up_thru epoch (OSDMonitor prepare_alive)
+    new_up_thru: dict = _field(default_factory=dict)
 
     def encode(self) -> bytes:
         def body(b):
@@ -656,8 +666,10 @@ class Incremental:
             b.mapping(self.new_blocklist, lambda e, k: e.string(k),
                       lambda e, v: e.f64(v))
             b.list(sorted(self.old_blocklist), lambda e, v: e.string(v))
+            b.mapping(self.new_up_thru, lambda e, k: e.u32(k),
+                      lambda e, v: e.u64(v))
 
-        return _Encoder().struct(3, 1, body).bytes()
+        return _Encoder().struct(4, 1, body).bytes()
 
     @staticmethod
     def decode(raw: bytes) -> "Incremental":
@@ -708,9 +720,13 @@ class Incremental:
                     lambda d: d.string(), lambda d: d.f64()
                 )
                 inc.old_blocklist = b.list(lambda d: d.string())
+            if version >= 4:
+                inc.new_up_thru = b.mapping(
+                    lambda d: d.u32(), lambda d: d.u64()
+                )
             return inc
 
-        return _Decoder(raw).struct(3, body)
+        return _Decoder(raw).struct(4, body)
 
 
 def apply_incremental(self, inc: Incremental) -> None:
@@ -731,6 +747,7 @@ def apply_incremental(self, inc: Incremental) -> None:
         self.osd_exists = grow(self.osd_exists, True, bool)
         self.osd_up = grow(self.osd_up, True, bool)
         self.osd_weight = grow(self.osd_weight, 0x10000, np.int64)
+        self.osd_up_thru = grow(self.osd_up_thru, 0, np.int64)
         if self.osd_primary_affinity is not None:
             self.osd_primary_affinity = grow(
                 self.osd_primary_affinity, DEFAULT_PRIMARY_AFFINITY, np.int64
@@ -791,6 +808,11 @@ def apply_incremental(self, inc: Incremental) -> None:
     self.blocklist.update(inc.new_blocklist)
     for entity in inc.old_blocklist:
         self.blocklist.pop(entity, None)
+    for osd, e in inc.new_up_thru.items():
+        if 0 <= osd < self.max_osd:
+            self.osd_up_thru[osd] = max(
+                int(self.osd_up_thru[osd]), int(e)
+            )
     self.epoch = inc.epoch
 
 
@@ -831,8 +853,11 @@ def encode_osdmap(self) -> bytes:
                   lambda e, v: e.string(v[0]).u32(v[1]))
         b.mapping(self.blocklist, lambda e, k: e.string(k),
                   lambda e, v: e.f64(v))
+        b.list(
+            [int(v) for v in self.osd_up_thru], lambda e, v: e.u64(v)
+        )
 
-    return _Encoder().struct(2, 1, body).bytes()
+    return _Encoder().struct(3, 1, body).bytes()
 
 
 def decode_osdmap(raw: bytes) -> "OSDMap":
@@ -878,9 +903,15 @@ def decode_osdmap(raw: bytes) -> "OSDMap":
             m.blocklist = b.mapping(
                 lambda d: d.string(), lambda d: d.f64()
             )
+        if version >= 3:
+            m.osd_up_thru = np.array(
+                b.list(lambda d: d.u64()), dtype=np.int64
+            )
+            if len(m.osd_up_thru) != m.max_osd:
+                m.osd_up_thru = np.zeros(m.max_osd, dtype=np.int64)
         return m
 
-    return _Decoder(raw).struct(2, body)
+    return _Decoder(raw).struct(3, body)
 
 
 # bound here so the dataclass body above stays focused on placement; these

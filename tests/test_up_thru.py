@@ -1,0 +1,155 @@
+"""PastIntervals interval math via up_thru (VERDICT r4 missing #7,
+src/osd/osd_types.h:3030 + OSDMap::check_new_interval's maybe_went_rw).
+
+A primary must commit an up_thru confirmation into the OSDMap BEFORE
+serving writes in a new interval; peering's prior-set gate then skips
+closed intervals whose primary never confirmed one — they provably hold
+no acked writes — instead of blocking on their unreachable members.
+"""
+
+import asyncio
+
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import (
+    REP_POOL,
+    Cluster,
+    wait_until,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 240))
+
+
+def test_up_thru_committed_before_serving_and_rw_flags():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        admin = Rados("client.ut", cluster.monmap, config=cluster.cfg)
+        await admin.connect()
+        await cluster.create_pools(admin)
+        io = admin.io_ctx(REP_POOL)
+        await io.write_full("obj", b"served")
+
+        # every primary that served went through the alive gate: its
+        # up_thru is committed in the map
+        leader = next(m for m in cluster.mons if m.is_leader)
+        m = leader.osdmap
+        primaries = set()
+        for ps in range(m.pools[REP_POOL].pg_num):
+            _u, _up, _acting, primary = m.pg_to_up_acting_osds(
+                REP_POOL, ps
+            )
+            primaries.add(primary)
+        for p in primaries:
+            assert int(m.osd_up_thru[p]) > 0, f"osd.{p} served w/o up_thru"
+
+        # pg history intervals carry the rw flag, and the open interval
+        # of an active PG is rw
+        rep = await admin.mon_command(
+            "pg history", {"pgid": [REP_POOL, 0], "from": 0}
+        )
+        ivs = rep["intervals"]
+        assert ivs and all(len(iv) == 4 for iv in ivs)
+        assert ivs[-1][3] is True
+
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_maybe_went_rw_computation():
+    """The mon's interval flagging, driven deterministically against
+    fabricated archives."""
+
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        admin = Rados("client.rw", cluster.monmap, config=cluster.cfg)
+        await admin.connect()
+        await cluster.create_pools(admin)
+        leader = next(m for m in cluster.mons if m.is_leader)
+
+        key = (99, 0)  # a fake PG: archives are plain dicts
+        leader._acting_archive[key] = [
+            (5, [3, 4, 5], 3),    # closed [5, 9]
+            (10, [4, 5, 0], 4),   # closed [10, 14]
+            (15, [3, 4, 5], 3),   # open   [15, now]
+        ]
+        leader.osdmap.pools[99] = leader.osdmap.pools[REP_POOL]
+        # osd.3 confirmed up_thru only at epoch 7; osd.4 never did
+        leader._up_thru_archive = {3: [7]}
+
+        rep = await admin.mon_command(
+            "pg history", {"pgid": [99, 0], "from": 0}
+        )
+        ivs = rep["intervals"]
+        assert [iv[3] for iv in ivs] == [
+            True,   # primary 3, up_thru 7 in [5, 9] -> served maybe
+            False,  # primary 4 never confirmed -> provably write-free
+            True,   # open interval: always conservative
+        ]
+
+        # prune floor keeps ancient intervals conservatively rw
+        leader._up_thru_floor[4] = 12
+        rep = await admin.mon_command(
+            "pg history", {"pgid": [99, 0], "from": 0}
+        )
+        assert [iv[3] for iv in rep["intervals"]] == [True, True, True]
+
+        del leader.osdmap.pools[99]
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_prior_set_skips_write_free_intervals():
+    """The OSD gate: a closed !rw interval of unreachable members does
+    NOT block peering; the same interval flagged rw does."""
+
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        admin = Rados("client.ps", cluster.monmap, config=cluster.cfg)
+        await admin.connect()
+        await cluster.create_pools(admin)
+        io = admin.io_ctx(REP_POOL)
+        await io.write_full("seed", b"s")
+
+        # find a PG's primary daemon
+        some = next(iter(cluster.osds.values()))
+        m = some.osdmap
+        ps = 0
+        _u, _up, acting, primary = m.pg_to_up_acting_osds(REP_POOL, ps)
+        osd = cluster.osds[primary]
+        pg = osd.pgs[(REP_POOL, ps)]
+
+        # fabricate history: a closed interval whose members are GONE
+        # (ids beyond the cluster). With rw=False peering proceeds...
+        ghost = [(2, [97, 98, 96], 97, False),
+                 (m.epoch, list(acting), primary, True)]
+
+        async def fake_hist(_pg):
+            return ghost
+
+        orig = osd._pg_history
+        osd._pg_history = fake_hist
+        try:
+            async with pg.lock:
+                ok = await osd._peer_and_recover(pg, acting)
+            assert ok, "write-free interval must not block peering"
+
+            # ...with rw=True the same unreachable members block
+            ghost[0] = (2, [97, 98, 96], 97, True)
+            async with pg.lock:
+                ok = await osd._peer_and_recover(pg, acting)
+            assert not ok, "maybe-rw interval with no reachable member"
+        finally:
+            osd._pg_history = orig
+
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
