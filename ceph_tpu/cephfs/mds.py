@@ -204,23 +204,16 @@ class MDSService:
             )
         elif op == "mkdir":
             await self.ioctx.write_full(_dir_obj(ev["ino"]), b"")
-            await self.ioctx.exec(
-                _dir_obj(ev["parent"]), "fs_dir", "link",
-                {"name": ev["name"], "ino": ev["ino"],
-                 "type": "dir", "replace": True},
+            await self._dir_link(
+                ev["parent"], ev["name"], ev["ino"], "dir"
             )
         elif op == "create":
-            await self.ioctx.exec(
-                _dir_obj(ev["parent"]), "fs_dir", "link",
-                {"name": ev["name"], "ino": ev["ino"],
-                 "type": "file", "replace": True},
+            await self._dir_link(
+                ev["parent"], ev["name"], ev["ino"], "file"
             )
         elif op == "unlink":
             try:
-                await self.ioctx.exec(
-                    _dir_obj(ev["parent"]), "fs_dir", "unlink",
-                    {"name": ev["name"]},
-                )
+                await self._dir_unlink(ev["parent"], ev["name"])
             except RadosError:
                 pass  # replay: already gone
             if ev.get("ino"):
@@ -248,6 +241,43 @@ class MDSService:
                 _dir_obj(ev["dir"]), "snaps",
                 json.dumps(realm, sort_keys=True).encode(),
             )
+        elif op == "fragment":
+            # re-shard the directory's dentries across 2^bits fragment
+            # objects (CDir::split). Idempotent: replay at the target
+            # bit count is a no-op
+            ino, bits = ev["ino"], ev["bits"]
+            cur = await self._dir_bits(ino)
+            if cur >= bits:
+                return
+            entries = await self._entries(ino)
+            for name, entry in entries.items():
+                await self.ioctx.exec(
+                    self._frag_obj(
+                        ino, self._frag_of(name, bits), bits
+                    ),
+                    "fs_dir", "link",
+                    {"name": name, "ino": entry["ino"],
+                     "type": entry["type"], "replace": True},
+                )
+            # drop the OLD layout's dentries, keep the base object (it
+            # holds the frags/snaps xattrs)
+            if cur == 0:
+                try:
+                    await self.ioctx.omap_clear(_dir_obj(ino))
+                except RadosError:
+                    pass
+            else:
+                for frag in range(1 << cur):
+                    try:
+                        await self.ioctx.remove(
+                            self._frag_obj(ino, frag, cur)
+                        )
+                    except ObjectNotFound:
+                        pass
+            await self.ioctx.setxattr(
+                _dir_obj(ino), "frags",
+                json.dumps({"bits": bits}).encode(),
+            )
         elif op == "rmsnap":
             realm = await self._realm(ev["dir"])
             if ev["name"] in realm:
@@ -262,27 +292,16 @@ class MDSService:
                 pass  # replay: already removed from the pool
         elif op == "rmdir":
             try:
-                await self.ioctx.exec(
-                    _dir_obj(ev["parent"]), "fs_dir", "unlink",
-                    {"name": ev["name"]},
-                )
+                await self._dir_unlink(ev["parent"], ev["name"])
             except RadosError:
                 pass
-            try:
-                await self.ioctx.remove(_dir_obj(ev["ino"]))
-            except ObjectNotFound:
-                pass
+            await self._remove_dir_objects(ev["ino"])
         elif op == "rename":
-            await self.ioctx.exec(
-                _dir_obj(ev["dparent"]), "fs_dir", "link",
-                {"name": ev["dname"], "ino": ev["ino"],
-                 "type": ev["type"], "replace": True},
+            await self._dir_link(
+                ev["dparent"], ev["dname"], ev["ino"], ev["type"]
             )
             try:
-                await self.ioctx.exec(
-                    _dir_obj(ev["sparent"]), "fs_dir", "unlink",
-                    {"name": ev["sname"]},
-                )
+                await self._dir_unlink(ev["sparent"], ev["sname"])
             except RadosError:
                 pass
         else:
@@ -331,13 +350,115 @@ class MDSService:
             return None
         return {"seq": max(snaps), "snaps": sorted(snaps, reverse=True)}
 
+    # -- directory fragments (CDir/frag_t, src/mds/CDir.h mini) ----------------
+    #
+    # An unfragmented directory keeps its dentries in the dir object's
+    # omap (bits=0). Once a fragment crosses mds_bal_split_size the MDS
+    # journals a "fragment" event doubling the fragment count: dentries
+    # re-shard across 2^bits fragment OBJECTS routed by rjenkins(name),
+    # so a huge directory's omap (and its update contention) spreads
+    # over many RADOS objects/PGs — the reference's dirfrag scaling
+    # axis. The split is journaled-then-applied and idempotent, like
+    # every other namespace mutation.
+
+    @staticmethod
+    def _frag_obj(ino: int, frag: int, bits: int) -> str:
+        # namespaced by the bit generation: a split from bits=1 to
+        # bits=2 re-shards into FRESH objects (f2_0..f2_3) before the
+        # old generation (f1_0..f1_1) is dropped — same-name reuse
+        # would destroy re-sharded entries mid-split
+        return f"{_dir_obj(ino)}.f{bits}_{frag:x}"
+
+    async def _dir_bits(self, ino: int) -> int:
+        try:
+            raw = await self.ioctx.getxattr(_dir_obj(ino), "frags")
+        except (ObjectNotFound, RadosError):
+            return 0
+        return json.loads(raw)["bits"]
+
+    @staticmethod
+    def _frag_of(name: str, bits: int) -> int:
+        from ceph_tpu.common.hash import ceph_str_hash_rjenkins
+
+        return ceph_str_hash_rjenkins(name) & ((1 << bits) - 1)
+
+    async def _dentry_obj(self, ino: int, name: str) -> str:
+        bits = await self._dir_bits(ino)
+        if bits == 0:
+            return _dir_obj(ino)
+        return self._frag_obj(ino, self._frag_of(name, bits), bits)
+
+    async def _dir_link(
+        self, ino: int, name: str, child: int, type_: str
+    ) -> None:
+        await self.ioctx.exec(
+            await self._dentry_obj(ino, name), "fs_dir", "link",
+            {"name": name, "ino": child, "type": type_,
+             "replace": True},
+        )
+
+    async def _dir_unlink(self, ino: int, name: str) -> None:
+        await self.ioctx.exec(
+            await self._dentry_obj(ino, name), "fs_dir", "unlink",
+            {"name": name},
+        )
+
+    async def _remove_dir_objects(self, ino: int) -> None:
+        bits = await self._dir_bits(ino)
+        for frag in range(1 << bits if bits else 0):
+            try:
+                await self.ioctx.remove(
+                    self._frag_obj(ino, frag, bits)
+                )
+            except ObjectNotFound:
+                pass
+        try:
+            await self.ioctx.remove(_dir_obj(ino))
+        except ObjectNotFound:
+            pass
+
+    async def _maybe_split(self, ino: int, name: str) -> None:
+        """Post-link check: fragment the dir when the dentry's fragment
+        crossed the split size (MDBalancer's split trigger, journaled
+        like any namespace mutation — but as an INTERNAL event with no
+        client reqid: it is idempotent and must not clobber the
+        triggering op's replay ack)."""
+        bits = await self._dir_bits(ino)
+        target = (
+            _dir_obj(ino) if bits == 0
+            else self._frag_obj(ino, self._frag_of(name, bits), bits)
+        )
+        listing = await self.ioctx.exec(
+            target, "fs_dir", "list", {}
+        )
+        if len(listing["entries"]) <= self.config.get(
+            "mds_bal_split_size"
+        ):
+            return
+        await self._journal_and_apply({
+            "op": "fragment", "ino": ino, "bits": bits + 1,
+        })
+
     # -- namespace helpers -----------------------------------------------------
 
     async def _entries(self, ino: int) -> dict:
-        listing = await self.ioctx.exec(
-            _dir_obj(ino), "fs_dir", "list", {}
-        )
-        return listing["entries"]
+        bits = await self._dir_bits(ino)
+        if bits == 0:
+            listing = await self.ioctx.exec(
+                _dir_obj(ino), "fs_dir", "list", {}
+            )
+            return listing["entries"]
+        merged: dict = {}
+        for frag in range(1 << bits):
+            try:
+                listing = await self.ioctx.exec(
+                    self._frag_obj(ino, frag, bits),
+                    "fs_dir", "list", {},
+                )
+            except ObjectNotFound:
+                continue
+            merged.update(listing["entries"])
+        return merged
 
     async def _resolve_dir(self, parts: list[str]) -> int:
         ino = ROOT_INO
@@ -576,6 +697,7 @@ class MDSService:
                 "op": "mkdir", "parent": parent, "name": name,
                 "ino": ino, **rid,
             })
+            await self._maybe_split(parent, name)
             return {"ino": ino}
         if op == "readdir":
             ino = await self._resolve_dir(self._split(p["path"]))
@@ -598,6 +720,7 @@ class MDSService:
                     "op": "create", "parent": parent, "name": name,
                     "ino": ino, **rid,
                 })
+                await self._maybe_split(parent, name)
             elif entry["type"] != "file":
                 raise MDSError("EISDIR", f"{p['path']!r} is a dir")
             else:

@@ -164,6 +164,9 @@ SCHEMA: dict[str, Option] = {
              "and a standby promotes"),
         _opt("mds_beacon_interval", TYPE_FLOAT, LEVEL_ADVANCED, 0.5,
              "seconds between MDS beacons to the mon"),
+        _opt("mds_bal_split_size", TYPE_UINT, LEVEL_ADVANCED, 10000,
+             "dentries in one directory fragment before the MDS splits "
+             "it (CDir fragmentation, mds_bal_split_size)"),
         _opt("mds_blocklist_expire", TYPE_FLOAT, LEVEL_ADVANCED, 3600.0,
              "seconds an MDS-evicted client stays blocklisted in the "
              "OSDMap (mds_session_blacklist_on_evict + "
