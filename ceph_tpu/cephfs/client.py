@@ -74,24 +74,50 @@ class CephFSClient:
         # MDS cap table): cached data is no longer revoke-protected
         self._cache.clear()
         rep = await self.objecter.mon.command("fs map", timeout=10.0)
-        active = rep["fsmap"]["active"]
-        if active is None:
+        fm = rep["fsmap"]
+        actives = fm.get("actives")
+        if actives is None:
+            actives = [fm["active"]] if fm.get("active") else []
+        if not actives:
             raise CephFSError("ENOENT", "no active MDS")
-        self._mds_conn = self.objecter.messenger.connect(
-            tuple(active["addr"]), Policy.lossless_client()
-        )
-        tid = next(self._tids)
-        fut = asyncio.get_event_loop().create_future()
-        self._waiters[tid] = fut
-        self._mds_conn.send_message(Message(
-            type="mds_session_open", tid=tid,
-            data=json.dumps({"tid": tid}).encode(),
-        ))
-        try:
-            await asyncio.wait_for(fut, 5.0)
-        finally:
-            self._waiters.pop(tid, None)
+        # one session per RANK (the multi-active FSMap): requests route
+        # by top-level directory hash, matching the MDS partition
+        self._actives = actives
+        self._mds_conns = {}
+        for rank, m in enumerate(actives):
+            conn = self.objecter.messenger.connect(
+                tuple(m["addr"]), Policy.lossless_client()
+            )
+            tid = next(self._tids)
+            fut = asyncio.get_event_loop().create_future()
+            self._waiters[tid] = fut
+            conn.send_message(Message(
+                type="mds_session_open", tid=tid,
+                data=json.dumps({"tid": tid}).encode(),
+            ))
+            try:
+                await asyncio.wait_for(fut, 5.0)
+            finally:
+                self._waiters.pop(tid, None)
+            self._mds_conns[rank] = conn
+        self._mds_conn = self._mds_conns[0]
         self._session_open = True
+
+    def _rank_of(self, payload: dict) -> int:
+        """Mirror of the MDS partition: rank by rjenkins(top-level
+        component); root/admin ops go to rank 0."""
+        n = len(getattr(self, "_actives", []) or [1])
+        if n <= 1:
+            return 0
+        path = payload.get("path") or payload.get("src")
+        if path is None:
+            return 0
+        parts = [x for x in path.strip("/").split("/") if x]
+        if not parts:
+            return 0
+        from ceph_tpu.common.hash import ceph_str_hash_rjenkins
+
+        return ceph_str_hash_rjenkins(parts[0]) % n
 
     async def mount(self) -> None:
         await self._connect_mds()
@@ -123,7 +149,10 @@ class CephFSClient:
                 self.objecter.config.get("mds_beacon_grace") + 2.0
             )
             try:
-                self._mds_conn.send_message(Message(
+                conn = getattr(self, "_mds_conns", {}).get(
+                    self._rank_of(payload), self._mds_conn
+                )
+                conn.send_message(Message(
                     type="mds_request", tid=tid,
                     data=json.dumps(payload).encode(),
                 ))
@@ -137,7 +166,10 @@ class CephFSClient:
                 continue
             finally:
                 self._waiters.pop(tid, None)
-            if rep.get("not_active") or rep.get("no_session"):
+            if (
+                rep.get("not_active") or rep.get("no_session")
+                or rep.get("wrong_rank")
+            ):
                 self._session_open = False
                 await asyncio.sleep(0.2)
                 if asyncio.get_event_loop().time() > deadline:

@@ -79,6 +79,12 @@ class MDSService:
         self.pool_id = pool_id
         self.journaler: Journaler | None = None
         self.active = False
+        #: this daemon's ACTIVE rank (None while standby): ranks
+        #: partition the namespace by top-level directory hash (the
+        #: subtree-partitioning role of MDBalancer, static at mini
+        #: scale) and name the journal each rank owns
+        self.rank: int | None = None
+        self.n_actives = 1
         self.fsmap_epoch = 0
         self._sessions: dict[str, _Session] = {}
         #: ino -> {client_name: "r"|"w"} granted capabilities
@@ -105,7 +111,6 @@ class MDSService:
         from ceph_tpu.rados.client import IoCtx
 
         self.ioctx = IoCtx(self.objecter, self.pool_id)
-        self.journaler = Journaler(self.ioctx, JOURNAL_OBJ)
         await self._beacon()  # learn the initial role
         self._tasks.append(asyncio.create_task(self._beacon_loop()))
 
@@ -130,13 +135,25 @@ class MDSService:
             timeout=5.0,
         )
         fm = rep["fsmap"]
+        actives = fm.get("actives")
+        if actives is None:
+            actives = [fm["active"]] if fm.get("active") else []
         was_active = self.active
-        self.active = (
-            fm["active"] is not None
-            and fm["active"]["name"] == self.name
+        old_rank = self.rank
+        self.rank = next(
+            (i for i, m in enumerate(actives)
+             if m["name"] == self.name),
+            None,
         )
+        self.active = self.rank is not None
+        self.n_actives = max(1, len(actives))
         self.fsmap_epoch = fm["epoch"]
-        if self.active and not was_active:
+        if self.active and (not was_active or old_rank != self.rank):
+            # rank identity = journal identity: a takeover replays the
+            # journal of the RANK we now hold, not a global one
+            self.journaler = Journaler(
+                self.ioctx, f"{JOURNAL_OBJ}.{self.rank}"
+            )
             await self._takeover()
 
     async def _beacon_loop(self) -> None:
@@ -591,10 +608,36 @@ class MDSService:
             data=json.dumps(reply).encode(),
         ))
 
+    def _owns(self, p: dict) -> bool:
+        """Static subtree partition: ops on top-level entries route by
+        rjenkins(first path component) % n_actives; root-level and
+        admin ops (mkfs) belong to rank 0. Cross-subtree renames
+        execute at the SOURCE owner (dir objects are cluster-side cls
+        state, so any rank may link; cap state for the moved ino stays
+        behind — stated mini reduction)."""
+        if self.n_actives <= 1:
+            return True
+        path = p.get("path") or p.get("src")
+        if path is None:
+            return self.rank == 0
+        parts = [x for x in path.strip("/").split("/") if x]
+        if not parts:
+            return self.rank == 0
+        from ceph_tpu.common.hash import ceph_str_hash_rjenkins
+
+        return (
+            ceph_str_hash_rjenkins(parts[0]) % self.n_actives
+            == self.rank
+        )
+
     async def _handle_request(self, conn, p: dict) -> dict:
         tid = p.get("tid", 0)
         if not self.active:
             return {"tid": tid, "ok": False, "not_active": True}
+        if not self._owns(p):
+            # the client's map is stale or it mis-routed: bounce with
+            # the authoritative hint (MDS_MAP epoch bump role)
+            return {"tid": tid, "ok": False, "wrong_rank": True}
         session = self._sessions.get(conn.peer_name)
         if session is None:
             return {"tid": tid, "ok": False, "no_session": True}

@@ -164,6 +164,9 @@ SCHEMA: dict[str, Option] = {
              "and a standby promotes"),
         _opt("mds_beacon_interval", TYPE_FLOAT, LEVEL_ADVANCED, 0.5,
              "seconds between MDS beacons to the mon"),
+        _opt("mds_max_active", TYPE_UINT, LEVEL_BASIC, 1,
+             "active metadata daemons (FSMap max_mds): ranks partition "
+             "the namespace by top-level directory hash"),
         _opt("mds_bal_split_size", TYPE_UINT, LEVEL_ADVANCED, 10000,
              "dentries in one directory fragment before the MDS splits "
              "it (CDir fragmentation, mds_bal_split_size)"),

@@ -1450,7 +1450,7 @@ class Monitor(Dispatcher):
         if cmd == "mgr map":
             return {"mgrmap": self.mgrmap}
         if cmd == "fs map":
-            return {"fsmap": self.fsmap}
+            return {"fsmap": self._fsmap_out()}
         raise ValueError(f"unknown command {cmd!r}")
 
     async def _cmd_mgr_beacon(self, args: dict) -> dict:
@@ -1498,48 +1498,67 @@ class Monitor(Dispatcher):
         name, addr = args["name"], list(args["addr"])
         now = asyncio.get_event_loop().time()
         self._mds_beacons[name] = now
-        fm = self.fsmap
+        fm = self._fsmap_out()
+        actives = list(fm["actives"])
+        standbys = list(fm["standbys"])
+        max_mds = int(self.config.get("mds_max_active"))
         # beacons are leader-volatile: after a mon restart or leader
-        # change the active has no record yet — stamp it as seen NOW so
-        # a standby's first beacon can't trigger a spurious failover
-        if fm["active"] is not None:
-            self._mds_beacons.setdefault(fm["active"]["name"], now)
-        known = {
-            m["name"] for m in ([fm["active"]] if fm["active"] else [])
-        } | {m["name"] for m in fm["standbys"]}
+        # change the actives have no record yet — stamp them as seen NOW
+        # so a standby's first beacon can't trigger a spurious failover
+        for m in actives:
+            self._mds_beacons.setdefault(m["name"], now)
+        known = {m["name"] for m in actives} | {
+            s["name"] for s in standbys
+        }
         grace = self.config.get("mds_beacon_grace")
         propose = None
+        me = {"name": name, "addr": addr}
         if name not in known:
-            if fm["active"] is None:
-                propose = {
-                    "active": {"name": name, "addr": addr},
-                    "standbys": fm["standbys"],
-                }
+            # admission: fill active RANKS up to max_mds (the FSMap's
+            # multi-active ladder), then stand by
+            if len(actives) < max_mds:
+                propose = {"actives": actives + [me],
+                           "standbys": standbys}
             else:
-                propose = {
-                    "active": fm["active"],
-                    "standbys": fm["standbys"]
-                    + [{"name": name, "addr": addr}],
-                }
-        elif (
-            fm["active"] is not None
-            and fm["active"]["name"] != name
-            and now - self._mds_beacons.get(
-                fm["active"]["name"], 0.0
-            ) > grace
-            and any(s["name"] == name for s in fm["standbys"])
-        ):
-            # the active went silent: promote THIS standby; the failed
-            # daemon is dropped and re-admits as standby if it revives
-            propose = {
-                "active": {"name": name, "addr": addr},
-                "standbys": [
-                    s for s in fm["standbys"] if s["name"] != name
-                ],
-            }
+                propose = {"actives": actives,
+                           "standbys": standbys + [me]}
+        elif any(s["name"] == name for s in standbys):
+            # a standby's beacon drives failover: take over a stale
+            # active's RANK in place (rank identity = journal identity,
+            # so the successor replays the right journal), or fill a
+            # below-max rank ladder
+            stale = next(
+                (
+                    i for i, m in enumerate(actives)
+                    if now - self._mds_beacons.get(m["name"], 0.0)
+                    > grace
+                ),
+                None,
+            )
+            rest = [s for s in standbys if s["name"] != name]
+            if stale is not None:
+                new_actives = list(actives)
+                new_actives[stale] = me
+                propose = {"actives": new_actives, "standbys": rest}
+            elif len(actives) < max_mds:
+                propose = {"actives": actives + [me],
+                           "standbys": rest}
         if propose is not None:
+            propose["max_mds"] = max_mds
             await self.propose("fsmap", json.dumps(propose).encode())
-        return {"fsmap": self.fsmap}
+        return {"fsmap": self._fsmap_out()}
+
+    def _fsmap_out(self) -> dict:
+        """FSMap in the rank-based shape, with the single-active alias
+        ('active' = rank 0) kept for older consumers."""
+        fm = dict(self.fsmap)
+        actives = fm.get("actives")
+        if actives is None:
+            actives = [fm["active"]] if fm.get("active") else []
+        fm["actives"] = actives
+        fm["active"] = actives[0] if actives else None
+        fm.setdefault("standbys", [])
+        return fm
 
     def _health(self) -> dict:
         """Real health checks (the role of Monitor.cc's get_health /
