@@ -43,6 +43,8 @@ class MgrService:
 
     async def stop(self) -> None:
         self._stopped = True
+        if getattr(self, "http", None) is not None:
+            await self.http.stop()
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
@@ -77,12 +79,14 @@ class MgrService:
         operators drive them through this daemon from now on."""
         from ceph_tpu.mgr.autoscaler import PgAutoscaler
         from ceph_tpu.mgr.balancer import BalancerModule
+        from ceph_tpu.mgr.dashboard import DashboardModule
         from ceph_tpu.mgr.prometheus import PrometheusExporter
 
         self.modules = {
             "balancer": BalancerModule(self.objecter.mon),
             "pg_autoscaler": PgAutoscaler(self.objecter),
             "prometheus": PrometheusExporter(self.objecter),
+            "dashboard": DashboardModule(self.objecter),
         }
 
     # -- module surface --------------------------------------------------------
@@ -92,3 +96,13 @@ class MgrService:
         if not self.active:
             raise RuntimeError(f"{self.name} is standby")
         return await self.modules["prometheus"].collect()
+
+    async def serve_http(self, host: str = "127.0.0.1",
+                         port: int = 0) -> int:
+        """Start the dashboard/metrics HTTP front (dashboard module's
+        CherryPy role); serves 503 while standby."""
+        from ceph_tpu.mgr.dashboard import DashboardServer
+
+        self.http = DashboardServer(self)
+        p = await self.http.start(host, port)
+        return p

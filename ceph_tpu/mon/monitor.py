@@ -1433,6 +1433,27 @@ class Monitor(Dispatcher):
                 "pools": sorted(self.osdmap.pools),
                 "health": self._health(),
             }
+        if cmd == "df":
+            # `ceph df` (the PGMap usage report): cluster totals +
+            # per-osd utilization from the statfs riding pg stats
+            now_df = asyncio.get_event_loop().time()
+            per_osd = {}
+            total = used = 0
+            for osd, (t, stats) in sorted(self._pg_stats.items()):
+                st = stats.get("statfs")
+                if not st or now_df - t > 30 or self.osdmap.is_down(
+                    osd
+                ):
+                    continue
+                per_osd[str(osd)] = st
+                total += st["total"]
+                used += st["used"]
+            return {
+                "total_bytes": total,
+                "used_bytes": used,
+                "avail_bytes": max(0, total - used),
+                "osds": per_osd,
+            }
         if cmd == "pg stats report":
             # primaries report PG state sums (num/degraded/undersized/
             # backfilling/peering/inconsistent) — the PGStats flow that

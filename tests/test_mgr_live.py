@@ -96,7 +96,7 @@ def test_mgr_failover_keeps_prometheus_serving():
         text = await a.prometheus_scrape()
         assert "ceph" in text or "osd" in text
         assert set(a.modules) == {
-            "balancer", "pg_autoscaler", "prometheus"
+            "balancer", "pg_autoscaler", "prometheus", "dashboard"
         }
 
         # kill the active: the standby's beacons promote it
@@ -109,6 +109,80 @@ def test_mgr_failover_keeps_prometheus_serving():
         text = await b.prometheus_scrape()
         assert text  # non-empty scrape
 
+        await b.stop()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_dashboard_http_surface():
+    """The mgr dashboard's API tier (src/pybind/mgr/dashboard role):
+    the ACTIVE serves /api/status, /api/df, /api/health and /metrics
+    over HTTP; a standby's server refuses with 503."""
+
+    async def main():
+        from tests.test_s3_auth_ext import raw_http
+
+        cluster = Cluster()
+        await cluster.start()
+        admin = Rados("client.dash", cluster.monmap, config=cluster.cfg)
+        await admin.connect()
+        await cluster.create_pools(admin)
+        io = admin.io_ctx(REP_POOL)
+        await io.write_full("obj", b"x" * 5000)
+
+        a = MgrService("mgr.a", cluster.monmap, config=cluster.cfg)
+        b = MgrService("mgr.b", cluster.monmap, config=cluster.cfg)
+        await a.start()
+        await wait_until(lambda: a.active, timeout=30)
+        await b.start()
+        pa = await a.serve_http()
+        pb = await b.serve_http()
+
+        # statfs rides pg stats on an interval: wait for df substance
+        async def df_ready():
+            df = await admin.mon_command("df")
+            return df["total_bytes"] > 0
+
+        loop = asyncio.get_event_loop()
+        end = loop.time() + 30
+        while not await df_ready():
+            assert loop.time() < end
+            await asyncio.sleep(0.3)
+
+        import json as _json
+
+        st, _, body = await raw_http("127.0.0.1", pa, "GET",
+                                     "/api/status")
+        assert st == 200
+        doc = _json.loads(body)
+        assert doc["cluster"]["num_osds"] == 6
+        assert doc["df"]["total_bytes"] > 0
+        assert doc["mgrmap"]["active"] == "mgr.a"
+
+        st, _, body = await raw_http("127.0.0.1", pa, "GET", "/api/df")
+        df = _json.loads(body)
+        assert df["used_bytes"] > 0 and len(df["osds"]) == 6
+
+        st, _, body = await raw_http("127.0.0.1", pa, "GET",
+                                     "/api/health")
+        assert st == 200 and _json.loads(body)["status"].startswith(
+            "HEALTH"
+        )
+
+        st, _, body = await raw_http("127.0.0.1", pa, "GET", "/metrics")
+        assert st == 200 and body
+
+        # the standby refuses: operators see the role plainly
+        st, _, _ = await raw_http("127.0.0.1", pb, "GET", "/api/status")
+        assert st == 503
+
+        # `ceph df` CLI rides the same mon command
+        df = await admin.mon_command("df")
+        assert df["avail_bytes"] == df["total_bytes"] - df["used_bytes"]
+
+        await a.stop()
         await b.stop()
         await admin.shutdown()
         await cluster.stop()

@@ -6,6 +6,7 @@ quorum and daemons from the shell.
 Commands mirror the reference surface:
 
     status | -s                      cluster status (quorum, epoch, osds)
+    df                               cluster + per-osd utilization
     health                           health checks (OSD_DOWN, PG_DEGRADED,
                                      PG_DAMAGED, ...) with severities
     osd tree                         crush hierarchy with up/down + weights
@@ -64,6 +65,9 @@ async def _dispatch(rados, args) -> dict:
 
     if cmd == "health":
         return await rados.mon_command("health")
+
+    if cmd == "df":
+        return await rados.mon_command("df")
 
     if cmd == "osd":
         sub = args.rest[0]
