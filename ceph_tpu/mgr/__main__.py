@@ -1,0 +1,21 @@
+"""``python -m ceph_tpu.mgr --id N --spec cluster_spec.json``
+
+The mgr daemon main for vstart multi-process deployments (the
+ceph-mgr binary's role): one daemon in its own OS process,
+SIGTERM-clean. Pool bindings ride the spec's extras.
+"""
+
+import argparse
+
+from ceph_tpu.vstart import daemon_main
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--id", type=int, required=True)
+    ap.add_argument("--spec", required=True)
+    args = ap.parse_args()
+    daemon_main("mgr", args.id, args.spec)
+
+
+main()

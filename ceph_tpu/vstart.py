@@ -59,6 +59,9 @@ class ClusterSpec:
     run_dir: str
     config: dict = field(default_factory=dict)
     keyring: dict = field(default_factory=dict)  # entity -> hex secret
+    #: launcher-only knobs outside the typed Config schema (pool ids
+    #: for mds/rgw daemons, rgw user database, ...)
+    extras: dict = field(default_factory=dict)
 
     def save(self, path: str) -> None:
         with open(path, "w") as f:
@@ -69,6 +72,7 @@ class ClusterSpec:
                     "run_dir": self.run_dir,
                     "config": self.config,
                     "keyring": self.keyring,
+                    "extras": self.extras,
                 },
                 f,
                 indent=1,
@@ -84,6 +88,7 @@ class ClusterSpec:
             run_dir=d["run_dir"],
             config=d.get("config", {}),
             keyring=d.get("keyring", {}),
+            extras=d.get("extras", {}),
         )
 
     # -- deterministic seeds --------------------------------------------------
@@ -191,14 +196,19 @@ def daemon_main(kind: str, ident: int, spec_path: str) -> None:
         stop_evt = asyncio.Event()
         cfg = spec.build_config()
         keyring = spec.bytes_keyring()
-        if kind == "osd" and cfg.get("osd_objectstore") == "memstore":
-            from ceph_tpu.common.kv import MemDB
+        db = None
+        if kind in ("mon", "osd"):
+            if (
+                kind == "osd"
+                and cfg.get("osd_objectstore") == "memstore"
+            ):
+                from ceph_tpu.common.kv import MemDB
 
-            db = MemDB()
-        else:
-            db = FileDB(
-                os.path.join(spec.run_dir, f"{kind}.{ident}.kv")
-            )
+                db = MemDB()
+            else:
+                db = FileDB(
+                    os.path.join(spec.run_dir, f"{kind}.{ident}.kv")
+                )
         if kind == "mon":
             from ceph_tpu.mon import Monitor
 
@@ -224,6 +234,18 @@ def daemon_main(kind: str, ident: int, spec_path: str) -> None:
             osd = OSDService(
                 ident, spec.monmap(), db=db, config=cfg, keyring=keyring
             )
+            # the reference OSD dlopens every cls plugin at boot; a
+            # daemon-main OSD registers all built-in class families so
+            # MDS/RGW/journal consumers work against any process
+            from ceph_tpu.cephfs.fs import register_fs_classes
+            from ceph_tpu.journal.journal import (
+                register_journal_classes,
+            )
+            from ceph_tpu.rgw.gateway import register_rgw_classes
+
+            register_fs_classes(osd)
+            register_journal_classes(osd)
+            register_rgw_classes(osd)
             await osd.start()
 
             async def _stop():
@@ -232,6 +254,76 @@ def daemon_main(kind: str, ident: int, spec_path: str) -> None:
 
             _install_term_handler(loop, _stop)
             print(f"osd.{ident} up at {osd.messenger.my_addr}", flush=True)
+        elif kind == "mds":
+            from ceph_tpu.cephfs.mds import MDSService
+
+            mds = MDSService(
+                f"mds.{ident}", spec.monmap(),
+                int(spec.extras.get("mds_data_pool", 1)),
+                config=cfg, keyring=keyring,
+            )
+            await mds.start()
+
+            async def _stop():
+                await mds.stop()
+                stop_evt.set()
+
+            _install_term_handler(loop, _stop)
+            print(f"mds.{ident} up at {mds.addr}", flush=True)
+        elif kind == "rgw":
+            from ceph_tpu.rados.client import IoCtx, Rados
+            from ceph_tpu.rgw import ObjectGateway, S3Frontend
+
+            rados = Rados(
+                f"client.rgw{ident}", spec.monmap(), config=cfg,
+                keyring=keyring,
+            )
+            await rados.connect()
+            gw = ObjectGateway(
+                IoCtx(rados.objecter,
+                      int(spec.extras.get("rgw_data_pool", 2))),
+                index_ioctx=IoCtx(
+                    rados.objecter,
+                    int(spec.extras.get("rgw_index_pool", 1)),
+                ),
+            )
+            users = dict(spec.extras.get("rgw_users") or {})
+            front = S3Frontend(gw, users=users)
+            port = await front.start()
+            # the kernel-assigned port is published for the launcher
+            # (vstart.sh writes the same kind of run files)
+            with open(
+                os.path.join(spec.run_dir, f"rgw.{ident}.port"), "w"
+            ) as f:
+                f.write(str(port))
+
+            async def _stop():
+                await front.stop()
+                await rados.shutdown()
+                stop_evt.set()
+
+            _install_term_handler(loop, _stop)
+            print(f"rgw.{ident} serving S3 on :{port}", flush=True)
+        elif kind == "mgr":
+            from ceph_tpu.mgr.daemon import MgrService
+
+            mgr = MgrService(
+                f"mgr.{ident}", spec.monmap(), config=cfg,
+                keyring=keyring,
+            )
+            await mgr.start()
+            port = await mgr.serve_http()
+            with open(
+                os.path.join(spec.run_dir, f"mgr.{ident}.port"), "w"
+            ) as f:
+                f.write(str(port))
+
+            async def _stop():
+                await mgr.stop()
+                stop_evt.set()
+
+            _install_term_handler(loop, _stop)
+            print(f"mgr.{ident} http on :{port}", flush=True)
         else:  # pragma: no cover - guarded by argparse choices
             raise SystemExit(f"unknown daemon kind {kind!r}")
         await _run_forever(stop_evt)
@@ -297,9 +389,19 @@ class VStart:
         self.env.update(env or {})
         self.mons: dict[int, subprocess.Popen] = {}
         self.osds: dict[int, subprocess.Popen] = {}
+        self.extra: dict[tuple, subprocess.Popen] = {}
         self._logs: list = []
 
     # -- process management ---------------------------------------------------
+
+    #: daemon kind -> python module hosting its __main__
+    _KIND_MODULE = {
+        "mon": "ceph_tpu.mon",
+        "osd": "ceph_tpu.osd",
+        "mds": "ceph_tpu.cephfs",
+        "rgw": "ceph_tpu.rgw",
+        "mgr": "ceph_tpu.mgr",
+    }
 
     def _spawn(self, kind: str, ident: int) -> subprocess.Popen:
         log = open(
@@ -310,7 +412,7 @@ class VStart:
             [
                 sys.executable,
                 "-m",
-                f"ceph_tpu.{kind}",
+                self._KIND_MODULE[kind],
                 "--id",
                 str(ident),
                 "--spec",
@@ -331,6 +433,30 @@ class VStart:
     def start_osd(self, osd_id: int) -> None:
         self.osds[osd_id] = self._spawn("osd", osd_id)
 
+    def start_daemon(self, kind: str, ident: int) -> None:
+        """Spawn an mds/rgw/mgr process (their pools must exist first —
+        the vstart.sh ordering). Pool bindings/users ride spec.extras."""
+        self.extra[(kind, ident)] = self._spawn(kind, ident)
+
+    def daemon_port(self, kind: str, ident: int,
+                    timeout: float = 60.0) -> int:
+        """Kernel-assigned port an rgw/mgr daemon published in its run
+        file (vstart.sh's out-dir convention)."""
+        path = os.path.join(
+            self.spec.run_dir, f"{kind}.{ident}.port"
+        )
+        end = time.time() + timeout
+        while time.time() < end:
+            try:
+                with open(path) as f:
+                    raw = f.read().strip()
+                if raw:
+                    return int(raw)
+            except FileNotFoundError:
+                pass
+            time.sleep(0.2)
+        raise TimeoutError(f"{kind}.{ident} never published a port")
+
     def kill_osd(self, osd_id: int, sig: int = signal.SIGKILL) -> None:
         p = self.osds.pop(osd_id)
         p.send_signal(sig)
@@ -342,7 +468,10 @@ class VStart:
         p.wait(timeout=30)
 
     def stop(self) -> None:
-        procs = list(self.mons.values()) + list(self.osds.values())
+        procs = (
+            list(self.mons.values()) + list(self.osds.values())
+            + list(self.extra.values())
+        )
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGKILL)
@@ -355,6 +484,7 @@ class VStart:
             log.close()
         self.mons.clear()
         self.osds.clear()
+        self.extra.clear()
 
     # -- client-side helpers --------------------------------------------------
 

@@ -11,6 +11,7 @@ store (ceph-osd restart semantics).
 """
 
 import asyncio
+import json
 import os
 import signal
 
@@ -139,6 +140,74 @@ def test_multiprocess_osd_crash_and_revival(vstart):
         await vstart.wait_healthy(rados=r, timeout=90)
         assert await rep.read("during-outage") == payload
         assert await rep.read("pre-0") == payload
+        await r.shutdown()
+
+    run(main())
+
+
+def test_multiprocess_full_stack_mds_rgw_mgr(vstart):
+    """The whole service tier as real processes: MDS (cephfs), RGW (S3
+    over HTTP), and mgr (dashboard HTTP) daemons join the multi-process
+    cluster; a client in the test process drives all three."""
+
+    async def main():
+        vstart.spec.extras.update({
+            "mds_data_pool": REP_POOL,
+            "rgw_data_pool": EC_POOL,
+            "rgw_index_pool": REP_POOL,
+            "rgw_users": {"AKMP": "multiprocess-secret"},
+        })
+        vstart.spec.save(vstart.spec_path)
+        r = await connect_client(vstart)
+        await vstart.wait_healthy(rados=r)
+        await create_pools(r)
+        vstart.start_daemon("mds", 0)
+        vstart.start_daemon("rgw", 0)
+        vstart.start_daemon("mgr", 0)
+
+        # -- CephFS against the MDS process
+        from ceph_tpu.cephfs import CephFSClient
+
+        fs = CephFSClient(r, REP_POOL)
+        await fs.mount()
+        await fs.mkfs()
+        await fs.mkdir("/docs")
+        await fs.write_file("/docs/hello", b"multi-process fs")
+        assert await fs.read_file("/docs/hello") == b"multi-process fs"
+
+        # -- S3 against the RGW process (real HTTP + SigV4)
+        from tests.test_s3_rest import MiniS3Client
+
+        s3_port = vstart.daemon_port("rgw", 0)
+        c = MiniS3Client(
+            "127.0.0.1", s3_port, "AKMP", "multiprocess-secret"
+        )
+        st, _, _ = await c.request("PUT", "/bucket")
+        assert st == 200
+        st, _, _ = await c.request(
+            "PUT", "/bucket/obj", payload=b"s3 across processes"
+        )
+        assert st == 200
+        st, _, body = await c.request("GET", "/bucket/obj")
+        assert st == 200 and body == b"s3 across processes"
+
+        # -- dashboard against the mgr process
+        from tests.test_s3_auth_ext import raw_http
+
+        mgr_port = vstart.daemon_port("mgr", 0)
+        st, _, body = await raw_http(
+            "127.0.0.1", mgr_port, "GET", "/api/status"
+        )
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["cluster"]["num_osds"] == 5
+        assert doc["mgrmap"]["active"] == "mgr.0"
+
+        # every service really is its own OS process
+        assert len(vstart.extra) == 3
+        assert all(
+            p.poll() is None for p in vstart.extra.values()
+        )
         await r.shutdown()
 
     run(main())
