@@ -897,13 +897,6 @@ class OSDService(Dispatcher):
                 if ivs is None or all(iv[0] <= pg.les for iv in ivs):
                     continue
             pg.active = False
-            # first map epoch at which we saw THIS acting set: the
-            # up_thru value to confirm before activation (it provably
-            # lies within the current interval, which is what makes the
-            # mon's maybe_went_rw computation see it)
-            if getattr(pg, "up_thru_seen_acting", None) != acting:
-                pg.up_thru_seen_acting = list(acting)
-                pg.up_thru_need = m.epoch
             try:
                 async with pg.lock:
                     complete = await self._peer_and_recover(pg, acting)
@@ -1244,6 +1237,14 @@ class OSDService(Dispatcher):
         intervals = await self._pg_history(pg)
         if intervals is None:
             return False  # no map history without a mon quorum: wait
+        # the CURRENT interval's start epoch (same_interval_since): the
+        # up_thru value activation must confirm. Taken from the mon's
+        # interval archive, NOT from when this daemon first noticed the
+        # interval — a first-seen epoch would ratchet with every
+        # up_thru commit and a mass PG split would cascade epochs
+        pg.up_thru_need = intervals[-1][0] if intervals else (
+            self.osdmap.epoch
+        )
         pool = self.osdmap.pools[pg.pool]
         contacted = set(infos)
         for interval in intervals:

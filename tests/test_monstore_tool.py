@@ -60,13 +60,11 @@ def test_monstore_dump_extract_copy_and_surgery(tmp_path, capsys):
             lambda: cluster.mons[0].osdmap.epoch
             == cluster.mons[1].osdmap.epoch
         )
-        epoch = cluster.mons[0].osdmap.epoch
         await rados.shutdown()
         await cluster.stop()
         db0.close()
-        return epoch
 
-    epoch = run(build())
+    run(build())
 
     # -- dump: paxos meta + per-version service map
     assert mst.main(["--store-path", str(tmp_path / "mon0.kv"),
@@ -75,6 +73,12 @@ def test_monstore_dump_extract_copy_and_surgery(tmp_path, capsys):
     assert dump["last_committed"] >= 3
     services = {v["service"] for v in dump["versions"]}
     assert "osdmap" in services
+    # the mon re-stamps incremental epochs at apply time, so the true
+    # final epoch is base(1) + the number of committed osdmap values —
+    # derived from the log, not from a racy live snapshot
+    epoch = 1 + sum(
+        1 for v in dump["versions"] if v["service"] == "osdmap"
+    )
 
     # -- get-osdmap: replay to the committed epoch over the spec seed
     assert mst.main([

@@ -165,8 +165,18 @@ def test_multiprocess_full_stack_mds_rgw_mgr(vstart):
         vstart.start_daemon("rgw", 0)
         vstart.start_daemon("mgr", 0)
 
-        # -- CephFS against the MDS process
+        # -- CephFS against the MDS process (interpreter startup takes
+        # seconds: wait for its beacon to claim the active rank)
         from ceph_tpu.cephfs import CephFSClient
+
+        async def mds_active():
+            fm = (await r.mon_command("fs map"))["fsmap"]
+            return fm.get("active") is not None
+
+        end = asyncio.get_event_loop().time() + 90
+        while not await mds_active():
+            assert asyncio.get_event_loop().time() < end, "no MDS"
+            await asyncio.sleep(0.5)
 
         fs = CephFSClient(r, REP_POOL)
         await fs.mount()

@@ -129,9 +129,13 @@ def main(argv=None) -> int:
             if service != "osdmap":
                 continue
             inc = Incremental.decode(payload)
-            if inc.epoch == m.epoch + 1:
-                m.apply_incremental(inc)
-                applied += 1
+            # the mon re-stamps at apply time (Monitor._apply_value):
+            # the committed payload keeps the proposing handler's epoch
+            # GUESS, which concurrent proposals make stale — the
+            # replayed epoch is always current+1
+            inc.epoch = m.epoch + 1
+            m.apply_incremental(inc)
+            applied += 1
         if args.out:
             with open(args.out, "wb") as f:
                 f.write(m.encode())
