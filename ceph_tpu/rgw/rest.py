@@ -122,11 +122,16 @@ class S3Frontend:
         self, gateway: ObjectGateway,
         users: dict[str, str] | None = None,
         region: str = "us-east-1",
+        dns_name: str | None = None,
     ):
         self.gw = gateway
         #: access_key -> secret_key (the rgw user database role)
         self.users = dict(users or {})
         self.region = region
+        #: rgw_dns_name: when set, Host "<bucket>.<dns_name>" addresses
+        #: the bucket virtual-host style (rgw_rest.cc's
+        #: hostnames_set handling); path-style always works too
+        self.dns_name = dns_name
         self._server: asyncio.AbstractServer | None = None
         self.port: int | None = None
 
@@ -464,10 +469,24 @@ class S3Frontend:
         except (ObjectNotFound, GatewayError):
             return False
 
+    def _vhost_bucket(self, headers) -> str | None:
+        """Virtual-host addressing: Host '<bucket>.<rgw_dns_name>'."""
+        if not self.dns_name:
+            return None
+        host = headers.get("host", "").split(":", 1)[0]
+        suffix = "." + self.dns_name
+        if host.endswith(suffix) and host != self.dns_name:
+            return host[: -len(suffix)]
+        return None
+
     async def _route(self, method, path, query, headers, body, auth):
-        parts = path.lstrip("/").split("/", 1)
-        bucket = parts[0]
-        key = parts[1] if len(parts) > 1 else ""
+        vbucket = self._vhost_bucket(headers)
+        if vbucket is not None:
+            bucket, key = vbucket, path.lstrip("/")
+        else:
+            parts = path.lstrip("/").split("/", 1)
+            bucket = parts[0]
+            key = parts[1] if len(parts) > 1 else ""
         if not bucket:
             raise S3Error(400, "InvalidRequest", "bucket required")
         if auth.get("anonymous") and not await self._anonymous_allowed(

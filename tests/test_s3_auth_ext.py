@@ -325,3 +325,44 @@ def test_streaming_chunked_upload():
         await cluster.stop()
 
     run(main())
+
+
+def test_virtual_host_addressing():
+    """Host '<bucket>.<rgw_dns_name>' addresses the bucket
+    virtual-host style (rgw_dns_name / hostnames handling); path-style
+    keeps working on the same frontend."""
+
+    async def main():
+        cluster, rados, front, port = await start_stack()
+        front.dns_name = "s3.example.test"
+        c = MiniS3Client("127.0.0.1", port, AK, SK)
+        await c.request("PUT", "/vhb")
+        await c.request("PUT", "/vhb/obj", payload=b"dual addressed")
+
+        # unsigned public read via virtual host (prove routing, not auth)
+        h = c._sign("PUT", "/vhb/obj", {"acl": ""}, b"")
+        h["x-amz-acl"] = "public-read"
+        await raw_http("127.0.0.1", port, "PUT", "/vhb/obj?acl=",
+                       headers=h)
+        st, _, body = await raw_http(
+            "127.0.0.1", port, "GET", "/obj",
+            headers={"host": "vhb.s3.example.test"},
+        )
+        assert st == 200 and body == b"dual addressed"
+        # path-style still resolves on the same frontend
+        st, _, body = await raw_http(
+            "127.0.0.1", port, "GET", "/vhb/obj",
+        )
+        assert st == 200 and body == b"dual addressed"
+        # an unknown vhost bucket 404s rather than mis-rooting
+        st, _, _ = await raw_http(
+            "127.0.0.1", port, "GET", "/obj",
+            headers={"host": "nosuch.s3.example.test"},
+        )
+        assert st in (403, 404)
+
+        await front.stop()
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
