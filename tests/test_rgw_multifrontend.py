@@ -40,59 +40,69 @@ def test_concurrent_version_pushes_across_frontends():
             clients.append(MiniS3Client("127.0.0.1", port, AK, SK))
 
         a, b = clients
-        await a.request("PUT", "/shared")
-        await a.request(
-            "PUT", "/shared", query={"versioning": ""},
-            payload=(b'<VersioningConfiguration><Status>Enabled'
-                     b'</Status></VersioningConfiguration>'),
-        )
+        try:
+            await a.request("PUT", "/shared")
+            await a.request(
+                "PUT", "/shared", query={"versioning": ""},
+                payload=(b'<VersioningConfiguration><Status>Enabled'
+                         b'</Status></VersioningConfiguration>'),
+            )
 
-        # both frontends hammer the SAME key concurrently
-        async def push(c, tag, n):
-            vids = []
-            for i in range(n):
-                st, hd, _ = await c.request(
-                    "PUT", "/shared/hot",
-                    payload=f"{tag}-{i}".encode(),
-                )
-                assert st == 200
-                vids.append(hd["x-amz-version-id"])
-            return vids
+            # both frontends hammer the SAME key concurrently
+            async def push(c, tag, n):
+                vids = {}
+                for i in range(n):
+                    payload = f"{tag}-{i}".encode()
+                    st, hd, _ = await c.request(
+                        "PUT", "/shared/hot", payload=payload
+                    )
+                    assert st == 200
+                    vids[hd["x-amz-version-id"]] = payload
+                return vids
 
-        vids_a, vids_b = await asyncio.gather(
-            push(a, "alpha", 8), push(b, "beta", 8)
-        )
-        all_vids = set(vids_a) | set(vids_b)
-        assert len(all_vids) == 16  # no version id lost or reused
+            by_vid_a, by_vid_b = await asyncio.gather(
+                push(a, "alpha", 8), push(b, "beta", 8)
+            )
+            by_vid = {**by_vid_a, **by_vid_b}
+            vids_a = list(by_vid_a)
+            vids_b = list(by_vid_b)
+            assert len(by_vid) == 16  # no version id lost or reused
 
-        # the stack holds every version, each readable with its bytes
-        st, _, body = await a.request(
-            "GET", "/shared", query={"versions": ""}
-        )
-        assert st == 200
-        assert body.count(b"<Version>") == 16
-        for vid in vids_a[:2] + vids_b[:2]:
-            st, _, data = await b.request(
-                "GET", "/shared/hot", query={"versionId": vid}
+            # the stack holds every version, each readable with its bytes
+            st, _, body = await a.request(
+                "GET", "/shared", query={"versions": ""}
             )
             assert st == 200
-            assert data.startswith((b"alpha-", b"beta-"))
+            assert body.count(b"<Version>") == 16
+            for vid in vids_a[:2] + vids_b[:2]:
+                st, _, data = await b.request(
+                    "GET", "/shared/hot", query={"versionId": vid}
+                )
+                assert st == 200
+                assert data == by_vid[vid]  # EXACT version's bytes
 
-        # cross-frontend deletes of specific versions converge too
-        for vid in (vids_a[0], vids_b[0]):
-            st, _, _ = await a.request(
-                "DELETE", "/shared/hot", query={"versionId": vid}
+            # cross-frontend deletes: each client removes one of ITS
+            # versions; the other frontend observes convergence
+            for c, vid in ((a, vids_a[0]), (b, vids_b[0])):
+                st, _, _ = await c.request(
+                    "DELETE", "/shared/hot", query={"versionId": vid}
+                )
+                assert st == 204
+            st, _, body = await b.request(
+                "GET", "/shared", query={"versions": ""}
             )
-            assert st == 204
-        st, _, body = await b.request(
-            "GET", "/shared", query={"versions": ""}
-        )
-        assert body.count(b"<Version>") == 14
+            assert st == 200
+            assert body.count(b"<Version>") == 14
+            gone = {vids_a[0], vids_b[0]}
+            for vid in by_vid:
+                present = f"<VersionId>{vid}</VersionId>".encode() in body
+                assert present == (vid not in gone), vid
 
-        for front in fronts:
-            await front.stop()
-        for r in radoses:
-            await r.shutdown()
-        await cluster.stop()
+        finally:
+            for front in fronts:
+                await front.stop()
+            for r in radoses:
+                await r.shutdown()
+            await cluster.stop()
 
     run(main())
