@@ -224,13 +224,14 @@ class CephFSClient:
         epoch = self._revoked.get(ino, 0)
         # the open reply carries the realm chain's snap context
         # (SnapRealm propagation with the cap): writes apply it so the
-        # OSD clones objects on first-write-after-snap
-        saved = self.ioctx.snapc
-        self.ioctx.snapc = got.get("snapc")
-        try:
-            await self.striper.write(_file_soid(ino), data)
-        finally:
-            self.ioctx.snapc = saved
+        # OSD clones objects on first-write-after-snap. A PRIVATE IoCtx
+        # per call: save/restore on the shared handle corrupts the
+        # context when calls interleave on the event loop
+        from ceph_tpu.rados.client import IoCtx
+
+        wctx = IoCtx(self.objecter, self.ioctx.pool_id)
+        wctx.snapc = got.get("snapc")
+        await RadosStriper(wctx).write(_file_soid(ino), data)
         if self._revoked.get(ino, 0) == epoch:
             self._cache[ino] = data  # no revoke raced the write
         return ino
@@ -239,16 +240,17 @@ class CephFSClient:
         got = await self.open(path, mode="r")
         ino = got["ino"]
         if got.get("snapid") is not None:
-            # a .snap path: read the striped objects AT the snapid;
-            # never cached (past data has no cap protection to need)
-            saved = self.ioctx.read_snap
-            self.ioctx.read_snap = got["snapid"]
+            # a .snap path: read the striped objects AT the snapid via a
+            # private IoCtx (same interleaving hazard as writes); never
+            # cached (past data has no cap protection to need)
+            from ceph_tpu.rados.client import IoCtx
+
+            rctx = IoCtx(self.objecter, self.ioctx.pool_id)
+            rctx.read_snap = got["snapid"]
             try:
-                return await self.striper.read(_file_soid(ino))
+                return await RadosStriper(rctx).read(_file_soid(ino))
             except ObjectNotFound:
                 return b""
-            finally:
-                self.ioctx.read_snap = saved
         cached = self._cache.get(ino)
         if cached is not None:
             return cached  # cap-protected cache: revoke drops it

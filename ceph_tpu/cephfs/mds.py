@@ -100,6 +100,9 @@ class MDSService:
         #: failover because mutations journal their reqid)
         self._replayed: dict[tuple[str, int], dict] = {}
         self._applied_pos = 0
+        #: (dir ino, dentry name) -> fragment size reported by the last
+        #: link cls op (the split trigger's O(1) feed)
+        self._frag_counts: dict[tuple, int] = {}
         self._stopped = False
         self._tasks: list[asyncio.Task] = []
 
@@ -407,12 +410,17 @@ class MDSService:
 
     async def _dir_link(
         self, ino: int, name: str, child: int, type_: str
-    ) -> None:
-        await self.ioctx.exec(
+    ) -> int:
+        rep = await self.ioctx.exec(
             await self._dentry_obj(ino, name), "fs_dir", "link",
             {"name": name, "ino": child, "type": type_,
              "replace": True},
         )
+        count = int(rep.get("count", 0))
+        # remember the fragment's size as reported by its own primary:
+        # the O(1) feed for the split trigger
+        self._frag_counts[(ino, name)] = count
+        return count
 
     async def _dir_unlink(self, ino: int, name: str) -> None:
         await self.ioctx.exec(
@@ -439,19 +447,13 @@ class MDSService:
         crossed the split size (MDBalancer's split trigger, journaled
         like any namespace mutation — but as an INTERNAL event with no
         client reqid: it is idempotent and must not clobber the
-        triggering op's replay ack)."""
-        bits = await self._dir_bits(ino)
-        target = (
-            _dir_obj(ino) if bits == 0
-            else self._frag_obj(ino, self._frag_of(name, bits), bits)
-        )
-        listing = await self.ioctx.exec(
-            target, "fs_dir", "list", {}
-        )
-        if len(listing["entries"]) <= self.config.get(
-            "mds_bal_split_size"
-        ):
+        triggering op's replay ack). O(1): the link cls op already
+        reported the fragment's post-insert count — listing the whole
+        fragment per create would make population O(n^2)."""
+        count = self._frag_counts.pop((ino, name), 0)
+        if count <= self.config.get("mds_bal_split_size"):
             return
+        bits = await self._dir_bits(ino)
         await self._journal_and_apply({
             "op": "fragment", "ino": ino, "bits": bits + 1,
         })

@@ -2392,7 +2392,12 @@ class OSDService(Dispatcher):
                 try:
                     await self._tier_flush(pool, pg, acting, name)
                 except Exception:
-                    dirty.pop(name, None)  # retried on the next trigger
+                    # keep it TRACKED (dropping it would orphan the
+                    # only durable copy in the cache): rotate to the
+                    # back and stop this pass; the next trigger retries
+                    dirty.pop(name, None)
+                    dirty[name] = True
+                    break
         finally:
             pg.tier_agent_busy = False
 
@@ -2421,14 +2426,34 @@ class OSDService(Dispatcher):
             return True
         if op == "delete":
             # deletes write through: cache copy AND base object go
-            # (mini semantics — the reference caches a whiteout)
-            try:
-                await self._tier_call(
-                    pool.tier_of, name, "tier_delete", {}
-                )
-            except Exception:
-                pass
+            # (mini semantics — the reference caches a whiteout). A
+            # failed base delete must NOT be swallowed: the local copy
+            # going while the base copy survives would resurrect the
+            # object on the next promote
+            rep = await self._tier_call(
+                pool.tier_of, name, "tier_delete", {}
+            )
+            base_had = rep.get("ok") and rep.get("errno") != "ENOENT"
+            if not rep.get("ok"):
+                raise RuntimeError(
+                    rep.get("error", "tier base delete failed")
+                )  # retryable: the client resends
             self._tier_dirty_set(pg).pop(name, None)
+            if not self._tier_exists_here(pg, name):
+                # base-only object (flushed + evicted): the base delete
+                # IS the whole operation — answer here, or the normal
+                # path would ENOENT an object we just deleted
+                reply = {"tid": p["tid"], "ok": True}
+                if not base_had:
+                    reply = {"tid": p["tid"], "ok": False,
+                             "errno": "ENOENT",
+                             "error": f"no such object {name!r}"}
+                conn.send_message(
+                    Message(type="osd_op_reply", tid=p["tid"],
+                            epoch=self.osdmap.epoch,
+                            data=json.dumps(reply).encode())
+                )
+                return True
             return False
         if not self._tier_exists_here(pg, name):
             await self._tier_promote(pool, pg, acting, name)

@@ -243,9 +243,11 @@ class Image:
 
     async def lock_acquire(self, timeout: float = 10.0) -> None:
         await self._lock.acquire(timeout=timeout)
+        self._lock_held = True
 
     async def lock_release(self) -> None:
         await self._lock.release()
+        self._lock_held = False
 
     async def lock_holders(self) -> list:
         return await self._lock.holders()
@@ -254,7 +256,11 @@ class Image:
         await self._lock.break_lock(owner, blocklist=blocklist)
 
     async def close(self) -> None:
-        await self.lock_release()
+        # release only what THIS handle acquired: a read-only handle's
+        # close must not strip the exclusive lock a sibling handle of
+        # the same client (same owner/cookie at the cls) still relies on
+        if getattr(self, "_lock_held", False):
+            await self.lock_release()
 
     async def _save_header(self) -> None:
         # the header itself is never snapshotted: strip the snapc

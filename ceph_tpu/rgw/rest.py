@@ -338,9 +338,13 @@ class S3Frontend:
             )
         try:
             expires = int(query.get("X-Amz-Expires", "0"))
-            t0 = time.mktime(
+            import calendar
+
+            # UTC arithmetic: mktime + timezone is off by an hour
+            # whenever local DST is in effect
+            t0 = calendar.timegm(
                 time.strptime(amz_date, "%Y%m%dT%H%M%SZ")
-            ) - time.timezone
+            )
         except ValueError as e:
             raise S3Error(403, "AccessDenied", "bad date") from e
         if time.time() > t0 + expires:
