@@ -140,15 +140,19 @@ def test_dashboard_http_surface():
         pa = await a.serve_http()
         pb = await b.serve_http()
 
-        # statfs rides pg stats on an interval: wait for df substance
+        # statfs rides pg stats on an interval: wait until EVERY osd's
+        # report landed (a half-filled df races the assertions below;
+        # generous window — a loaded single-core box runs slow)
         async def df_ready():
             df = await admin.mon_command("df")
-            return df["total_bytes"] > 0
+            return (
+                df["used_bytes"] > 0 and len(df["osds"]) == 6
+            )
 
         loop = asyncio.get_event_loop()
-        end = loop.time() + 30
+        end = loop.time() + 90
         while not await df_ready():
-            assert loop.time() < end
+            assert loop.time() < end, await admin.mon_command("df")
             await asyncio.sleep(0.3)
 
         import json as _json
