@@ -1423,6 +1423,7 @@ class Monitor(Dispatcher):
             )
             return {}
         if cmd == "status":
+            fm = self._fsmap_out()
             return {
                 "epoch": self.osdmap.epoch,
                 "leader": self.leader_rank,
@@ -1432,6 +1433,21 @@ class Monitor(Dispatcher):
                 "num_up": int(self.osdmap.osd_up.sum()),
                 "pools": sorted(self.osdmap.pools),
                 "health": self._health(),
+                # the `ceph -s` service lines: mds and mgr states
+                "fsmap": {
+                    "actives": [
+                        m["name"] for m in fm["actives"]
+                    ],
+                    "standbys": [
+                        s["name"] for s in fm["standbys"]
+                    ],
+                },
+                "mgrmap": {
+                    "active": self.mgrmap.get("active"),
+                    "standbys": list(
+                        self.mgrmap.get("standbys", [])
+                    ),
+                },
             }
         if cmd == "df":
             # `ceph df` (the PGMap usage report): cluster totals +

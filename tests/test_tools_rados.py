@@ -154,3 +154,52 @@ def test_objectstore_tool_offline_and_pg_export_import(tmp_path):
         f"pg_{REP_POOL}_{ps}", "precious"
     ) == payload
     db.close()
+
+
+def test_rados_bench_and_status_services(capsys):
+    """`rados bench <secs> write|seq` (the operator throughput probe)
+    over the real CLI path, and `ceph status` carrying the mds/mgr
+    service lines."""
+    import json as _json
+
+    import tools.rados as rados_cli
+
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        try:
+            rados = Rados("client.rb", cluster.monmap,
+                          config=cluster.cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+            mon_host = ",".join(
+                f"{h}:{p}" for h, p in cluster.monmap.addrs
+            )
+            # the CLI owns its own loop: run it in a worker thread
+            rc = await asyncio.to_thread(rados_cli.main, [
+                "--mon-host", mon_host, "-p", str(REP_POOL),
+                "--bench-size", "4096", "--bench-concurrency", "4",
+                "bench", "1", "write",
+            ])
+            assert rc == 0
+            out = _json.loads(capsys.readouterr().out)
+            assert out["mode"] == "write" and out["ops"] > 0
+            assert out["bytes_per_sec"] > 0
+
+            rc = await asyncio.to_thread(rados_cli.main, [
+                "--mon-host", mon_host, "-p", str(REP_POOL),
+                "--bench-size", "4096", "--bench-concurrency", "4",
+                "bench", "1", "seq",
+            ])
+            assert rc == 0
+            out = _json.loads(capsys.readouterr().out)
+            assert out["mode"] == "seq" and out["ops"] > 0
+
+            st = await rados.mon_command("status")
+            assert st["fsmap"] == {"actives": [], "standbys": []}
+            assert st["mgrmap"]["active"] is None
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())

@@ -95,6 +95,53 @@ async def _amain(args) -> int:
             for name in await _pool_ls(rados, args.pool):
                 print(name)
             return 0
+        if cmd == "bench":
+            # `rados bench <seconds> write|seq` (src/tools/rados: the
+            # operator's quick cluster-throughput probe). write fills
+            # benchmark_data-* objects; seq reads them back.
+            import time as _time
+
+            seconds = float(args.rest[0]) if args.rest else 5.0
+            mode = args.rest[1] if len(args.rest) > 1 else "write"
+            size = args.bench_size
+            lanes = args.bench_concurrency
+            payload = bytes(range(256)) * (size // 256)
+            done = {"ops": 0}
+            end_at = _time.monotonic() + seconds
+
+            async def writer(lane: int):
+                i = 0
+                while _time.monotonic() < end_at:
+                    await io.write_full(
+                        f"benchmark_data-{lane}-{i}", payload
+                    )
+                    done["ops"] += 1
+                    i += 1
+
+            async def reader(lane: int):
+                i = 0
+                while _time.monotonic() < end_at:
+                    try:
+                        await io.read(f"benchmark_data-{lane}-{i}")
+                    except ObjectNotFound:
+                        i = 0
+                        continue
+                    done["ops"] += 1
+                    i += 1
+
+            fn = writer if mode == "write" else reader
+            t0 = _time.monotonic()
+            await asyncio.gather(*(fn(w) for w in range(lanes)))
+            elapsed = max(1e-9, _time.monotonic() - t0)
+            print(json.dumps({
+                "mode": mode,
+                "seconds": round(elapsed, 3),
+                "ops": done["ops"],
+                "object_size": size,
+                "bytes_per_sec": round(done["ops"] * size / elapsed),
+                "ops_per_sec": round(done["ops"] / elapsed, 2),
+            }, indent=2))
+            return 0
         print(f"unknown command {cmd!r}", file=sys.stderr)
         return 2
     except ObjectNotFound as e:
@@ -109,6 +156,8 @@ def main(argv=None) -> int:
     ap.add_argument("--mon-host", required=True)
     ap.add_argument("--name", default="client.admin")
     ap.add_argument("-p", "--pool", type=int, default=None)
+    ap.add_argument("--bench-size", type=int, default=65536)
+    ap.add_argument("--bench-concurrency", type=int, default=8)
     ap.add_argument("command")
     ap.add_argument("rest", nargs="*")
     args = ap.parse_args(argv)
