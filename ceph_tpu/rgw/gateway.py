@@ -633,6 +633,14 @@ class ObjectGateway:
     def _part_obj(bucket: str, key: str, upload_id: str, n: int) -> str:
         return f"{bucket}/{key}.__mp_{upload_id}.{n:05d}"
 
+    @staticmethod
+    def _uploads_obj(bucket: str) -> str:
+        return f".bucket.uploads.{bucket}"
+
+    @staticmethod
+    def _upload_row(key: str, upload_id: str) -> str:
+        return f"{key}\x00{upload_id}"
+
     async def initiate_multipart(self, bucket: str, key: str) -> str:
         if not await self.bucket_exists(bucket):
             raise GatewayError(f"no bucket {bucket!r}")
@@ -643,7 +651,69 @@ class ObjectGateway:
             )
         import uuid
 
-        return uuid.uuid4().hex[:16]
+        upload_id = uuid.uuid4().hex[:16]
+        # in-progress uploads are REGISTERED (RGWListMultipart /
+        # list_multipart_uploads need an index; part uploads are atomic
+        # single-row cls inserts so concurrent frontends never race)
+        await self.index_ioctx.exec(
+            self._uploads_obj(bucket), "rgw_index", "insert",
+            {"key": self._upload_row(key, upload_id),
+             "meta": {"key": key, "upload_id": upload_id,
+                      "initiated": time.time()}},
+        )
+        return upload_id
+
+    async def list_multipart_uploads(
+        self, bucket: str, prefix: str = ""
+    ) -> list:
+        """In-progress uploads (ListMultipartUploads)."""
+        try:
+            page = await self.index_ioctx.exec(
+                self._uploads_obj(bucket), "rgw_index", "list",
+                {"prefix": prefix, "max_entries": 1000},
+            )
+        except ObjectNotFound:
+            return []
+        return [
+            meta for row, meta in sorted(page["entries"].items())
+            if row.count("\x00") == 1  # part rows carry two
+        ]
+
+    async def list_parts(
+        self, bucket: str, key: str, upload_id: str
+    ) -> list:
+        """Uploaded parts of one in-progress upload (ListParts)."""
+        base = self._upload_row(key, upload_id) + "\x00"
+        try:
+            page = await self.index_ioctx.exec(
+                self._uploads_obj(bucket), "rgw_index", "list",
+                {"prefix": base, "max_entries": 10000},
+            )
+        except ObjectNotFound:
+            return []
+        return [
+            meta for _row, meta in sorted(page["entries"].items())
+        ]
+
+    async def _drop_upload_rows(
+        self, bucket: str, key: str, upload_id: str
+    ) -> None:
+        base = self._upload_row(key, upload_id)
+        try:
+            page = await self.index_ioctx.exec(
+                self._uploads_obj(bucket), "rgw_index", "list",
+                {"prefix": base, "max_entries": 10000},
+            )
+        except ObjectNotFound:
+            return
+        for row in page["entries"]:
+            try:
+                await self.index_ioctx.exec(
+                    self._uploads_obj(bucket), "rgw_index", "remove",
+                    {"key": row},
+                )
+            except RadosError:
+                pass
 
     async def upload_part(
         self, bucket: str, key: str, upload_id: str, part_num: int,
@@ -658,6 +728,13 @@ class ObjectGateway:
         # etag rides the part as an xattr so complete() never re-reads
         # part payloads (the S3 contract passes etags back at complete)
         await self.ioctx.setxattr(pname, "rgw.etag", etag.encode())
+        await self.index_ioctx.exec(
+            self._uploads_obj(bucket), "rgw_index", "insert",
+            {"key": (self._upload_row(key, upload_id)
+                     + f"\x00{part_num:05d}"),
+             "meta": {"part": part_num, "size": len(data),
+                      "etag": etag, "mtime": time.time()}},
+        )
         return etag
 
     async def complete_multipart(
@@ -704,6 +781,7 @@ class ObjectGateway:
         await self._remove_stray_parts(
             bucket, key, upload_id, keep=set(parts)
         )
+        await self._drop_upload_rows(bucket, key, upload_id)
         return etag
 
     async def _remove_stray_parts(
@@ -730,3 +808,4 @@ class ObjectGateway:
         # sparse part numbers are legal: scan past gaps with a bounded
         # consecutive-miss budget instead of stopping at the first hole
         await self._remove_stray_parts(bucket, key, upload_id, keep=set())
+        await self._drop_upload_rows(bucket, key, upload_id)

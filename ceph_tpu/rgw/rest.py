@@ -570,6 +570,24 @@ class S3Frontend:
             if method == "DELETE" and "lifecycle" in query:
                 await self.gw.delete_lifecycle(bucket)
                 return 204, {}, b""
+            if method == "GET" and "uploads" in query:
+                ups = await self.gw.list_multipart_uploads(
+                    bucket, prefix=query.get("prefix", "")
+                )
+                xml = [
+                    "<?xml version=\"1.0\" encoding=\"UTF-8\"?>",
+                    "<ListMultipartUploadsResult>",
+                    f"<Bucket>{escape(bucket)}</Bucket>",
+                ]
+                for u in ups:
+                    xml.append(
+                        "<Upload>"
+                        f"<Key>{escape(u['key'])}</Key>"
+                        f"<UploadId>{escape(u['upload_id'])}"
+                        "</UploadId></Upload>"
+                    )
+                xml.append("</ListMultipartUploadsResult>")
+                return 200, ok_xml, "".join(xml).encode()
             if method == "PUT" and "versioning" in query:
                 root = ElementTree.fromstring(body.decode())
                 ns = ""
@@ -688,6 +706,27 @@ class S3Frontend:
             raise S3Error(400, "MethodNotAllowed", method)
 
         # object-scoped ops (+ multipart query dialect)
+        if method == "GET" and "uploadId" in query:
+            parts = await self.gw.list_parts(
+                bucket, key, query["uploadId"]
+            )
+            xml = [
+                "<?xml version=\"1.0\" encoding=\"UTF-8\"?>",
+                "<ListPartsResult>",
+                f"<Bucket>{escape(bucket)}</Bucket>",
+                f"<Key>{escape(key)}</Key>",
+                f"<UploadId>{escape(query['uploadId'])}</UploadId>",
+            ]
+            for p_ in parts:
+                xml.append(
+                    "<Part>"
+                    f"<PartNumber>{p_['part']}</PartNumber>"
+                    f"<Size>{p_['size']}</Size>"
+                    f"<ETag>&quot;{p_['etag']}&quot;</ETag>"
+                    "</Part>"
+                )
+            xml.append("</ListPartsResult>")
+            return 200, ok_xml, "".join(xml).encode()
         if method == "POST" and "uploads" in query:
             upload_id = await self.gw.initiate_multipart(bucket, key)
             xml = (

@@ -366,3 +366,89 @@ def test_virtual_host_addressing():
         await cluster.stop()
 
     run(main())
+
+
+def test_multipart_listing_dialects():
+    """ListMultipartUploads (GET /bucket?uploads) and ListParts
+    (GET /bucket/key?uploadId): in-progress uploads are registered,
+    parts enumerate with sizes/etags, and complete/abort clear them."""
+
+    async def main():
+        from xml.etree import ElementTree
+
+        cluster, rados, front, port = await start_stack()
+        c = MiniS3Client("127.0.0.1", port, AK, SK)
+        await c.request("PUT", "/mpb")
+
+        # two in-progress uploads
+        ids = {}
+        for key in ("video", "backup"):
+            st, _, body = await c.request(
+                "POST", f"/mpb/{key}", query={"uploads": ""}
+            )
+            assert st == 200
+            root = ElementTree.fromstring(body.decode())
+            ids[key] = root.find(
+                ".//{*}UploadId"
+            ).text if root.tag.startswith("{") else root.find(
+                "UploadId"
+            ).text
+
+        st, _, _ = await c.request(
+            "PUT", "/mpb/video",
+            query={"uploadId": ids["video"], "partNumber": "1"},
+            payload=b"A" * 700,
+        )
+        assert st == 200
+        await c.request(
+            "PUT", "/mpb/video",
+            query={"uploadId": ids["video"], "partNumber": "2"},
+            payload=b"B" * 300,
+        )
+
+        # ListMultipartUploads shows both
+        st, _, body = await c.request(
+            "GET", "/mpb", query={"uploads": ""}
+        )
+        assert st == 200
+        assert body.count(b"<Upload>") == 2
+        assert b"video" in body and b"backup" in body
+
+        # ListParts shows sizes in order
+        st, _, body = await c.request(
+            "GET", "/mpb/video", query={"uploadId": ids["video"]}
+        )
+        assert st == 200
+        assert body.count(b"<Part>") == 2
+        assert b"<Size>700</Size>" in body
+        assert b"<Size>300</Size>" in body
+
+        # complete one, abort the other: listings drain
+        st, _, _ = await c.request(
+            "POST", "/mpb/video", query={"uploadId": ids["video"]},
+            payload=(
+                b"<CompleteMultipartUpload>"
+                b"<Part><PartNumber>1</PartNumber></Part>"
+                b"<Part><PartNumber>2</PartNumber></Part>"
+                b"</CompleteMultipartUpload>"
+            ),
+        )
+        assert st == 200
+        st, _, _ = await c.request(
+            "DELETE", "/mpb/backup",
+            query={"uploadId": ids["backup"]},
+        )
+        assert st == 204
+        st, _, body = await c.request(
+            "GET", "/mpb", query={"uploads": ""}
+        )
+        assert body.count(b"<Upload>") == 0
+        # the assembled object reads back whole
+        st, _, body = await c.request("GET", "/mpb/video")
+        assert st == 200 and body == b"A" * 700 + b"B" * 300
+
+        await front.stop()
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
