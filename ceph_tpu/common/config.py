@@ -144,7 +144,37 @@ SCHEMA: dict[str, Option] = {
         _opt("osd_objectstore", TYPE_STR, LEVEL_BASIC, "kstore-file",
              "backing store a daemon-main OSD boots with: kstore-file "
              "(crash-safe WAL FileDB, the default) | memstore "
-             "(reference vstart.sh --memstore analogue for benching)"),
+             "(reference vstart.sh --memstore analogue for benching) | "
+             "blockstore (allocator + block file + at-rest crc32c, "
+             "the BlueStore analogue)"),
+        # blockstore (the bluestore_* option family, options.cc:4252+)
+        _opt("blockstore_min_alloc_size", TYPE_UINT, LEVEL_ADVANCED, 4096,
+             "allocation granularity of the block file; writes below it "
+             "take the deferred (KV WAL) path — bluestore_min_alloc_size",
+             min=512),
+        _opt("blockstore_csum_block_size", TYPE_UINT, LEVEL_ADVANCED,
+             4096,
+             "bytes covered by one stored crc32c "
+             "(bluestore_csum_* block granularity)", min=512),
+        _opt("blockstore_compression_mode", TYPE_STR, LEVEL_ADVANCED,
+             "none",
+             "compression-on-write policy: none | passive | aggressive "
+             "| force (Compressor.h modes)",
+             see_also=("blockstore_compression_algorithm",)),
+        _opt("blockstore_compression_algorithm", TYPE_STR,
+             LEVEL_ADVANCED, "zlib",
+             "codec from the compressor registry used when "
+             "blockstore_compression_mode compresses"),
+        _opt("blockstore_compression_min_blob_size", TYPE_UINT,
+             LEVEL_ADVANCED, 4096,
+             "blobs below this size never attempt compression"),
+        _opt("blockstore_deferred_batch_bytes", TYPE_UINT,
+             LEVEL_ADVANCED, 65536,
+             "deferred-write backlog that triggers a flush to the block "
+             "file (bluestore deferred_batch role)"),
+        _opt("blockstore_block_path", TYPE_STR, LEVEL_ADVANCED, "",
+             "explicit block file path; empty = <kv dir>/block beside a "
+             "FileDB, or an in-memory device over MemDB"),
         _opt("osd_min_pg_log_entries", TYPE_UINT, LEVEL_ADVANCED, 500,
              "log entries retained per PG; peers further behind than "
              "this take a full backfill instead of log recovery"),

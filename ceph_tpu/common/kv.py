@@ -222,6 +222,16 @@ class FileDB(KeyValueDB):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, snap_path)
+        # fsync the parent directory: rename() durability is a property
+        # of the DIRECTORY entry, not the file — without this a power
+        # loss can revert the snapshot to the old (or no) inode even
+        # though the new bytes were fsynced (the classic rename-without-
+        # dirsync hole; process death alone never hits it)
+        dirfd = os.open(self.path, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
         self._wal.close()
         self._wal = open(os.path.join(self.path, self.WAL), "wb")
         self._wal.flush()

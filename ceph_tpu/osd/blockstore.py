@@ -1,0 +1,580 @@
+"""BlockStore: the BlueStore-analogue local object store.
+
+Re-expresses the reference's src/os/bluestore design at our scale: object
+**data** lives as allocator-managed extents in one raw block file; all
+**metadata** — onodes (extent map + per-block checksums), xattrs, omap,
+collections, and the allocator free list — lives in the `KeyValueDB`
+(RocksDB's role). A `Transaction` still commits as exactly one KV batch,
+and the ordering discipline is BlueStore's:
+
+  * **big writes** (>= min_alloc_size) go to freshly-allocated extents —
+    never to space a live onode references — and the device is fsynced
+    *before* the KV batch commits, so a crash at any point leaves the old
+    onode pointing at intact old bytes (copy-on-write, no torn data);
+  * **small writes** (< min_alloc_size) are *deferred*: the payload rides
+    the KV WAL batch itself (the commit point) and `flush_deferred` later
+    moves it onto the device, repointing the onode in a second batch —
+    BlueStore's deferred-write path, crash-safe because the WAL row stays
+    authoritative until that second batch commits;
+  * **frees** are quarantined until the batch that drops them commits —
+    reusing a freed extent earlier could clobber bytes the previous onode
+    still references across a crash.
+
+Every checksum block (bluestore_csum_block_size) of the stored payload is
+crc32c-summed on write and verified on every read; a mismatch raises
+`StoreError("EIO", ...)`, which the OSD's deep scrub surfaces as a
+`read_error` inconsistency and repairs from healthy peers. Optional
+compression-on-write runs the payload through the compressor registry
+(BlueStore's compression_mode/required_ratio policy) with the compressed
+length tracked per blob. `fsck(deep=...)` cross-checks onode extents vs
+the free list (allocated ∪ free must tile the device exactly) and — deep —
+re-reads every blob against its stored checksums.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from ceph_tpu.common.crc import ceph_crc32c
+from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.common.kv import KeyValueDB, KVTransaction
+from ceph_tpu.osd.allocator import ExtentAllocator
+from ceph_tpu.osd.objectstore import (
+    _ATTR,
+    _OMAP,
+    KStore,
+    StoreError,
+    _encode_attrs,
+    _okey,
+    _okey_decode,
+)
+
+_ONODE = b"ond"  # onode rows: size, flags, extent map, csums (O prefix)
+_DEFER = b"dfw"  # deferred sub-min_alloc payloads riding the KV WAL
+_FREE = b"fre"   # allocator free-list rows (FreelistManager's B prefix)
+_BMETA = b"bmt"  # store meta: device size + pinned geometry
+
+_CSUM_SEED = 0xFFFFFFFF
+
+FLAG_INLINE = 1      # payload lives in the _DEFER row, not on the device
+FLAG_COMPRESSED = 2  # stored payload is comp_alg-compressed
+
+
+@dataclass
+class Onode:
+    """Per-object metadata row (bluestore_onode_t + its blob/extent maps,
+    flattened: one blob per object at our scale)."""
+
+    size: int = 0         # logical object size
+    flags: int = 0
+    comp_alg: str = ""    # compressor name when FLAG_COMPRESSED
+    stored_len: int = 0   # physical payload length (== size when raw)
+    csum_block: int = 4096
+    extents: list = field(default_factory=list)  # [(offset, length)]
+    csums: list = field(default_factory=list)    # u32 per csum block
+
+    def encode(self) -> bytes:
+        def body(b):
+            b.u8(self.flags).u64(self.size).string(self.comp_alg)
+            b.u64(self.stored_len).u32(self.csum_block)
+            b.list(self.extents, lambda e, x: e.u64(x[0]).u64(x[1]))
+            b.list(self.csums, lambda e, c: e.u32(c))
+
+        return Encoder().struct(1, 1, body).bytes()
+
+    @staticmethod
+    def decode(raw: bytes) -> "Onode":
+        def body(b, _version):
+            on = Onode(flags=b.u8(), size=b.u64(), comp_alg=b.string())
+            on.stored_len = b.u64()
+            on.csum_block = b.u32()
+            on.extents = b.list(lambda d: (d.u64(), d.u64()))
+            on.csums = b.list(lambda d: d.u32())
+            return on
+
+        on = Decoder(raw).struct(1, body)
+        on.size, on.stored_len = int(on.size), int(on.stored_len)
+        return on
+
+
+# ---------------------------------------------------------------------------
+# Block devices (KernelDevice's role, reduced to pread/pwrite/flush)
+
+
+class MemBlockDevice:
+    """bytearray-backed device — the MemStore-tier BlockStore for tests
+    (and the bit-rot injection surface: flip bytes in `buf`)."""
+
+    path = None
+
+    def __init__(self) -> None:
+        self.buf = bytearray()
+
+    def pwrite(self, off: int, data: bytes) -> None:
+        end = off + len(data)
+        if len(self.buf) < end:
+            self.buf.extend(b"\x00" * (end - len(self.buf)))
+        self.buf[off:end] = data
+
+    def pread(self, off: int, length: int) -> bytes:
+        out = bytes(self.buf[off:off + length])
+        return out + b"\x00" * (length - len(out))  # sparse tail is zeros
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class FileBlockDevice:
+    """One raw block file, grow-on-demand; flush() is a real fsync — the
+    write-before-commit ordering the crash story depends on."""
+
+    def __init__(self, path: str):
+        self.path = path
+        if not os.path.exists(path):
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "wb"):
+                pass
+        self._f = open(path, "r+b")
+
+    def pwrite(self, off: int, data: bytes) -> None:
+        self._f.seek(off)
+        self._f.write(data)
+
+    def pread(self, off: int, length: int) -> bytes:
+        self._f.seek(off)
+        out = self._f.read(length)
+        return out + b"\x00" * (length - len(out))  # sparse tail is zeros
+
+    def flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+# ---------------------------------------------------------------------------
+
+
+class BlockStore(KStore):
+    """ObjectStore with data on a block device; see module docstring.
+
+    Inherits the collection/attr/omap row handling from KStore and
+    overrides only the data-bearing ops — the BlueStore/KStore contract
+    difference is *where bytes live*, not what a Transaction means.
+    """
+
+    def __init__(self, db: KeyValueDB | None = None, config=None,
+                 block_path: str | None = None):
+        super().__init__(db)
+        if config is None:
+            from ceph_tpu.common.config import Config
+
+            config = Config()
+        min_alloc = int(config.get("blockstore_min_alloc_size"))
+        self.csum_block = int(config.get("blockstore_csum_block_size"))
+        # geometry is pinned at mkfs: a later config change must not skew
+        # how an existing store's checksums were laid out
+        geom = self.db.get(_BMETA, b"geometry")
+        if geom is not None:
+            d = Decoder(geom)
+            min_alloc, self.csum_block = int(d.u64()), int(d.u64())
+        self.alloc = ExtentAllocator(min_alloc)
+        self.comp_mode = config.get("blockstore_compression_mode")
+        self.comp_min = int(
+            config.get("blockstore_compression_min_blob_size")
+        )
+        self._compressor = None
+        if self.comp_mode != "none":
+            from ceph_tpu.common.compressor import factory
+
+            self._compressor = factory(
+                config.get("blockstore_compression_algorithm")
+            )
+        self.deferred_batch_bytes = int(
+            config.get("blockstore_deferred_batch_bytes")
+        )
+        if block_path is None:
+            block_path = config.get("blockstore_block_path") or None
+        if block_path is None and isinstance(
+            getattr(self.db, "path", None), str
+        ):
+            block_path = os.path.join(self.db.path, "block")
+        self.device = (
+            FileBlockDevice(block_path) if block_path else MemBlockDevice()
+        )
+        # per-transaction compile state
+        self._staged: dict[bytes, tuple[Onode, bytes]] = {}
+        self._pending_release: list[tuple[int, int]] = []
+        self._batch_allocs: list[tuple[int, int]] = []
+        self._mount(geom is None)
+
+    def _mount(self, mkfs: bool) -> None:
+        raw = self.db.get(_BMETA, b"size")
+        size = Decoder(raw).u64() if raw is not None else 0
+        free = {
+            int.from_bytes(k[1], "big"): Decoder(v).u64()
+            for k, v in self.db.iterate(_FREE)
+        }
+        self.alloc.init(free, size)
+        self._deferred_bytes = sum(
+            len(v) for _k, v in self.db.iterate(_DEFER)
+        )
+        if mkfs:
+            kv = KVTransaction()
+            kv.set(
+                _BMETA, b"geometry",
+                Encoder().u64(self.alloc.min_alloc_size)
+                .u64(self.csum_block).bytes(),
+            )
+            self.db.submit_transaction(kv)
+
+    # -- transaction compilation ----------------------------------------------
+
+    def _begin_batch(self) -> None:
+        self._staged = {}
+        self._pending_release = []
+        self._batch_allocs = []
+
+    def _abort_batch(self) -> None:
+        # compile failed before the commit point: hand batch allocations
+        # back (their device bytes are garbage in free space — harmless)
+        # and re-derive the deferred backlog from committed rows
+        self.alloc.release(self._batch_allocs)
+        self._deferred_bytes = sum(
+            len(v) for _k, v in self.db.iterate(_DEFER)
+        )
+        self._begin_batch()
+
+    def _commit_batch(self, kv: KVTransaction) -> None:
+        # frees quarantined during compile join the allocator only now —
+        # nothing between here and the KV submit allocates, so a freed
+        # extent can never be rewritten before the free itself commits
+        self.alloc.release(self._pending_release)
+        self.alloc.flush(kv, _FREE, _BMETA)
+        self.device.flush()  # data durable BEFORE metadata references it
+        self.db.submit_transaction(kv)
+        self._begin_batch()
+        if self._deferred_bytes > self.deferred_batch_bytes:
+            self.flush_deferred()
+
+    def _compile_op(self, kv: KVTransaction, op: tuple) -> None:
+        kind = op[0]
+        if kind == "touch":
+            _, coll, name = op
+            key = _okey(coll, name)
+            if key not in self._staged and self.db.get(_ONODE, key) is None:
+                on = Onode(csum_block=self.csum_block)
+                kv.set(_ONODE, key, on.encode())
+                self._staged[key] = (on, b"")
+        elif kind == "write":
+            _, coll, name, data, attrs = op
+            key = _okey(coll, name)
+            self._stage_write(kv, key, data)
+            if attrs is not None:
+                kv.set(_ATTR, key, _encode_attrs(attrs))
+        elif kind == "write_at":
+            _, coll, name, off, data = op
+            key = _okey(coll, name)
+            cur = self._compile_read(coll, name, key)
+            if len(cur) < off:
+                cur = cur + b"\x00" * (off - len(cur))
+            self._stage_write(
+                kv, key, cur[:off] + data + cur[off + len(data):]
+            )
+        elif kind == "remove":
+            _, coll, name = op
+            key = _okey(coll, name)
+            self._forget(kv, key)
+            kv.rm(_ONODE, key)
+            kv.rm(_ATTR, key)
+            for k, _v in list(self.db.iterate(_OMAP)):
+                if k[1].startswith(key):
+                    kv.rm(_OMAP, k[1])
+        elif kind == "rmcoll":
+            prefix = Encoder().string(op[1]).bytes()
+            for k, _v in list(self.db.iterate(_ONODE)):
+                if k[1].startswith(prefix):
+                    self._forget(kv, k[1])
+            super()._compile_op(kv, op)  # coll row + rows via _rows_of
+        else:
+            super()._compile_op(kv, op)
+
+    def _forget(self, kv: KVTransaction, key: bytes) -> None:
+        """Release whatever payload the current onode (staged by an
+        earlier op in this batch, else committed) holds for `key`."""
+        staged = self._staged.pop(key, None)
+        if staged is not None:
+            on = staged[0]
+        else:
+            raw = self.db.get(_ONODE, key)
+            if raw is None:
+                return
+            on = Onode.decode(raw)
+        if on.flags & FLAG_INLINE:
+            kv.rm(_DEFER, key)
+            self._deferred_bytes -= on.stored_len
+        else:
+            self._pending_release.extend(on.extents)
+
+    def _stage_write(self, kv: KVTransaction, key: bytes,
+                     data: bytes) -> None:
+        self._forget(kv, key)
+        data = bytes(data)
+        payload, alg = data, ""
+        if self._compressor is not None and len(data) >= self.comp_min:
+            compressed, out = self._compressor.maybe_compress(
+                data, mode=self.comp_mode
+            )
+            if compressed and len(out) < len(data):
+                payload, alg = out, self._compressor.name
+        on = Onode(
+            size=len(data),
+            flags=FLAG_COMPRESSED if alg else 0,
+            comp_alg=alg,
+            stored_len=len(payload),
+            csum_block=self.csum_block,
+        )
+        on.csums = [
+            ceph_crc32c(_CSUM_SEED, payload[i:i + self.csum_block])
+            for i in range(0, len(payload), self.csum_block)
+        ]
+        if payload and len(payload) < self.alloc.min_alloc_size:
+            on.flags |= FLAG_INLINE
+            kv.set(_DEFER, key, payload)
+            self._deferred_bytes += len(payload)
+        elif payload:
+            on.extents = self.alloc.allocate(len(payload))
+            self._batch_allocs.extend(on.extents)
+            self._write_extents(on.extents, payload)
+        kv.set(_ONODE, key, on.encode())
+        self._staged[key] = (on, data)
+
+    def _compile_read(self, coll: str, name: str, key: bytes) -> bytes:
+        """Object content as visible to the op being compiled: what an
+        earlier op in this batch staged, else committed state."""
+        staged = self._staged.get(key)
+        if staged is not None:
+            return staged[1]
+        try:
+            return self.read(coll, name)
+        except StoreError as e:
+            if e.code == "ENOENT":
+                return b""
+            raise
+
+    def _write_extents(self, extents, payload: bytes) -> None:
+        pos = 0
+        for off, ln in extents:
+            chunk = payload[pos:pos + ln]
+            self.device.pwrite(off, chunk)
+            pos += len(chunk)
+
+    # -- deferred writes -------------------------------------------------------
+
+    def flush_deferred(self) -> int:
+        """Move every deferred payload onto the device (BlueStore's
+        deferred_try_submit / _deferred_replay): allocate, write, fsync,
+        then ONE KV batch repoints the onodes and drops the WAL rows.
+        Crash-safe at any point — until that batch commits, the _DEFER
+        rows remain authoritative. Returns the number of payloads moved."""
+        rows = [(k[1], v) for k, v in self.db.iterate(_DEFER)]
+        if not rows:
+            self._deferred_bytes = 0
+            return 0
+        kv = KVTransaction()
+        moved = 0
+        for key, payload in rows:
+            raw = self.db.get(_ONODE, key)
+            on = Onode.decode(raw) if raw is not None else None
+            if on is None or not on.flags & FLAG_INLINE:
+                kv.rm(_DEFER, key)  # orphan WAL row: drop
+                continue
+            on.extents = self.alloc.allocate(len(payload))
+            self._write_extents(on.extents, payload)
+            on.flags &= ~FLAG_INLINE
+            kv.set(_ONODE, key, on.encode())
+            kv.rm(_DEFER, key)
+            moved += 1
+        self.alloc.flush(kv, _FREE, _BMETA)
+        self.device.flush()
+        self.db.submit_transaction(kv)
+        self._deferred_bytes = 0
+        return moved
+
+    def compact(self) -> None:
+        """Flush the deferred backlog, then fold the KV WAL."""
+        self.flush_deferred()
+        if hasattr(self.db, "compact"):
+            self.db.compact()
+
+    def umount(self) -> None:
+        """Clean shutdown: drain deferred writes, close device + DB."""
+        self.flush_deferred()
+        self.device.close()
+        if hasattr(self.db, "close"):
+            self.db.close()
+
+    def close(self) -> None:
+        """Read-only close (fsck/tool path): no deferred flush, so an
+        inspection never mutates the store under examination."""
+        self.device.close()
+        if hasattr(self.db, "close"):
+            self.db.close()
+
+    # -- reads ----------------------------------------------------------------
+
+    def exists(self, coll: str, name: str) -> bool:
+        return self.db.get(_ONODE, _okey(coll, name)) is not None
+
+    def read(self, coll: str, name: str) -> bytes:
+        key = _okey(coll, name)
+        raw = self.db.get(_ONODE, key)
+        if raw is None:
+            raise StoreError("ENOENT", f"{coll}/{name} does not exist")
+        on = Onode.decode(raw)
+        payload = self._read_payload(key, on, f"{coll}/{name}")
+        if on.flags & FLAG_COMPRESSED:
+            from ceph_tpu.common.compressor import factory
+
+            try:
+                data = factory(on.comp_alg).decompress(payload)
+            except Exception as e:  # noqa: BLE001 - surfaced as EIO
+                raise StoreError(
+                    "EIO", f"{coll}/{name}: decompression failed: {e}"
+                ) from e
+            if len(data) != on.size:
+                raise StoreError(
+                    "EIO",
+                    f"{coll}/{name}: decompressed to {len(data)} bytes, "
+                    f"onode says {on.size}",
+                )
+            return data
+        return payload
+
+    def _read_payload(self, key: bytes, on: Onode, label: str) -> bytes:
+        if on.flags & FLAG_INLINE:
+            payload = self.db.get(_DEFER, key)
+            if payload is None:
+                raise StoreError(
+                    "EIO", f"{label}: deferred payload row missing"
+                )
+        else:
+            parts = []
+            remaining = on.stored_len
+            for off, ln in on.extents:
+                take = min(ln, remaining)
+                parts.append(self.device.pread(off, take))
+                remaining -= take
+            payload = b"".join(parts)
+            if len(payload) != on.stored_len:
+                raise StoreError(
+                    "EIO",
+                    f"{label}: extent map covers {len(payload)} of "
+                    f"{on.stored_len} stored bytes",
+                )
+        bs = on.csum_block or self.csum_block
+        want = (len(payload) + bs - 1) // bs
+        if len(on.csums) != want:
+            raise StoreError(
+                "EIO",
+                f"{label}: {len(on.csums)} checksums for {want} blocks",
+            )
+        for i, c in enumerate(on.csums):
+            if ceph_crc32c(_CSUM_SEED, payload[i * bs:(i + 1) * bs]) != c:
+                raise StoreError(
+                    "EIO",
+                    f"{label}: checksum mismatch in block {i} "
+                    f"(at-rest corruption)",
+                )
+        return payload
+
+    def list_objects(self, coll: str) -> list[str]:
+        prefix = Encoder().string(coll).bytes()
+        return [
+            _okey_decode(k[1])[1]
+            for k, _v in self.db.iterate(_ONODE)
+            if k[1].startswith(prefix)
+        ]
+
+    def _rows_of(self, coll: str):
+        prefix = Encoder().string(coll).bytes()
+        for table in (_ONODE, _DEFER, _ATTR, _OMAP):
+            for k, _v in list(self.db.iterate(table)):
+                if k[1].startswith(prefix):
+                    yield table, k[1]
+
+    def used_bytes(self) -> int:
+        """KV footprint (metadata + deferred WAL rows) plus the bytes the
+        allocator has handed to live blobs."""
+        return super().used_bytes() + self.alloc.allocated_bytes()
+
+    # -- fsck -----------------------------------------------------------------
+
+    def fsck(self, deep: bool = False) -> list[dict]:
+        """Cross-check the whole store; returns one dict per error.
+
+        Shallow: every onode decodes; inline onodes have their WAL row and
+        no extents; no orphan WAL rows; onode extents vs the free list
+        tile [0, device size) exactly (no overlap, no leak). Deep: also
+        re-read every blob and verify its stored checksums (and that
+        compressed blobs still decompress to the logical size)."""
+        errors: list[dict] = []
+        onodes: list[tuple[str, str, bytes, Onode]] = []
+        allocated: list[tuple[int, int]] = []
+        for k, raw in list(self.db.iterate(_ONODE)):
+            key = k[1]
+            try:
+                coll, name = _okey_decode(key)
+                on = Onode.decode(raw)
+            except Exception as e:  # noqa: BLE001 - each row reported
+                errors.append(
+                    {"key": key.hex(), "error": f"undecodable onode: {e}"}
+                )
+                continue
+            onodes.append((coll, name, key, on))
+            allocated.extend(on.extents)
+            if on.flags & FLAG_INLINE:
+                if on.extents:
+                    errors.append({
+                        "object": f"{coll}/{name}",
+                        "error": "inline onode with extents",
+                    })
+                if self.db.get(_DEFER, key) is None:
+                    errors.append({
+                        "object": f"{coll}/{name}",
+                        "error": "deferred payload row missing",
+                    })
+        inline_keys = {
+            key for _c, _n, key, on in onodes if on.flags & FLAG_INLINE
+        }
+        for k, _v in list(self.db.iterate(_DEFER)):
+            if k[1] not in inline_keys:
+                errors.append({
+                    "key": k[1].hex(),
+                    "error": "orphan deferred row (no inline onode)",
+                })
+        for msg in self.alloc.check(allocated):
+            errors.append({"error": msg})
+        if deep:
+            for coll, name, key, on in onodes:
+                try:
+                    payload = self._read_payload(key, on, f"{coll}/{name}")
+                    if on.flags & FLAG_COMPRESSED:
+                        from ceph_tpu.common.compressor import factory
+
+                        out = factory(on.comp_alg).decompress(payload)
+                        if len(out) != on.size:
+                            raise StoreError(
+                                "EIO", "decompressed size mismatch"
+                            )
+                except Exception as e:  # noqa: BLE001
+                    errors.append(
+                        {"object": f"{coll}/{name}", "error": str(e)}
+                    )
+        return errors

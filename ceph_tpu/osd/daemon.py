@@ -60,7 +60,7 @@ from ceph_tpu.osd.extent_cache import (
     patch_window,
     write_column_intervals,
 )
-from ceph_tpu.osd.objectstore import KStore, StoreError, Transaction
+from ceph_tpu.osd.objectstore import StoreError, Transaction, create_store
 from ceph_tpu.osd.ops import (
     ObjectState,
     OpError,
@@ -378,7 +378,10 @@ class OSDService(Dispatcher):
         self.crush_location = crush_location
         self.name = f"osd.{osd_id}"
         self.config = config if config is not None else Config()
-        self.store = KStore(db)
+        # kstore over the given KV db by default; `osd_objectstore =
+        # blockstore` opts into the allocator/at-rest-checksum store
+        # (its block file lands beside a FileDB's WAL)
+        self.store = create_store(db, self.config)
         self.messenger = Messenger(
             self.name, config=self.config, keyring=keyring
         )
@@ -2008,8 +2011,12 @@ class OSDService(Dispatcher):
         try:
             data = self.store.read(p["coll"], p["name"])
             attrs = self.store.getattrs(p["coll"], p["name"])
-        except StoreError:
-            self._reply_peer(conn, p["tid"], {"ok": False})
+        except StoreError as e:
+            # carry the errno so the scrubbing primary can tell at-rest
+            # corruption (EIO -> read_error) from an absent copy
+            self._reply_peer(
+                conn, p["tid"], {"ok": False, "error": e.code}
+            )
             return
         if p.get("ver") is not None and attrs.get("ver") != p["ver"]:
             self._reply_peer(conn, p["tid"], {"ok": False, "stale": True})
@@ -4121,8 +4128,10 @@ class OSDService(Dispatcher):
                     self.store.read(pg.coll, sname),
                     self.store.getattrs(pg.coll, sname),
                 )
-            except StoreError:
-                return "missing"
+            except StoreError as e:
+                # EIO = at-rest corruption a checksumming store caught
+                # (BlockStore); distinct from a copy that is simply gone
+                return "read_error" if e.code == "EIO" else "missing"
         try:
             rep = await self._peer_call(
                 osd, "obj_read", {"coll": pg.coll, "name": sname},
@@ -4131,7 +4140,7 @@ class OSDService(Dispatcher):
         except (asyncio.TimeoutError, RuntimeError):
             return "unreachable"
         if not rep.get("ok"):
-            return "missing"
+            return "read_error" if rep.get("error") == "EIO" else "missing"
         return rep["_raw"], _attrs_from(rep)
 
     async def _scrub(self, pool_id: int, deep: bool) -> dict:

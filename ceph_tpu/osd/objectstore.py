@@ -1,4 +1,4 @@
-"""ObjectStore: transactional object storage over KeyValueDB (KStore-style).
+"""ObjectStore: the OSD's transactional persistence contract, two backends.
 
 The reference's `ObjectStore` interface (src/os/ObjectStore.h +
 Transaction.h) is the OSD's only persistence contract: every mutation —
@@ -8,13 +8,27 @@ crash-consistent (SURVEY §5 checkpoint/resume: durability *is* the
 transaction log). Implementations differ in media: BlueStore (raw block),
 FileStore, MemStore, and KStore, which stores everything in the KV layer.
 
-`KStore` here follows that last design (src/os/kstore): objects, attrs, and
-omap are rows in a `KeyValueDB`, a Transaction compiles to one KV batch, and
-the KV WAL (ceph_tpu.common.kv.FileDB) provides atomicity + crash recovery.
-Backed by `MemDB` it is the MemStore equivalent; backed by `FileDB` it
-survives process death — an OSD daemon reopening its store resumes from the
-last committed transaction exactly like an OSD restart replaying its
-journal.
+Two backends implement the contract here, selected by the
+`osd_objectstore` config option (`create_store`):
+
+  * `KStore` (this module; src/os/kstore design): objects, attrs, and omap
+    are rows in a `KeyValueDB`, a Transaction compiles to one KV batch, and
+    the KV WAL (ceph_tpu.common.kv.FileDB) provides atomicity + crash
+    recovery. Backed by `MemDB` it is the MemStore equivalent; backed by
+    `FileDB` it survives process death — an OSD daemon reopening its store
+    resumes from the last committed transaction exactly like an OSD restart
+    replaying its journal.
+  * `BlockStore` (ceph_tpu.osd.blockstore; src/os/bluestore design): object
+    *data* lives as allocator-managed extents in a raw block file with a
+    crc32c per checksum block, verified on every read; *metadata* (onode
+    extent maps, attrs, omap, the free list) stays in the KV layer —
+    BlueStore's data/RocksDB split. Sub-min_alloc writes ride the KV WAL
+    batch (deferred writes) and `fsck(deep=True)` re-reads every blob
+    against its stored checksum.
+
+The per-op compilation is factored through `_compile_op`/`_begin_batch`/
+`_commit_batch` so BlockStore overrides only the data-bearing ops and
+inherits collection/attr/omap handling unchanged.
 
 Object identity is (collection, name) where a collection is a PG
 (coll_t, src/osd/osd_types.h); keys are denc-encoded so ordered KV
@@ -174,50 +188,69 @@ class KStore:
     def queue_transaction(self, txn: Transaction) -> None:
         """Compile to one KV batch and commit atomically."""
         kv = KVTransaction()
-        for op in txn.ops:
-            kind = op[0]
-            if kind == "mkcoll":
-                kv.set(_COLL, op[1].encode(), b"")
-            elif kind == "rmcoll":
-                coll = op[1]
-                kv.rm(_COLL, coll.encode())
-                for table, row_key in self._rows_of(coll):
-                    kv.rm(table, row_key)
-            elif kind == "touch":
-                _, coll, name = op
-                if self.db.get(_DATA, _okey(coll, name)) is None:
-                    kv.set(_DATA, _okey(coll, name), b"")
-            elif kind == "write":
-                _, coll, name, data, attrs = op
-                kv.set(_DATA, _okey(coll, name), data)
-                if attrs is not None:
-                    kv.set(_ATTR, _okey(coll, name), _encode_attrs(attrs))
-            elif kind == "write_at":
-                _, coll, name, off, data = op
-                kv.set_range(_DATA, _okey(coll, name), off, data)
-            elif kind == "remove":
-                _, coll, name = op
-                kv.rm(_DATA, _okey(coll, name))
-                kv.rm(_ATTR, _okey(coll, name))
-                for k, _v in list(self.db.iterate(_OMAP)):
-                    if k[1].startswith(_okey(coll, name)):
-                        kv.rm(_OMAP, k[1])
-            elif kind == "setattrs":
-                _, coll, name, attrs = op
-                merged = dict(self.getattrs(coll, name))
-                merged.update(attrs)
-                kv.set(_ATTR, _okey(coll, name), _encode_attrs(merged))
-            elif kind == "omap_set":
-                _, coll, name, pairs = op
-                for k, v in pairs.items():
-                    kv.set(_OMAP, _okey(coll, name, k), v)
-            elif kind == "omap_rm":
-                _, coll, name, keys = op
-                for k in keys:
-                    kv.rm(_OMAP, _okey(coll, name, k))
-            else:
-                raise ValueError(f"unknown transaction op {kind!r}")
+        self._begin_batch()
+        try:
+            for op in txn.ops:
+                self._compile_op(kv, op)
+        except BaseException:
+            self._abort_batch()
+            raise
+        self._commit_batch(kv)
+
+    def _begin_batch(self) -> None:
+        """Per-transaction compile state reset (backend hook)."""
+
+    def _abort_batch(self) -> None:
+        """Undo compile-time side effects after a failed compile (backend
+        hook; the KV batch itself was never submitted)."""
+
+    def _commit_batch(self, kv: KVTransaction) -> None:
+        """Make the compiled batch durable — THE commit point."""
         self.db.submit_transaction(kv)
+
+    def _compile_op(self, kv: KVTransaction, op: tuple) -> None:
+        kind = op[0]
+        if kind == "mkcoll":
+            kv.set(_COLL, op[1].encode(), b"")
+        elif kind == "rmcoll":
+            coll = op[1]
+            kv.rm(_COLL, coll.encode())
+            for table, row_key in self._rows_of(coll):
+                kv.rm(table, row_key)
+        elif kind == "touch":
+            _, coll, name = op
+            if self.db.get(_DATA, _okey(coll, name)) is None:
+                kv.set(_DATA, _okey(coll, name), b"")
+        elif kind == "write":
+            _, coll, name, data, attrs = op
+            kv.set(_DATA, _okey(coll, name), data)
+            if attrs is not None:
+                kv.set(_ATTR, _okey(coll, name), _encode_attrs(attrs))
+        elif kind == "write_at":
+            _, coll, name, off, data = op
+            kv.set_range(_DATA, _okey(coll, name), off, data)
+        elif kind == "remove":
+            _, coll, name = op
+            kv.rm(_DATA, _okey(coll, name))
+            kv.rm(_ATTR, _okey(coll, name))
+            for k, _v in list(self.db.iterate(_OMAP)):
+                if k[1].startswith(_okey(coll, name)):
+                    kv.rm(_OMAP, k[1])
+        elif kind == "setattrs":
+            _, coll, name, attrs = op
+            merged = dict(self.getattrs(coll, name))
+            merged.update(attrs)
+            kv.set(_ATTR, _okey(coll, name), _encode_attrs(merged))
+        elif kind == "omap_set":
+            _, coll, name, pairs = op
+            for k, v in pairs.items():
+                kv.set(_OMAP, _okey(coll, name, k), v)
+        elif kind == "omap_rm":
+            _, coll, name, keys = op
+            for k in keys:
+                kv.rm(_OMAP, _okey(coll, name, k))
+        else:
+            raise ValueError(f"unknown transaction op {kind!r}")
 
     def _rows_of(self, coll: str):
         prefix = Encoder().string(coll).bytes()
@@ -262,3 +295,56 @@ class KStore:
             if k[1].startswith(prefix):
                 out.append(_okey_decode(k[1])[1])
         return out
+
+    # -- fsck -----------------------------------------------------------------
+
+    def fsck(self, deep: bool = False) -> list[dict]:
+        """Consistency check (ceph-objectstore-tool --op fsck surface).
+
+        KStore keeps everything in KV rows the WAL already crc-frames, so
+        there is no allocator or at-rest checksum to cross-check — fsck
+        verifies the rows themselves decode: object keys, attr blobs, and
+        (deep) that every data row is readable. BlockStore overrides this
+        with the real extent/free-list/checksum cross-checks."""
+        errors: list[dict] = []
+        for k, _v in list(self.db.iterate(_DATA)):
+            try:
+                _okey_decode(k[1])
+            except Exception as e:  # noqa: BLE001 - each row reported
+                errors.append(
+                    {"key": k[1].hex(), "error": f"undecodable key: {e}"}
+                )
+        for k, v in list(self.db.iterate(_ATTR)):
+            try:
+                coll, name = _okey_decode(k[1])
+                _decode_attrs(v)
+            except Exception as e:  # noqa: BLE001
+                errors.append(
+                    {"key": k[1].hex(), "error": f"undecodable attrs: {e}"}
+                )
+        if deep:
+            for k, _v in list(self.db.iterate(_DATA)):
+                try:
+                    coll, name = _okey_decode(k[1])
+                    self.read(coll, name)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(
+                        {"key": k[1].hex(), "error": f"unreadable: {e}"}
+                    )
+        return errors
+
+
+def create_store(db: KeyValueDB | None = None, config=None):
+    """Build the ObjectStore the `osd_objectstore` option names.
+
+    `kstore-file`/`memstore` differ only in the KeyValueDB the caller
+    passes (FileDB vs MemDB) — both get a KStore. `blockstore` gets the
+    allocator/at-rest-checksum store; its block file defaults to
+    `<db.path>/block` beside a FileDB's WAL, or an in-memory device over
+    MemDB (the MemStore-tier equivalent for tests)."""
+    kind = config.get("osd_objectstore") if config is not None else None
+    if kind == "blockstore":
+        from ceph_tpu.osd.blockstore import BlockStore
+
+        return BlockStore(db, config=config)
+    return KStore(db)

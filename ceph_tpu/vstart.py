@@ -13,6 +13,7 @@ Layout of a run directory (``--run-dir``):
 
     cluster_spec.json      monmap + n_osds + config overrides
     mon.0.kv / osd.3.kv    per-daemon FileDB stores (WAL, crash-safe)
+    osd.3.kv/block         raw block file when osd_objectstore=blockstore
     mon.0.log / osd.3.log  daemon stdout+stderr
 
 The spec is deterministic: every mon builds the identical initial OSDMap
@@ -206,6 +207,10 @@ def daemon_main(kind: str, ident: int, spec_path: str) -> None:
 
                 db = MemDB()
             else:
+                # kstore-file AND blockstore both persist through this
+                # FileDB; a blockstore OSD adds its block file inside
+                # the same per-daemon dir (OSDService builds the store
+                # from osd_objectstore)
                 db = FileDB(
                     os.path.join(spec.run_dir, f"{kind}.{ident}.kv")
                 )

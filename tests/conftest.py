@@ -11,10 +11,33 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# 8 virtual CPU devices. Newer jax exposes jax_num_cpu_devices; older
+# releases only honor the XLA flag, which must be in the environment
+# before the backend initializes — set it unconditionally so either
+# path yields the same mesh.
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # pre-0.4.34 jax: XLA_FLAGS above already did it
+    pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running live tests excluded from the tier-1 "
+        "budgeted run (-m 'not slow')",
+    )
 
 
 def make_mini_cluster(

@@ -1,5 +1,7 @@
 """KV layer + transactional object store: atomicity, crash recovery from a
-torn WAL tail, and the KStore surface (collections, attrs, omap)."""
+torn WAL tail, and the ObjectStore surface (collections, attrs, omap) —
+every store test runs against BOTH backends (KStore and the BlueStore-
+analogue BlockStore), since they implement one Transaction contract."""
 
 import os
 
@@ -8,6 +10,8 @@ import pytest
 from ceph_tpu.common.kv import FileDB, KVTransaction, MemDB
 from ceph_tpu.osd.ecutil import HashInfo
 from ceph_tpu.osd.objectstore import KStore, StoreError, Transaction
+
+BACKENDS = ["kstore", "blockstore"]
 
 
 # -- kv -----------------------------------------------------------------------
@@ -74,14 +78,27 @@ def test_filedb_discards_torn_wal_tail(tmp_path):
 
 # -- object store -------------------------------------------------------------
 
-def make_store(tmp_path=None):
-    if tmp_path is None:
-        return KStore()
-    return KStore(FileDB(str(tmp_path / "store")))
+def make_store(backend="kstore", tmp_path=None):
+    """MemDB-backed when tmp_path is None (MemStore tier), else durable
+    FileDB-backed (BlockStore adds its block file beside the WAL)."""
+    db = None if tmp_path is None else FileDB(str(tmp_path / "store"))
+    if backend == "kstore":
+        return KStore(db)
+    from ceph_tpu.osd.blockstore import BlockStore
+
+    return BlockStore(db)
 
 
-def test_kstore_transaction_surface():
-    st = make_store()
+def close_store(st) -> None:
+    if hasattr(st, "umount"):
+        st.umount()
+    else:
+        st.db.close()
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_store_transaction_surface(backend):
+    st = make_store(backend)
     hi = HashInfo(4096, [1, 2, 3])
     st.queue_transaction(
         Transaction()
@@ -109,8 +126,9 @@ def test_kstore_transaction_surface():
         st.read("pg_1_0", "obj-b")
 
 
-def test_kstore_remove_collection_drops_rows():
-    st = make_store()
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_store_remove_collection_drops_rows(backend):
+    st = make_store(backend)
     st.queue_transaction(
         Transaction()
         .create_collection("pg_1_0")
@@ -126,30 +144,52 @@ def test_kstore_remove_collection_drops_rows():
     assert st.read("pg_1_1", "keep") == b"y"
 
 
-def test_kstore_restart_resumes_exactly(tmp_path):
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_store_restart_resumes_exactly(backend, tmp_path):
     """The OSD-restart story: reopen the store and find the last committed
     transaction, attrs and omap intact."""
-    st = make_store(tmp_path)
+    st = make_store(backend, tmp_path)
     st.queue_transaction(
         Transaction()
         .create_collection("pg_2_3")
         .write("pg_2_3", "shard", b"\x01" * 512,
                attrs={"ver": 7, "hinfo": HashInfo(512, [9, 9])})
+        .write("pg_2_3", "bigshard", b"\x02" * 9000, attrs={"ver": 8})
         .omap_setkeys("pg_2_3", "pglog", {b"0000007": b"entry"})
     )
+    # NO clean shutdown for the data rows: close only the KV handle, the
+    # way a killed OSD leaves its store (deferred rows must replay)
     st.db.close()
 
-    st2 = KStore(FileDB(str(tmp_path / "store")))
+    st2 = make_store(backend, tmp_path)
     assert st2.read("pg_2_3", "shard") == b"\x01" * 512
+    assert st2.read("pg_2_3", "bigshard") == b"\x02" * 9000
     assert st2.getattrs("pg_2_3", "shard")["ver"] == 7
     assert st2.omap_get("pg_2_3", "pglog") == {b"0000007": b"entry"}
-    st2.db.close()
+    if hasattr(st2, "fsck"):
+        assert st2.fsck(deep=True) == []
+    close_store(st2)
 
 
-def test_touch_does_not_clobber():
-    st = make_store()
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_touch_does_not_clobber(backend):
+    st = make_store(backend)
     st.queue_transaction(
         Transaction().create_collection("c").write("c", "o", b"data")
     )
     st.queue_transaction(Transaction().touch("c", "o"))
     assert st.read("c", "o") == b"data"
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_write_at_patches_and_extends(backend):
+    """Sub-extent overwrite semantics shared by both backends: patch in
+    place, zero-fill any gap when writing past the end."""
+    st = make_store(backend)
+    st.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", b"abcdef")
+    )
+    st.queue_transaction(Transaction().write_at("c", "o", 2, b"XY"))
+    assert st.read("c", "o") == b"abXYef"
+    st.queue_transaction(Transaction().write_at("c", "o", 8, b"ZZ"))
+    assert st.read("c", "o") == b"abXYef\x00\x00ZZ"

@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """ceph-objectstore-tool analogue: OFFLINE surgery on an OSD's store.
 
-Operates directly on a stopped OSD's durable KStore (the FileDB
-directory), the way the reference tool opens a stopped OSD's
-BlueStore/FileStore (src/tools/ceph_objectstore_tool.cc):
+Operates directly on a stopped OSD's durable store (the FileDB
+directory; KStore or BlockStore, autodetected like the reference probes
+the store type from the data dir), the way the reference tool opens a
+stopped OSD's BlueStore/FileStore (src/tools/ceph_objectstore_tool.cc):
 
     python tools/objectstore_tool.py --data-path <dir> --op list
     python tools/objectstore_tool.py --data-path <dir> --op list --pgid 2.3
@@ -16,11 +17,16 @@ BlueStore/FileStore (src/tools/ceph_objectstore_tool.cc):
     python tools/objectstore_tool.py --data-path <dir> --op import \
         --file <file>
     python tools/objectstore_tool.py --data-path <dir> --op log --pgid 2.3
+    python tools/objectstore_tool.py --data-path <dir> --op fsck [--deep]
 
 export/import move one PG's complete contents (objects + attrs + omap +
 the pg-meta log) between stores as a JSON bundle — the disaster-recovery
 flow the reference tool exists for (yank a PG off a dead OSD's disk,
-inject it into a fresh one).
+inject it into a fresh one); the bundle is store-agnostic, so a PG
+exported from a KStore OSD imports into a BlockStore OSD and vice versa.
+`--op fsck` runs the store's own consistency check (`--deep` re-reads
+every blob against its at-rest checksums on BlockStore) and exits
+nonzero when errors are found, like `ceph-objectstore-tool --op fsck`.
 """
 
 from __future__ import annotations
@@ -87,21 +93,55 @@ def _coll_of(pgid: str) -> str:
     return f"pg_{int(pool)}_{int(ps)}"
 
 
+def open_store(data_path: str, type_: str = "auto"):
+    """(store, backend-name) over a stopped OSD's FileDB dir. `auto`
+    probes for BlockStore's pinned-geometry row / block file, the way
+    the reference sniffs the store type from the data dir."""
+    db = FileDB(data_path)
+    if type_ == "auto":
+        type_ = (
+            "blockstore"
+            if db.get(b"bmt", b"geometry") is not None
+            or os.path.exists(os.path.join(data_path, "block"))
+            else "kstore"
+        )
+    if type_ == "blockstore":
+        from ceph_tpu.osd.blockstore import BlockStore
+
+        return BlockStore(db), "blockstore"
+    return KStore(db), "kstore"
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="objectstore_tool")
     ap.add_argument("--data-path", required=True)
     ap.add_argument("--op", required=True,
                     choices=["list", "info", "get", "log", "export",
-                             "import"])
+                             "import", "fsck"])
+    ap.add_argument("--type", default="auto",
+                    choices=["auto", "kstore", "blockstore"],
+                    help="store backend (auto probes the data dir)")
+    ap.add_argument("--deep", action="store_true",
+                    help="fsck: re-read every blob against its stored "
+                         "checksums")
     ap.add_argument("--pgid")
     ap.add_argument("--obj")
     ap.add_argument("--out")
     ap.add_argument("--file")
     args = ap.parse_args(argv)
 
-    db = FileDB(args.data_path)
-    store = KStore(db)
+    store, backend = open_store(args.data_path, args.type)
+    db = store.db
     try:
+        if args.op == "fsck":
+            errors = store.fsck(deep=args.deep)
+            print(json.dumps({
+                "backend": backend,
+                "deep": args.deep,
+                "error_count": len(errors),
+                "errors": errors,
+            }, indent=2))
+            return 1 if errors else 0
         if args.op == "list":
             colls = (
                 [_coll_of(args.pgid)] if args.pgid
@@ -189,7 +229,12 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
     finally:
-        db.close()
+        # offline tooling must never mutate the store on the way out:
+        # BlockStore.close() skips the deferred flush umount() would do
+        if hasattr(store, "close"):
+            store.close()
+        else:
+            db.close()
 
 
 if __name__ == "__main__":

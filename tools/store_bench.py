@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Local object-store microbenchmark: KStore vs BlockStore.
+
+The `ceph daemon osd.N bench` / objectstore fio-plugin role
+(src/test/objectstore/store_test.cc perf tier): hammer each ObjectStore
+backend directly — no messenger, no PG layer — so the store's own write
+and read paths are the only thing on the clock. Reports MB/s per
+(backend, object size) over durable FileDB-backed stores, JSON to stdout
+(bench.py convention) so CI can diff runs:
+
+    python tools/store_bench.py
+    python tools/store_bench.py --sizes 4096,65536 --bytes-per-case 8388608
+    python tools/store_bench.py --backends blockstore --out bench.json
+
+Each case writes enough objects of the given size to move
+--bytes-per-case, fsync-per-transaction (the store's real durability
+cost), then reads them all back (BlockStore verifying every stored
+checksum — the at-rest integrity tax is part of the number, as it is in
+production). BlockStore cases end with a shallow fsck so a benchmark can
+never "win" by corrupting itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from ceph_tpu.common.kv import FileDB  # noqa: E402
+from ceph_tpu.osd.objectstore import KStore, Transaction  # noqa: E402
+
+COLL = "pg_bench_0"
+
+
+def _make_store(backend: str, path: str):
+    db = FileDB(path)
+    if backend == "blockstore":
+        from ceph_tpu.osd.blockstore import BlockStore
+
+        return BlockStore(db)
+    return KStore(db)
+
+
+def _close(store) -> None:
+    if hasattr(store, "umount"):
+        store.umount()
+    else:
+        store.db.close()
+
+
+def bench_case(backend: str, size: int, bytes_per_case: int,
+               base_dir: str) -> dict:
+    count = max(4, bytes_per_case // size)
+    payloads = [
+        (f"obj-{i:06d}", (i % 251).to_bytes(1, "little") * size)
+        for i in range(count)
+    ]
+    path = os.path.join(base_dir, f"{backend}-{size}")
+    store = _make_store(backend, path)
+    store.queue_transaction(Transaction().create_collection(COLL))
+
+    t0 = time.perf_counter()
+    for name, data in payloads:
+        store.queue_transaction(
+            Transaction().write(COLL, name, data, attrs={"ver": 1})
+        )
+    write_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    read_bytes = 0
+    for name, data in payloads:
+        got = store.read(COLL, name)
+        read_bytes += len(got)
+        assert got == data, f"readback mismatch on {name}"
+    read_s = time.perf_counter() - t0
+
+    fsck_errors = None
+    if hasattr(store, "fsck"):
+        fsck_errors = len(store.fsck())
+    _close(store)
+    total = size * count
+    return {
+        "backend": backend,
+        "object_size": size,
+        "objects": count,
+        "bytes": total,
+        "write_mbps": total / write_s / 1e6,
+        "read_mbps": read_bytes / read_s / 1e6,
+        "write_iops": count / write_s,
+        "fsck_errors": fsck_errors,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="store_bench")
+    ap.add_argument("--backends", default="kstore,blockstore")
+    ap.add_argument("--sizes", default="4096,65536,4194304",
+                    help="comma-separated object sizes (bytes)")
+    ap.add_argument("--bytes-per-case", type=int, default=16 << 20,
+                    help="approximate bytes written per (backend, size)")
+    ap.add_argument("--dir", default=None,
+                    help="work dir (default: a fresh temp dir, removed)")
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args(argv)
+
+    base = args.dir or tempfile.mkdtemp(prefix="store_bench_")
+    own_dir = args.dir is None
+    results = []
+    try:
+        for backend in args.backends.split(","):
+            for size in (int(s) for s in args.sizes.split(",")):
+                r = bench_case(
+                    backend.strip(), size, args.bytes_per_case, base
+                )
+                results.append(r)
+                print(
+                    f"# {r['backend']:>10} {r['object_size']:>8}B: "
+                    f"write {r['write_mbps']:8.1f} MB/s  "
+                    f"read {r['read_mbps']:8.1f} MB/s",
+                    file=sys.stderr,
+                )
+    finally:
+        if own_dir:
+            shutil.rmtree(base, ignore_errors=True)
+    doc = {"bench": "store_bench", "results": results}
+    print(json.dumps(doc, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
