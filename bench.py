@@ -30,6 +30,8 @@ see BASELINE.md for the measurement and for the ISA-L AVX512 context.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
@@ -79,6 +81,52 @@ def measure_seconds(fn, words, n_lo: int = 10, n_hi: int = 110) -> float:
     return max(1e-9, (run(hi) - run(lo)) / (n_hi - n_lo))
 
 
+def _store_bench_line() -> None:
+    """Optional second JSON line: a quick BlockStore store-bench so the
+    BENCH trajectory tracks store MB/s alongside EC GB/s. Guarded (off
+    unless --store-bench / CEPH_TPU_BENCH_STORE=1) and non-fatal — the
+    driver's one-line contract for the EC metric is never at risk."""
+    try:
+        import io
+        import tempfile
+        from contextlib import redirect_stderr, redirect_stdout
+
+        from tools import store_bench
+
+        with tempfile.TemporaryDirectory(prefix="bench_store_") as d:
+            out = os.path.join(d, "store.json")
+            with redirect_stdout(io.StringIO()), \
+                    redirect_stderr(io.StringIO()):
+                store_bench.main([
+                    "--backend", "blockstore",
+                    "--sizes", "65536",
+                    "--small-sizes", "1024",
+                    "--bytes-per-case", str(4 << 20),
+                    "--dir", d,
+                    "--out", out,
+                ])
+            with open(out) as f:
+                results = json.load(f)["results"]
+        rw = next(r for r in results if r["workload"] == "rw")
+        small = next(r for r in results if r["workload"] == "small-write")
+        print(
+            json.dumps({
+                "metric": "blockstore_reread_throughput",
+                "value": round(rw["reread_mbps"], 1),
+                "unit": "MB/s",
+                "write_mbps": round(rw["write_mbps"], 1),
+                "read_mbps": round(rw["read_mbps"], 1),
+                "small_write_iops": round(small["write_iops"], 1),
+                "deferred_flushes": small["perf"]["deferred_flushes"],
+                "buffer_hit_rate": round(
+                    rw["perf"]["buffer_hit_rate"], 3
+                ),
+            })
+        )
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def main() -> None:
     import jax
 
@@ -115,6 +163,10 @@ def main() -> None:
             }
         )
     )
+    if "--store-bench" in sys.argv[1:] or os.environ.get(
+        "CEPH_TPU_BENCH_STORE"
+    ):
+        _store_bench_line()
 
 
 if __name__ == "__main__":

@@ -172,6 +172,25 @@ SCHEMA: dict[str, Option] = {
              LEVEL_ADVANCED, 65536,
              "deferred-write backlog that triggers a flush to the block "
              "file (bluestore deferred_batch role)"),
+        _opt("blockstore_deferred_max_age_ms", TYPE_UINT,
+             LEVEL_ADVANCED, 500,
+             "oldest deferred write may sit in the KV WAL this long "
+             "before the background flusher drains the backlog to the "
+             "device, independent of byte pressure; 0 disables the "
+             "flusher (byte-threshold-only, the PR-1 behavior)",
+             see_also=("blockstore_deferred_batch_bytes",)),
+        _opt("blockstore_onode_cache_size", TYPE_UINT, LEVEL_ADVANCED,
+             1024,
+             "decoded onodes (extent map + csums) kept in an LRU so hot "
+             "objects skip the KV fetch + decode "
+             "(bluestore_onode_cache_size role); 0 disables"),
+        _opt("blockstore_buffer_cache_bytes", TYPE_UINT, LEVEL_ADVANCED,
+             32 << 20,
+             "bytes of recently read/written object data kept in a "
+             "write-through LRU so re-reads skip the device and the "
+             "checksum re-verify (bluestore buffer cache role); 0 "
+             "disables — fsck, deep scrub, and read_verify always read "
+             "device truth regardless"),
         _opt("blockstore_block_path", TYPE_STR, LEVEL_ADVANCED, "",
              "explicit block file path; empty = <kv dir>/block beside a "
              "FileDB, or an in-memory device over MemDB"),

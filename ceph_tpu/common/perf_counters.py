@@ -65,6 +65,14 @@ class PerfCounters:
     def set(self, key: str, value: int) -> None:
         self._counters[key].value = value
 
+    def set_max(self, key: str, value: int) -> None:
+        """Raise a gauge to `value` only if it is higher — the
+        peak/high-watermark gauge idiom (queue depth peaks, max backlog)
+        that a plain `set` would overwrite on every sample."""
+        c = self._counters[key]
+        if value > c.value:
+            c.value = value
+
     def tinc(self, key: str, seconds: float) -> None:
         c = self._counters[key]
         c.avgcount += 1

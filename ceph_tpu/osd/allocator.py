@@ -73,8 +73,22 @@ class ExtentAllocator:
     def allocate(self, length: int) -> list[tuple[int, int]]:
         """Return disjoint extents totalling round_up(length) bytes —
         free extents first (address order), then an end-of-device
-        extension. May span multiple extents (BlueStore PExtentVector)."""
+        extension. May span multiple extents (BlueStore PExtentVector).
+
+        Prefers the first free extent that fits the whole ask (so the
+        common allocation is one contiguous run the vectored device IO
+        path serves with a single pwrite/pread) before falling back to
+        first-fit spanning across fragments; spanning still beats
+        growing the device, which keeps the block file compact."""
         need = self.round_up(length)
+        if need:
+            for off in sorted(self.free):
+                ln = self.free[off]
+                if ln >= need:
+                    self.free.pop(off)
+                    if need < ln:
+                        self.free[off + need] = ln - need
+                    return [(off, need)]
         got: list[tuple[int, int]] = []
         for off in sorted(self.free):
             if not need:
@@ -89,6 +103,32 @@ class ExtentAllocator:
             got.append((self.size, need))
             self.size += need
         return got
+
+    def allocate_many(
+        self, lengths: list[int]
+    ) -> list[list[tuple[int, int]]]:
+        """One allocator pass for a whole batch (the deferred-flush
+        shape): allocate round_up(sum) bytes once, then carve the
+        returned extents into per-length runs at min_alloc boundaries.
+        Cheaper than N allocate() calls and it lands the batch in one
+        (usually contiguous) device region, so the flush coalesces into
+        very few writes."""
+        pool = self.allocate(sum(self.round_up(n) for n in lengths))
+        out: list[list[tuple[int, int]]] = []
+        for n in lengths:
+            need = self.round_up(n)
+            got: list[tuple[int, int]] = []
+            while need:
+                off, ln = pool[0]
+                take = min(ln, need)
+                got.append((off, take))
+                if take < ln:
+                    pool[0] = (off + take, ln - take)
+                else:
+                    pool.pop(0)
+                need -= take
+            out.append(got)
+        return out
 
     def release(self, extents) -> None:
         """Return extents to the free map, coalescing neighbors."""

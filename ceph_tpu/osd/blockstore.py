@@ -21,24 +21,56 @@ and the ordering discipline is BlueStore's:
     still references across a crash.
 
 Every checksum block (bluestore_csum_block_size) of the stored payload is
-crc32c-summed on write and verified on every read; a mismatch raises
-`StoreError("EIO", ...)`, which the OSD's deep scrub surfaces as a
+crc32c-summed on write and verified on every device read; a mismatch
+raises `StoreError("EIO", ...)`, which the OSD's deep scrub surfaces as a
 `read_error` inconsistency and repairs from healthy peers. Optional
 compression-on-write runs the payload through the compressor registry
 (BlueStore's compression_mode/required_ratio policy) with the compressed
 length tracked per blob. `fsck(deep=...)` cross-checks onode extents vs
 the free list (allocated ∪ free must tile the device exactly) and — deep —
 re-reads every blob against its stored checksums.
+
+The fast path (BlueStore's cache trio + deferred aging):
+
+  * an **onode LRU** (`blockstore_onode_cache_size`) keeps decoded
+    onodes so hot objects skip the KV fetch + decode; entries fold in
+    only after the KV batch that changes them commits, so the cache is
+    always committed truth (aborted compiles never pollute it);
+  * a **buffer cache** (`blockstore_buffer_cache_bytes`, LRU by bytes,
+    write-through) keeps recently read/written logical object data so
+    re-reads skip the device and the checksum re-verify entirely.
+    `read_verify` bypasses it (and refreshes it) — deep scrub and fsck
+    always see device truth, so cached data can never mask at-rest
+    corruption; `drop_caches` is the restart-equivalent hook tests use;
+  * a **background flusher** drains the deferred backlog once its oldest
+    entry exceeds `blockstore_deferred_max_age_ms` (BlueStore's
+    deferred_try_submit aging), instead of only on byte pressure. It
+    starts lazily on the first commit that leaves a backlog — a store
+    opened for inspection (fsck / objectstore_tool) never spawns one —
+    and is joined before the device closes. Crash-safety is unchanged:
+    the flush is the same WAL-row-authoritative two-phase move;
+  * **vectored device IO**: adjacent extents coalesce into single
+    pwrite/pread calls (writev/readv discipline), and the deferred flush
+    batches the whole backlog into ONE allocator pass + one coalesced
+    write plan + one fsync + one KV batch.
+
+Per-store `PerfCounters` (cache hits/misses, deferred queue depth/age,
+flush latency, device call/segment counts) make the wins observable via
+`perf dump` when a daemon adopts the block.
 """
 
 from __future__ import annotations
 
 import os
+import threading
+import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from ceph_tpu.common.crc import ceph_crc32c
 from ceph_tpu.common.encoding import Decoder, Encoder
 from ceph_tpu.common.kv import KeyValueDB, KVTransaction
+from ceph_tpu.common.perf_counters import PerfCounters
 from ceph_tpu.osd.allocator import ExtentAllocator
 from ceph_tpu.osd.objectstore import (
     _ATTR,
@@ -98,6 +130,20 @@ class Onode:
         return on
 
 
+def _coalesce(extents) -> list[tuple[int, int]]:
+    """Merge device-adjacent extents into runs: [(0,4096),(4096,4096)]
+    -> [(0,8192)]. Inputs are in payload order; only extents adjacent in
+    BOTH payload and device order merge, so a run is always one
+    contiguous pread/pwrite of in-order payload bytes."""
+    runs: list[list[int]] = []
+    for off, ln in extents:
+        if runs and runs[-1][0] + runs[-1][1] == off:
+            runs[-1][1] += ln
+        else:
+            runs.append([off, ln])
+    return [(off, ln) for off, ln in runs]
+
+
 # ---------------------------------------------------------------------------
 # Block devices (KernelDevice's role, reduced to pread/pwrite/flush)
 
@@ -117,6 +163,10 @@ class MemBlockDevice:
             self.buf.extend(b"\x00" * (end - len(self.buf)))
         self.buf[off:end] = data
 
+    def pwritev(self, off: int, buffers) -> None:
+        """One contiguous vectored write (writev at a device offset)."""
+        self.pwrite(off, b"".join(buffers))
+
     def pread(self, off: int, length: int) -> bytes:
         out = bytes(self.buf[off:off + length])
         return out + b"\x00" * (length - len(out))  # sparse tail is zeros
@@ -130,32 +180,63 @@ class MemBlockDevice:
 
 class FileBlockDevice:
     """One raw block file, grow-on-demand; flush() is a real fsync — the
-    write-before-commit ordering the crash story depends on."""
+    write-before-commit ordering the crash story depends on.
+
+    All IO is raw positional fd syscalls (os.pread/os.pwrite(v)) —
+    KernelDevice's shape — deliberately avoiding Python's buffered file
+    objects: mixing a BufferedRandom's seek-within-buffer fast path with
+    raw vectored writes on the same fd can serve stale bytes."""
 
     def __init__(self, path: str):
         self.path = path
-        if not os.path.exists(path):
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            with open(path, "wb"):
-                pass
-        self._f = open(path, "r+b")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        self._closed = False
 
     def pwrite(self, off: int, data: bytes) -> None:
-        self._f.seek(off)
-        self._f.write(data)
+        view = memoryview(data)
+        while view:
+            n = os.pwrite(self._fd, view, off)
+            off += n
+            view = view[n:]
+
+    def pwritev(self, off: int, buffers) -> None:
+        """One contiguous vectored write: os.pwritev when the platform
+        has it (one syscall for the whole coalesced run, the io_uring-ish
+        shape), else a joined pwrite."""
+        buffers = [b for b in buffers if b]
+        if not buffers:
+            return
+        if len(buffers) == 1 or not hasattr(os, "pwritev"):
+            self.pwrite(off, buffers[0] if len(buffers) == 1
+                        else b"".join(buffers))
+            return
+        queue = [memoryview(b) for b in buffers]
+        while queue:
+            n = os.pwritev(self._fd, queue, off)
+            off += n
+            while queue and n >= len(queue[0]):
+                n -= len(queue[0])
+                queue.pop(0)
+            if queue and n:
+                queue[0] = queue[0][n:]
 
     def pread(self, off: int, length: int) -> bytes:
-        self._f.seek(off)
-        out = self._f.read(length)
-        return out + b"\x00" * (length - len(out))  # sparse tail is zeros
+        out = os.pread(self._fd, length, off)
+        while len(out) < length:  # short reads only happen at EOF...
+            more = os.pread(self._fd, length - len(out), off + len(out))
+            if not more:
+                break
+            out += more
+        return out + b"\x00" * (length - len(out))  # ...sparse tail: zeros
 
     def flush(self) -> None:
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        os.fsync(self._fd)
 
     def close(self) -> None:
-        if not self._f.closed:
-            self._f.close()
+        if not self._closed:
+            self._closed = True
+            os.close(self._fd)
 
 
 # ---------------------------------------------------------------------------
@@ -167,6 +248,11 @@ class BlockStore(KStore):
     Inherits the collection/attr/omap row handling from KStore and
     overrides only the data-bearing ops — the BlueStore/KStore contract
     difference is *where bytes live*, not what a Transaction means.
+
+    Thread model: the data path is the owning (event-loop) thread plus
+    the one background flusher; every entry point that touches the KV
+    table, device, allocator, or caches serializes on `_lock` (an RLock
+    so transaction compilation may re-enter `read`).
     """
 
     def __init__(self, db: KeyValueDB | None = None, config=None,
@@ -199,6 +285,15 @@ class BlockStore(KStore):
         self.deferred_batch_bytes = int(
             config.get("blockstore_deferred_batch_bytes")
         )
+        self.deferred_max_age = (
+            int(config.get("blockstore_deferred_max_age_ms")) / 1000.0
+        )
+        self.onode_cache_size = int(
+            config.get("blockstore_onode_cache_size")
+        )
+        self.buffer_cache_bytes = int(
+            config.get("blockstore_buffer_cache_bytes")
+        )
         if block_path is None:
             block_path = config.get("blockstore_block_path") or None
         if block_path is None and isinstance(
@@ -208,11 +303,65 @@ class BlockStore(KStore):
         self.device = (
             FileBlockDevice(block_path) if block_path else MemBlockDevice()
         )
+        # caches: committed truth only (folded in at commit points)
+        self._onode_cache: OrderedDict[bytes, Onode] = OrderedDict()
+        self._buffer_cache: OrderedDict[bytes, bytes] = OrderedDict()
+        self._buffer_bytes = 0
+        # one lock serializes the data path against the flusher thread
+        self._lock = threading.RLock()
+        self._flusher: threading.Thread | None = None
+        self._flusher_stop = threading.Event()
+        self._closed = False
+        self.perf = self._make_perf()
         # per-transaction compile state
         self._staged: dict[bytes, tuple[Onode, bytes]] = {}
         self._pending_release: list[tuple[int, int]] = []
         self._batch_allocs: list[tuple[int, int]] = []
+        self._batch_drops: set[bytes] = set()
         self._mount(geom is None)
+
+    def _make_perf(self) -> PerfCounters:
+        perf = PerfCounters("blockstore")
+        for key, desc in (
+            ("onode_hit", "onode served from the LRU (no KV decode)"),
+            ("onode_miss", "onode fetched from KV and decoded"),
+            ("buffer_hit", "read served from the buffer cache "
+                           "(no device IO, no checksum verify)"),
+            ("buffer_miss", "read that went to the WAL row / device"),
+            ("buffer_evict_bytes", "bytes LRU-evicted from the buffer "
+                                   "cache"),
+            ("write_big", "writes that took the COW big-write path"),
+            ("write_deferred", "sub-min_alloc writes deferred onto the "
+                               "KV WAL"),
+            ("deferred_flush", "deferred-backlog flushes"),
+            ("deferred_flush_aged", "flushes triggered by max-age, not "
+                                    "byte pressure"),
+            ("deferred_flush_ops", "payloads moved WAL -> device by "
+                                   "flushes"),
+            ("deferred_flush_errors", "background flush attempts that "
+                                      "raised (retried next tick)"),
+            ("dev_read_calls", "device pread calls issued"),
+            ("dev_read_segments", "extents those preads covered "
+                                  "(segments - calls = coalescing win)"),
+            ("dev_write_calls", "device pwrite(v) calls issued"),
+            ("dev_write_segments", "extents those pwrites covered"),
+        ):
+            perf.add_u64_counter(key, desc)
+        for key, desc in (
+            ("deferred_bytes", "deferred backlog riding the KV WAL"),
+            ("deferred_peak_bytes", "high-watermark of the deferred "
+                                    "backlog since mount"),
+            ("deferred_ops", "deferred payload rows queued"),
+            ("deferred_age_ms", "age of the oldest queued deferred "
+                                "write at the last queue/flush event"),
+            ("buffer_bytes", "bytes held by the buffer cache"),
+            ("onode_entries", "onodes held by the LRU"),
+        ):
+            perf.add_u64(key, desc)
+        perf.add_time_avg(
+            "l_flush", "deferred flush wall time (alloc+write+fsync+KV)"
+        )
+        return perf
 
     def _mount(self, mkfs: bool) -> None:
         raw = self.db.get(_BMETA, b"size")
@@ -222,9 +371,14 @@ class BlockStore(KStore):
             for k, v in self.db.iterate(_FREE)
         }
         self.alloc.init(free, size)
-        self._deferred_bytes = sum(
-            len(v) for _k, v in self.db.iterate(_DEFER)
-        )
+        rows = list(self.db.iterate(_DEFER))
+        self._deferred_bytes = sum(len(v) for _k, v in rows)
+        self._deferred_ops = len(rows)
+        # a backlog inherited across a crash starts its age clock at
+        # mount; the flusher itself stays lazy (first write commit) so a
+        # store opened only for inspection never mutates itself
+        self._deferred_since = time.monotonic() if rows else None
+        self._sync_gauges()
         if mkfs:
             kv = KVTransaction()
             kv.set(
@@ -234,21 +388,99 @@ class BlockStore(KStore):
             )
             self.db.submit_transaction(kv)
 
+    # -- caches ---------------------------------------------------------------
+
+    def _sync_gauges(self) -> None:
+        self.perf.set("deferred_bytes", self._deferred_bytes)
+        self.perf.set_max("deferred_peak_bytes", self._deferred_bytes)
+        self.perf.set("deferred_ops", self._deferred_ops)
+        self.perf.set(
+            "deferred_age_ms", int(self.deferred_age_s() * 1000)
+        )
+        self.perf.set("buffer_bytes", self._buffer_bytes)
+        self.perf.set("onode_entries", len(self._onode_cache))
+
+    def _onode_put(self, key: bytes, on: Onode) -> None:
+        if self.onode_cache_size <= 0:
+            return
+        oc = self._onode_cache
+        oc[key] = on
+        oc.move_to_end(key)
+        while len(oc) > self.onode_cache_size:
+            oc.popitem(last=False)
+
+    def _get_onode(self, key: bytes) -> Onode | None:
+        """Committed onode for `key`, LRU first. None when absent."""
+        on = self._onode_cache.get(key)
+        if on is not None:
+            self._onode_cache.move_to_end(key)
+            self.perf.inc("onode_hit")
+            return on
+        raw = self.db.get(_ONODE, key)
+        if raw is None:
+            return None
+        self.perf.inc("onode_miss")
+        on = Onode.decode(raw)
+        self._onode_put(key, on)
+        return on
+
+    def _buffer_drop(self, key: bytes) -> None:
+        old = self._buffer_cache.pop(key, None)
+        if old is not None:
+            self._buffer_bytes -= len(old)
+
+    def _buffer_put(self, key: bytes, data: bytes) -> None:
+        if self.buffer_cache_bytes <= 0:
+            return
+        self._buffer_drop(key)
+        if len(data) > self.buffer_cache_bytes:
+            return
+        self._buffer_cache[key] = data
+        self._buffer_bytes += len(data)
+        while self._buffer_bytes > self.buffer_cache_bytes:
+            _k, v = self._buffer_cache.popitem(last=False)
+            self._buffer_bytes -= len(v)
+            self.perf.inc("buffer_evict_bytes", len(v))
+
+    def drop_caches(self) -> None:
+        """Forget every cached onode and data buffer — the cache state an
+        OSD restart implies. The next reads hit the KV layer and the
+        device, which is what makes injected at-rest bit-rot visible to a
+        plain `read` again (deep scrub never needs this: `read_verify`
+        bypasses the buffer cache by construction)."""
+        with self._lock:
+            self._onode_cache.clear()
+            self._buffer_cache.clear()
+            self._buffer_bytes = 0
+            self._sync_gauges()
+
     # -- transaction compilation ----------------------------------------------
+
+    def queue_transaction(self, txn) -> None:
+        with self._lock:
+            super().queue_transaction(txn)
 
     def _begin_batch(self) -> None:
         self._staged = {}
         self._pending_release = []
         self._batch_allocs = []
+        self._batch_drops = set()
 
     def _abort_batch(self) -> None:
         # compile failed before the commit point: hand batch allocations
-        # back (their device bytes are garbage in free space — harmless)
-        # and re-derive the deferred backlog from committed rows
+        # back (their device bytes are garbage in free space — harmless),
+        # re-derive the deferred backlog from committed rows, and drop
+        # every touched cache entry (committed truth is re-readable)
         self.alloc.release(self._batch_allocs)
-        self._deferred_bytes = sum(
-            len(v) for _k, v in self.db.iterate(_DEFER)
-        )
+        rows = list(self.db.iterate(_DEFER))
+        self._deferred_bytes = sum(len(v) for _k, v in rows)
+        self._deferred_ops = len(rows)
+        if not rows:
+            self._deferred_since = None
+        for key in set(self._staged) | self._batch_drops:
+            self._onode_cache.pop(key, None)
+            self._buffer_drop(key)
+        self._sync_gauges()
         self._begin_batch()
 
     def _commit_batch(self, kv: KVTransaction) -> None:
@@ -259,6 +491,21 @@ class BlockStore(KStore):
         self.alloc.flush(kv, _FREE, _BMETA)
         self.device.flush()  # data durable BEFORE metadata references it
         self.db.submit_transaction(kv)
+        # the batch is durable: fold its effects into the caches (drops
+        # first — a remove-then-write of one key re-stages it)
+        for key in self._batch_drops:
+            self._onode_cache.pop(key, None)
+            self._buffer_drop(key)
+        for key, (on, data) in self._staged.items():
+            self._onode_put(key, on)
+            self._buffer_put(key, data)
+        if self._deferred_bytes > 0:
+            if self._deferred_since is None:
+                self._deferred_since = time.monotonic()
+            self._maybe_start_flusher()
+        else:
+            self._deferred_since = None
+        self._sync_gauges()
         self._begin_batch()
         if self._deferred_bytes > self.deferred_batch_bytes:
             self.flush_deferred()
@@ -268,7 +515,7 @@ class BlockStore(KStore):
         if kind == "touch":
             _, coll, name = op
             key = _okey(coll, name)
-            if key not in self._staged and self.db.get(_ONODE, key) is None:
+            if key not in self._staged and self._get_onode(key) is None:
                 on = Onode(csum_block=self.csum_block)
                 kv.set(_ONODE, key, on.encode())
                 self._staged[key] = (on, b"")
@@ -291,6 +538,7 @@ class BlockStore(KStore):
             _, coll, name = op
             key = _okey(coll, name)
             self._forget(kv, key)
+            self._batch_drops.add(key)
             kv.rm(_ONODE, key)
             kv.rm(_ATTR, key)
             for k, _v in list(self.db.iterate(_OMAP)):
@@ -301,6 +549,7 @@ class BlockStore(KStore):
             for k, _v in list(self.db.iterate(_ONODE)):
                 if k[1].startswith(prefix):
                     self._forget(kv, k[1])
+                    self._batch_drops.add(k[1])
             super()._compile_op(kv, op)  # coll row + rows via _rows_of
         else:
             super()._compile_op(kv, op)
@@ -312,13 +561,13 @@ class BlockStore(KStore):
         if staged is not None:
             on = staged[0]
         else:
-            raw = self.db.get(_ONODE, key)
-            if raw is None:
+            on = self._get_onode(key)
+            if on is None:
                 return
-            on = Onode.decode(raw)
         if on.flags & FLAG_INLINE:
             kv.rm(_DEFER, key)
             self._deferred_bytes -= on.stored_len
+            self._deferred_ops -= 1
         else:
             self._pending_release.extend(on.extents)
 
@@ -348,10 +597,13 @@ class BlockStore(KStore):
             on.flags |= FLAG_INLINE
             kv.set(_DEFER, key, payload)
             self._deferred_bytes += len(payload)
+            self._deferred_ops += 1
+            self.perf.inc("write_deferred")
         elif payload:
             on.extents = self.alloc.allocate(len(payload))
             self._batch_allocs.extend(on.extents)
             self._write_extents(on.extents, payload)
+            self.perf.inc("write_big")
         kv.set(_ONODE, key, on.encode())
         self._staged[key] = (on, data)
 
@@ -368,76 +620,227 @@ class BlockStore(KStore):
                 return b""
             raise
 
+    # -- vectored device IO ----------------------------------------------------
+
     def _write_extents(self, extents, payload: bytes) -> None:
+        self._write_plan(self._extent_chunks(extents, payload))
+
+    @staticmethod
+    def _extent_chunks(extents, payload: bytes):
+        """[(device offset, chunk)] for a payload across its extents.
+        Chunks are zero-padded to the extent length — the extents are
+        freshly-allocated COW space, reads stop at stored_len, and full
+        min_alloc-granular chunks let device-adjacent extents (even of
+        different objects in a deferred batch) coalesce into one
+        pwrite."""
         pos = 0
+        plan = []
         for off, ln in extents:
             chunk = payload[pos:pos + ln]
-            self.device.pwrite(off, chunk)
             pos += len(chunk)
+            if len(chunk) < ln:
+                chunk = chunk + b"\x00" * (ln - len(chunk))
+            if chunk:
+                plan.append((off, chunk))
+        return plan
+
+    def _write_plan(self, plan) -> None:
+        """Issue [(device offset, bytes)] writes, coalescing runs that
+        are adjacent on the device into single vectored pwrites."""
+        if not plan:
+            return
+        plan = sorted(plan)
+        run_off, run = plan[0][0], [plan[0][1]]
+        run_end = run_off + len(plan[0][1])
+        calls = 0
+        for off, data in plan[1:]:
+            if off == run_end:
+                run.append(data)
+            else:
+                self.device.pwritev(run_off, run)
+                calls += 1
+                run_off, run = off, [data]
+            run_end = off + len(data)
+        self.device.pwritev(run_off, run)
+        self.perf.inc("dev_write_calls", calls + 1)
+        self.perf.inc("dev_write_segments", len(plan))
 
     # -- deferred writes -------------------------------------------------------
 
+    def deferred_age_s(self) -> float:
+        """Seconds the oldest queued deferred write has been waiting."""
+        since = self._deferred_since
+        return 0.0 if since is None else time.monotonic() - since
+
+    def tick(self) -> int:
+        """Age-based deferred flush: drain the backlog iff its oldest
+        entry exceeds blockstore_deferred_max_age_ms. Called by the
+        background flusher; also callable from an external driver loop
+        (an OSD tick) when the flusher is disabled. Returns payloads
+        moved."""
+        with self._lock:
+            self._sync_gauges()
+            if self._closed or self._deferred_bytes <= 0:
+                return 0
+            if self.deferred_max_age <= 0:
+                return 0
+            if self.deferred_age_s() < self.deferred_max_age:
+                return 0
+            self.perf.inc("deferred_flush_aged")
+            return self.flush_deferred()
+
+    def _maybe_start_flusher(self) -> None:
+        """Lazily spawn the aging flusher — only ever from a write
+        commit, so read-only opens (fsck, objectstore_tool) never start
+        one and never mutate the store under examination."""
+        if (
+            self._flusher is None
+            and not self._closed
+            and self.deferred_max_age > 0
+        ):
+            self._flusher = threading.Thread(
+                target=self._flusher_main,
+                name="blockstore-flusher",
+                daemon=True,
+            )
+            self._flusher.start()
+
+    def _flusher_main(self) -> None:
+        interval = max(0.01, self.deferred_max_age / 4)
+        while not self._flusher_stop.wait(interval):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - keep aging; retry next tick
+                self.perf.inc("deferred_flush_errors")
+
+    def _stop_flusher(self) -> None:
+        """Join the flusher (outside the lock — it may hold it mid-flush)
+        so no thread can touch the device after close."""
+        t = self._flusher
+        if t is None:
+            return
+        self._flusher_stop.set()
+        t.join()
+        self._flusher = None
+
     def flush_deferred(self) -> int:
         """Move every deferred payload onto the device (BlueStore's
-        deferred_try_submit / _deferred_replay): allocate, write, fsync,
+        deferred_try_submit / _deferred_replay): ONE allocator pass for
+        the whole backlog, one coalesced vectored write plan, one fsync,
         then ONE KV batch repoints the onodes and drops the WAL rows.
         Crash-safe at any point — until that batch commits, the _DEFER
         rows remain authoritative. Returns the number of payloads moved."""
-        rows = [(k[1], v) for k, v in self.db.iterate(_DEFER)]
-        if not rows:
+        with self._lock:
+            t0 = time.perf_counter()
+            rows = [(k[1], v) for k, v in self.db.iterate(_DEFER)]
+            if not rows:
+                self._deferred_bytes = 0
+                self._deferred_ops = 0
+                self._deferred_since = None
+                self._sync_gauges()
+                return 0
+            kv = KVTransaction()
+            moved: list[tuple[bytes, Onode, bytes]] = []
+            for key, payload in rows:
+                raw = self.db.get(_ONODE, key)
+                on = Onode.decode(raw) if raw is not None else None
+                if on is None or not on.flags & FLAG_INLINE:
+                    kv.rm(_DEFER, key)  # orphan WAL row: drop
+                    continue
+                moved.append((key, on, payload))
+            if moved:
+                extent_lists = self.alloc.allocate_many(
+                    [len(p) for _k, _on, p in moved]
+                )
+                plan = []
+                for (key, on, payload), extents in zip(
+                    moved, extent_lists
+                ):
+                    on.extents = extents
+                    on.flags &= ~FLAG_INLINE
+                    kv.set(_ONODE, key, on.encode())
+                    kv.rm(_DEFER, key)
+                    plan.extend(self._extent_chunks(extents, payload))
+                self._write_plan(plan)
+            self.alloc.flush(kv, _FREE, _BMETA)
+            self.device.flush()
+            self.db.submit_transaction(kv)
+            for key, on, _payload in moved:
+                if key in self._onode_cache:
+                    self._onode_cache[key] = on
             self._deferred_bytes = 0
-            return 0
-        kv = KVTransaction()
-        moved = 0
-        for key, payload in rows:
-            raw = self.db.get(_ONODE, key)
-            on = Onode.decode(raw) if raw is not None else None
-            if on is None or not on.flags & FLAG_INLINE:
-                kv.rm(_DEFER, key)  # orphan WAL row: drop
-                continue
-            on.extents = self.alloc.allocate(len(payload))
-            self._write_extents(on.extents, payload)
-            on.flags &= ~FLAG_INLINE
-            kv.set(_ONODE, key, on.encode())
-            kv.rm(_DEFER, key)
-            moved += 1
-        self.alloc.flush(kv, _FREE, _BMETA)
-        self.device.flush()
-        self.db.submit_transaction(kv)
-        self._deferred_bytes = 0
-        return moved
+            self._deferred_ops = 0
+            self._deferred_since = None
+            self.perf.inc("deferred_flush")
+            self.perf.inc("deferred_flush_ops", len(moved))
+            self.perf.tinc("l_flush", time.perf_counter() - t0)
+            self._sync_gauges()
+            return len(moved)
 
     def compact(self) -> None:
         """Flush the deferred backlog, then fold the KV WAL."""
-        self.flush_deferred()
-        if hasattr(self.db, "compact"):
-            self.db.compact()
+        with self._lock:
+            self.flush_deferred()
+            if hasattr(self.db, "compact"):
+                self.db.compact()
 
     def umount(self) -> None:
-        """Clean shutdown: drain deferred writes, close device + DB."""
-        self.flush_deferred()
-        self.device.close()
-        if hasattr(self.db, "close"):
-            self.db.close()
+        """Clean shutdown: join the flusher BEFORE the device closes,
+        drain deferred writes, close device + DB."""
+        self._stop_flusher()
+        with self._lock:
+            if not self._closed:
+                self.flush_deferred()
+            self._closed = True
+            self.device.close()
+            if hasattr(self.db, "close"):
+                self.db.close()
 
     def close(self) -> None:
         """Read-only close (fsck/tool path): no deferred flush, so an
-        inspection never mutates the store under examination."""
-        self.device.close()
-        if hasattr(self.db, "close"):
-            self.db.close()
+        inspection never mutates the store under examination. A flusher
+        is never *started* by this path (it spawns only from write
+        commits), but one left over from earlier writes is still joined
+        before the device goes away."""
+        self._stop_flusher()
+        with self._lock:
+            self._closed = True
+            self.device.close()
+            if hasattr(self.db, "close"):
+                self.db.close()
 
     # -- reads ----------------------------------------------------------------
 
     def exists(self, coll: str, name: str) -> bool:
-        return self.db.get(_ONODE, _okey(coll, name)) is not None
+        with self._lock:
+            key = _okey(coll, name)
+            if key in self._onode_cache:
+                return True
+            return self.db.get(_ONODE, key) is not None
 
     def read(self, coll: str, name: str) -> bytes:
-        key = _okey(coll, name)
-        raw = self.db.get(_ONODE, key)
-        if raw is None:
+        with self._lock:
+            key = _okey(coll, name)
+            data = self._buffer_cache.get(key)
+            if data is not None:
+                self._buffer_cache.move_to_end(key)
+                self.perf.inc("buffer_hit")
+                return data
+            self.perf.inc("buffer_miss")
+            return self._read_cold(coll, name, key)
+
+    def read_verify(self, coll: str, name: str) -> bytes:
+        """Read device truth: bypass the buffer cache, re-run the stored
+        checksum verification, and refresh the cache with the verified
+        bytes. Deep scrub reads through this so cached data can never
+        mask at-rest corruption."""
+        with self._lock:
+            return self._read_cold(coll, name, _okey(coll, name))
+
+    def _read_cold(self, coll: str, name: str, key: bytes) -> bytes:
+        on = self._get_onode(key)
+        if on is None:
             raise StoreError("ENOENT", f"{coll}/{name} does not exist")
-        on = Onode.decode(raw)
         payload = self._read_payload(key, on, f"{coll}/{name}")
         if on.flags & FLAG_COMPRESSED:
             from ceph_tpu.common.compressor import factory
@@ -454,8 +857,10 @@ class BlockStore(KStore):
                     f"{coll}/{name}: decompressed to {len(data)} bytes, "
                     f"onode says {on.size}",
                 )
-            return data
-        return payload
+        else:
+            data = payload
+        self._buffer_put(key, data)
+        return data
 
     def _read_payload(self, key: bytes, on: Onode, label: str) -> bytes:
         if on.flags & FLAG_INLINE:
@@ -465,12 +870,17 @@ class BlockStore(KStore):
                     "EIO", f"{label}: deferred payload row missing"
                 )
         else:
-            parts = []
+            takes = []
             remaining = on.stored_len
             for off, ln in on.extents:
                 take = min(ln, remaining)
-                parts.append(self.device.pread(off, take))
+                if take:
+                    takes.append((off, take))
                 remaining -= take
+            runs = _coalesce(takes)
+            parts = [self.device.pread(off, ln) for off, ln in runs]
+            self.perf.inc("dev_read_calls", len(runs))
+            self.perf.inc("dev_read_segments", len(takes))
             payload = b"".join(parts)
             if len(payload) != on.stored_len:
                 raise StoreError(
@@ -495,12 +905,13 @@ class BlockStore(KStore):
         return payload
 
     def list_objects(self, coll: str) -> list[str]:
-        prefix = Encoder().string(coll).bytes()
-        return [
-            _okey_decode(k[1])[1]
-            for k, _v in self.db.iterate(_ONODE)
-            if k[1].startswith(prefix)
-        ]
+        with self._lock:
+            prefix = Encoder().string(coll).bytes()
+            return [
+                _okey_decode(k[1])[1]
+                for k, _v in self.db.iterate(_ONODE)
+                if k[1].startswith(prefix)
+            ]
 
     def _rows_of(self, coll: str):
         prefix = Encoder().string(coll).bytes()
@@ -509,10 +920,29 @@ class BlockStore(KStore):
                 if k[1].startswith(prefix):
                     yield table, k[1]
 
+    # the flusher thread mutates the (single) KV table dict mid-batch;
+    # every reader that iterates it must hold the lock too
+    def getattrs(self, coll: str, name: str) -> dict:
+        with self._lock:
+            return super().getattrs(coll, name)
+
+    def omap_get(self, coll: str, name: str) -> dict[bytes, bytes]:
+        with self._lock:
+            return super().omap_get(coll, name)
+
+    def collection_exists(self, coll: str) -> bool:
+        with self._lock:
+            return super().collection_exists(coll)
+
+    def list_collections(self) -> list[str]:
+        with self._lock:
+            return super().list_collections()
+
     def used_bytes(self) -> int:
         """KV footprint (metadata + deferred WAL rows) plus the bytes the
         allocator has handed to live blobs."""
-        return super().used_bytes() + self.alloc.allocated_bytes()
+        with self._lock:
+            return super().used_bytes() + self.alloc.allocated_bytes()
 
     # -- fsck -----------------------------------------------------------------
 
@@ -523,7 +953,12 @@ class BlockStore(KStore):
         no extents; no orphan WAL rows; onode extents vs the free list
         tile [0, device size) exactly (no overlap, no leak). Deep: also
         re-read every blob and verify its stored checksums (and that
-        compressed blobs still decompress to the logical size)."""
+        compressed blobs still decompress to the logical size). Reads go
+        straight to the KV rows and the device — never the caches."""
+        with self._lock:
+            return self._fsck_locked(deep)
+
+    def _fsck_locked(self, deep: bool) -> list[dict]:
         errors: list[dict] = []
         onodes: list[tuple[str, str, bytes, Onode]] = []
         allocated: list[tuple[int, int]] = []

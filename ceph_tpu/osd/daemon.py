@@ -406,6 +406,12 @@ class OSDService(Dispatcher):
 
         self.perf_collection = PerfCountersCollection()
         self.perf = self.perf_collection.create(self.name)
+        # a BlockStore keeps its own counter block (cache hits, deferred
+        # queue depth/age, flush latency); adopt it so `perf dump` shows
+        # the store alongside the op-path counters
+        store_perf = getattr(self.store, "perf", None)
+        if store_perf is not None:
+            self.perf_collection.add(store_perf)
         for key, desc in (
             ("op_w", "client writes served as primary"),
             ("op_w_partial", "EC writes served via sub-stripe RMW"),
@@ -639,6 +645,15 @@ class OSDService(Dispatcher):
             except (asyncio.CancelledError, Exception):
                 pass
         await self.messenger.shutdown()
+        # a BlockStore owns a background deferred-write flusher: umount
+        # joins it before the device closes and drains the backlog
+        umount = getattr(self.store, "umount", None)
+        if umount is not None:
+            try:
+                umount()
+            except Exception:  # noqa: BLE001 - shutdown must not throw
+                if (d := self.dlog.dout(1)) is not None:
+                    d(f"osd.{self.id}: store umount failed at stop")
 
     # -- placement helpers ----------------------------------------------------
 
@@ -2008,8 +2023,12 @@ class OSDService(Dispatcher):
         `runs` = [[off,len],...] requests sub-extent ranges only — the
         ECSubRead (offset,count) shape (src/osd/ECMsgTypes.h to_read)
         that sub-stripe RMW reads and CLAY fractional repairs ride."""
+        reader = self.store.read
+        if p.get("verify"):
+            # deep-scrub fetch: read device truth, not the buffer cache
+            reader = getattr(self.store, "read_verify", reader)
         try:
-            data = self.store.read(p["coll"], p["name"])
+            data = reader(p["coll"], p["name"])
             attrs = self.store.getattrs(p["coll"], p["name"])
         except StoreError as e:
             # carry the errno so the scrubbing primary can tell at-rest
@@ -4120,12 +4139,18 @@ class OSDService(Dispatcher):
                     data=json.dumps(reply).encode())
         )
 
-    async def _scrub_fetch(self, pg, sname: str, osd: int):
-        """One copy's (data, attrs) or an error string."""
+    async def _scrub_fetch(self, pg, sname: str, osd: int,
+                           verify: bool = False):
+        """One copy's (data, attrs) or an error string. `verify` reads
+        device truth through BlockStore.read_verify so the buffer cache
+        can never mask at-rest corruption from a deep scrub."""
         if osd == self.id:
+            reader = self.store.read
+            if verify:
+                reader = getattr(self.store, "read_verify", reader)
             try:
                 return (
-                    self.store.read(pg.coll, sname),
+                    reader(pg.coll, sname),
                     self.store.getattrs(pg.coll, sname),
                 )
             except StoreError as e:
@@ -4134,7 +4159,8 @@ class OSDService(Dispatcher):
                 return "read_error" if e.code == "EIO" else "missing"
         try:
             rep = await self._peer_call(
-                osd, "obj_read", {"coll": pg.coll, "name": sname},
+                osd, "obj_read",
+                {"coll": pg.coll, "name": sname, "verify": verify},
                 timeout=2.0,
             )
         except (asyncio.TimeoutError, RuntimeError):
@@ -4170,7 +4196,7 @@ class OSDService(Dispatcher):
                         continue
                     shard = pos if ec is not None else None
                     got = await self._scrub_fetch(
-                        pg, shard_name(name, shard), osd
+                        pg, shard_name(name, shard), osd, verify=deep
                     )
                     if isinstance(got, str):
                         errors.append(
@@ -4284,8 +4310,10 @@ class OSDService(Dispatcher):
                 if osd in (_NONE, bad_osd) or self.osdmap.is_down(osd):
                     continue
                 spos = pos if ec is not None else None
+                # repair sources are verified reads: never rebuild a
+                # copy from a peer's (possibly rot-masking) cache
                 got = await self._scrub_fetch(
-                    pg, shard_name(err["name"], spos), osd
+                    pg, shard_name(err["name"], spos), osd, verify=True
                 )
                 if isinstance(got, str):
                     continue

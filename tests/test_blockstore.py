@@ -114,6 +114,14 @@ def test_bitrot_is_detected_on_read_and_by_deep_fsck():
     )
     assert st.fsck(deep=True) == []
     st.device.buf[4100] ^= 0x01  # one flipped bit, second csum block
+    # the write-through buffer cache still holds the fresh bytes, so a
+    # plain read can't see at-rest rot yet — but read_verify (the deep
+    # scrub read path) always reads device truth
+    assert st.read("c", "o") == b"A" * 8192
+    with pytest.raises(StoreError) as ei:
+        st.read_verify("c", "o")
+    assert ei.value.code == "EIO"
+    st.drop_caches()  # the restart-equivalent: now plain reads see it
     with pytest.raises(StoreError) as ei:
         st.read("c", "o")
     assert ei.value.code == "EIO"
@@ -130,7 +138,10 @@ def test_bitrot_is_detected_on_read_and_by_deep_fsck():
 # -- deferred writes ----------------------------------------------------------
 
 def test_small_writes_ride_the_kv_wal_then_flush_to_device():
-    st = BlockStore()
+    cfg = Config()
+    # deterministic: the aging flusher must not race the asserts below
+    cfg.set("blockstore_deferred_max_age_ms", 0)
+    st = BlockStore(config=cfg)
     st.queue_transaction(
         Transaction().create_collection("c").write("c", "s", b"x" * 100)
     )

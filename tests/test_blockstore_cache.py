@@ -1,0 +1,489 @@
+"""BlockStore fast path: onode/buffer cache coherency (on and off),
+time-aged deferred flushing (background thread + explicit tick),
+flusher-vs-close lifecycle, vectored device IO coalescing, batched
+allocation, and a seeded-random cached-vs-uncached crosscheck — plus the
+kill-9-with-active-flusher crash tier (slow)."""
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.kv import FileDB
+from ceph_tpu.osd.allocator import ExtentAllocator
+from ceph_tpu.osd.blockstore import (
+    _DEFER,
+    BlockStore,
+    MemBlockDevice,
+)
+from ceph_tpu.osd.objectstore import StoreError, Transaction
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_cfg(**overrides) -> Config:
+    cfg = Config()
+    for name, value in overrides.items():
+        cfg.set(name, value)
+    return cfg
+
+
+def uncached_cfg(**overrides) -> Config:
+    return make_cfg(
+        blockstore_onode_cache_size=0,
+        blockstore_buffer_cache_bytes=0,
+        blockstore_deferred_max_age_ms=0,
+        **overrides,
+    )
+
+
+CACHE_MODES = ["cached", "uncached"]
+
+
+def mode_cfg(mode: str, **overrides) -> Config:
+    if mode == "cached":
+        # keep aging off so cache asserts can't race the flusher; the
+        # aging tier has its own tests below
+        return make_cfg(blockstore_deferred_max_age_ms=0, **overrides)
+    return uncached_cfg(**overrides)
+
+
+# -- coherency battery (caches on and off must be indistinguishable) ----------
+
+@pytest.mark.parametrize("mode", CACHE_MODES)
+def test_read_after_write_overwrite_remove_touch(mode):
+    st = BlockStore(config=mode_cfg(mode))
+    st.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", b"v1" * 4096)
+    )
+    assert st.read("c", "o") == b"v1" * 4096
+    assert st.read("c", "o") == b"v1" * 4096  # second read: cache path
+    # overwrite (big) and overwrite (deferred) must both invalidate
+    st.queue_transaction(Transaction().write("c", "o", b"v2" * 4096))
+    assert st.read("c", "o") == b"v2" * 4096
+    st.queue_transaction(Transaction().write("c", "o", b"tiny"))
+    assert st.read("c", "o") == b"tiny"
+    # write_at patches through whatever is cached
+    st.queue_transaction(Transaction().write_at("c", "o", 2, b"XX"))
+    assert st.read("c", "o") == b"tiXX"
+    st.queue_transaction(Transaction().remove("c", "o"))
+    assert not st.exists("c", "o")
+    with pytest.raises(StoreError) as ei:
+        st.read("c", "o")
+    assert ei.value.code == "ENOENT"
+    st.queue_transaction(Transaction().touch("c", "o"))
+    assert st.read("c", "o") == b""
+    assert st.fsck(deep=True) == []
+
+
+@pytest.mark.parametrize("mode", CACHE_MODES)
+def test_rmcoll_and_clone_pattern_stay_coherent(mode):
+    st = BlockStore(config=mode_cfg(mode))
+    st.queue_transaction(
+        Transaction().create_collection("c")
+        .write("c", "src", b"S" * 8192)
+    )
+    # clone pattern (the snapshot/COPY_FROM shape at store level): read
+    # src, write the bytes under a new name, then diverge the source —
+    # the clone must keep the old content
+    st.queue_transaction(
+        Transaction().write("c", "clone", st.read("c", "src"))
+    )
+    st.queue_transaction(Transaction().write("c", "src", b"T" * 8192))
+    assert st.read("c", "clone") == b"S" * 8192
+    assert st.read("c", "src") == b"T" * 8192
+    st.queue_transaction(Transaction().remove_collection("c"))
+    for name in ("src", "clone"):
+        with pytest.raises(StoreError) as ei:
+            st.read("c", name)
+        assert ei.value.code == "ENOENT"
+    assert st.fsck(deep=True) == []
+
+
+@pytest.mark.parametrize("mode", CACHE_MODES)
+def test_aborted_transaction_never_pollutes_caches(mode):
+    st = BlockStore(config=mode_cfg(mode))
+    st.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", b"old" * 2048)
+    )
+    assert st.read("c", "o") == b"old" * 2048  # warm the caches
+    bad = Transaction().write("c", "o", b"new" * 2048)
+    bad.ops.append(("bogus-op",))
+    with pytest.raises(ValueError):
+        st.queue_transaction(bad)
+    # the aborted compile staged a new onode + data: none of it may be
+    # visible — not via the caches, not via the KV rows
+    assert st.read("c", "o") == b"old" * 2048
+    st.drop_caches()
+    assert st.read("c", "o") == b"old" * 2048
+    assert st.fsck(deep=True) == []
+
+
+def test_restart_serves_identical_bytes(tmp_path):
+    st = BlockStore(FileDB(str(tmp_path / "s")), config=make_cfg())
+    st.queue_transaction(
+        Transaction().create_collection("c")
+        .write("c", "big", b"B" * 20000)
+        .write("c", "small", b"s" * 77)
+    )
+    hot = {n: st.read("c", n) for n in ("big", "small")}
+    st.umount()
+    st2 = BlockStore(FileDB(str(tmp_path / "s")), config=make_cfg())
+    for name, data in hot.items():
+        assert st2.read(name="%s" % name, coll="c") == data
+    assert st2.fsck(deep=True) == []
+    st2.umount()
+
+
+@pytest.mark.parametrize("seed", [7, 1234])
+def test_seeded_random_crosscheck_cached_vs_uncached(tmp_path, seed):
+    """Drive the SAME seeded op stream through a fully-cached store and
+    a cache-free store: every read must be byte-identical, and the
+    cached store's plain reads must match its own verify reads (device
+    truth) and a cold reopen."""
+    rng = random.Random(seed)
+    names = [f"o{i}" for i in range(10)]
+    hot = BlockStore(
+        FileDB(str(tmp_path / "hot")),
+        config=make_cfg(blockstore_deferred_batch_bytes=8192),
+    )
+    cold = BlockStore(
+        FileDB(str(tmp_path / "cold")),
+        config=uncached_cfg(blockstore_deferred_batch_bytes=8192),
+    )
+    for st in (hot, cold):
+        st.queue_transaction(Transaction().create_collection("c"))
+    for _step in range(150):
+        name = rng.choice(names)
+        kind = rng.choice(["write", "write", "write_at", "remove",
+                           "read", "flush"])
+        if kind == "write":
+            data = bytes([rng.randrange(256)]) * rng.randint(1, 12000)
+            for st in (hot, cold):
+                st.queue_transaction(Transaction().write("c", name, data))
+        elif kind == "write_at":
+            off = rng.randint(0, 6000)
+            data = os.urandom(rng.randint(1, 500))
+            for st in (hot, cold):
+                st.queue_transaction(
+                    Transaction().write_at("c", name, off, data)
+                )
+        elif kind == "remove":
+            for st in (hot, cold):
+                st.queue_transaction(Transaction().remove("c", name))
+        elif kind == "flush":
+            for st in (hot, cold):
+                st.flush_deferred()
+        else:
+            try:
+                a = hot.read("c", name)
+            except StoreError as e:
+                assert e.code == "ENOENT"
+                with pytest.raises(StoreError):
+                    cold.read("c", name)
+            else:
+                assert a == cold.read("c", name)
+                assert a == hot.read_verify("c", name)
+    assert hot.fsck(deep=True) == []
+    assert cold.fsck(deep=True) == []
+    survivors = sorted(hot.list_objects("c"))
+    assert survivors == sorted(cold.list_objects("c"))
+    final = {n: hot.read("c", n) for n in survivors}
+    hot.umount()
+    reopened = BlockStore(FileDB(str(tmp_path / "hot")),
+                          config=uncached_cfg())
+    for name, data in final.items():
+        assert reopened.read("c", name) == data  # cold device read
+    reopened.umount()
+
+
+def test_cache_hit_counters_tick():
+    st = BlockStore(config=make_cfg(blockstore_deferred_max_age_ms=0))
+    st.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", b"d" * 8192)
+    )
+    before = st.perf.dump()
+    assert st.read("c", "o") == b"d" * 8192  # write-through: buffer hit
+    after = st.perf.dump()
+    assert after["buffer_hit"] == before["buffer_hit"] + 1
+    st.drop_caches()
+    assert st.read("c", "o") == b"d" * 8192  # cold: miss + device read
+    d = st.perf.dump()
+    assert d["buffer_miss"] > before["buffer_miss"]
+    assert d["onode_miss"] >= 1
+    assert st.read("c", "o") == b"d" * 8192
+    assert st.perf.dump()["buffer_hit"] == after["buffer_hit"] + 1
+
+
+def test_buffer_cache_lru_evicts_by_bytes():
+    st = BlockStore(config=make_cfg(
+        blockstore_buffer_cache_bytes=20000,
+        blockstore_deferred_max_age_ms=0,
+    ))
+    st.queue_transaction(Transaction().create_collection("c"))
+    for i in range(5):  # 5 x 8KiB through a 20KB cache
+        st.queue_transaction(
+            Transaction().write("c", f"o{i}", bytes([i]) * 8192)
+        )
+    d = st.perf.dump()
+    assert d["buffer_bytes"] <= 20000
+    assert d["buffer_evict_bytes"] >= 8192 * 3 - 20000
+    for i in range(5):  # evicted or not, bytes must be right
+        assert st.read("c", f"o{i}") == bytes([i]) * 8192
+
+
+# -- deferred aging -----------------------------------------------------------
+
+def test_background_flusher_drains_backlog_by_age():
+    st = BlockStore(config=make_cfg(
+        blockstore_deferred_max_age_ms=40,
+        blockstore_deferred_batch_bytes=1 << 30,  # never by byte pressure
+    ))
+    st.queue_transaction(Transaction().create_collection("c"))
+    for i in range(4):
+        st.queue_transaction(
+            Transaction().write("c", f"s{i}", bytes([i + 1]) * 100)
+        )
+    assert st._flusher is not None and st._flusher.is_alive()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and list(st.db.iterate(_DEFER)):
+        time.sleep(0.02)
+    assert list(st.db.iterate(_DEFER)) == [], "aging flush never fired"
+    d = st.perf.dump()
+    assert d["deferred_flush_aged"] >= 1
+    assert d["deferred_flush_ops"] >= 1
+    assert d["deferred_bytes"] == 0 and d["deferred_ops"] == 0
+    assert d["l_flush"]["avgcount"] >= 1
+    for i in range(4):
+        assert st.read("c", f"s{i}") == bytes([i + 1]) * 100
+    assert st.fsck(deep=True) == []
+    st.umount()
+    assert st._flusher is None
+
+
+def test_explicit_tick_respects_max_age():
+    st = BlockStore(config=make_cfg(
+        blockstore_deferred_max_age_ms=10_000,
+        blockstore_deferred_batch_bytes=1 << 30,
+    ))
+    st.queue_transaction(
+        Transaction().create_collection("c").write("c", "s", b"x" * 64)
+    )
+    assert st.tick() == 0  # backlog too young
+    st._deferred_since -= 11.0  # age the queue past max_age
+    assert st.tick() == 1
+    assert st.perf.dump()["deferred_flush_aged"] == 1
+    assert list(st.db.iterate(_DEFER)) == []
+    assert st.read("c", "s") == b"x" * 64
+    st.umount()
+
+
+def test_readonly_close_keeps_backlog_and_never_flushes(tmp_path):
+    cfg = make_cfg(
+        blockstore_deferred_max_age_ms=60_000,  # flusher alive but idle
+        blockstore_deferred_batch_bytes=1 << 30,
+    )
+    st = BlockStore(FileDB(str(tmp_path / "s")), config=cfg)
+    st.queue_transaction(
+        Transaction().create_collection("c")
+        .write("c", "a", b"a" * 50).write("c", "b", b"b" * 60)
+    )
+    assert st._flusher is not None and st._flusher.is_alive()
+    flusher = st._flusher
+    st.close()  # read-only close: join the flusher, do NOT flush
+    assert not flusher.is_alive() and st._flusher is None
+
+    st2 = BlockStore(FileDB(str(tmp_path / "s")), config=cfg)
+    assert len(list(st2.db.iterate(_DEFER))) == 2  # backlog intact
+    assert st2.fsck(deep=True) == []  # inspection is clean...
+    assert st2._flusher is None  # ...and never spawned a flusher
+    assert st2.read("c", "a") == b"a" * 50  # served from the WAL row
+    st2.close()
+
+    st3 = BlockStore(FileDB(str(tmp_path / "s")), config=cfg)
+    st3.umount()  # real unmount: drains the backlog
+    st4 = BlockStore(FileDB(str(tmp_path / "s")), config=cfg)
+    assert list(st4.db.iterate(_DEFER)) == []
+    assert st4.read("c", "b") == b"b" * 60
+    assert st4.fsck(deep=True) == []
+    st4.umount()
+
+
+# -- vectored device IO -------------------------------------------------------
+
+class CountingDevice(MemBlockDevice):
+    def __init__(self):
+        super().__init__()
+        self.writev_calls = 0
+        self.pread_calls = 0
+        self.flush_calls = 0
+
+    def pwritev(self, off, buffers):
+        self.writev_calls += 1
+        super().pwritev(off, buffers)
+
+    def pread(self, off, length):
+        self.pread_calls += 1
+        return super().pread(off, length)
+
+    def flush(self):
+        self.flush_calls += 1
+
+
+def counting_store(**overrides) -> BlockStore:
+    st = BlockStore(config=uncached_cfg(**overrides))
+    st.device = CountingDevice()
+    return st
+
+
+def test_contiguous_write_and_read_are_single_device_calls():
+    st = counting_store()
+    st.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", b"Z" * 65536)
+    )
+    assert st.device.writev_calls == 1  # 16 extents' worth, one pwrite
+    st.read("c", "o")
+    assert st.device.pread_calls == 1
+    d = st.perf.dump()
+    assert d["dev_write_calls"] == 1
+    assert d["dev_read_calls"] == 1
+
+
+def test_fragmented_extents_coalesce_into_runs():
+    st = counting_store()
+    st.queue_transaction(Transaction().create_collection("c"))
+    for name in ("x1", "x2", "x3"):
+        st.queue_transaction(Transaction().write("c", name, b"f" * 4096))
+    st.queue_transaction(
+        Transaction().remove("c", "x1")
+    )
+    st.queue_transaction(Transaction().remove("c", "x3"))
+    # free = {0:4096, 8192:4096}; a 12KiB ask spans both fragments plus
+    # an end-of-device extension adjacent to the second fragment
+    w0 = st.device.writev_calls
+    st.queue_transaction(Transaction().write("c", "big", b"G" * 12288))
+    extents = [
+        (0, 4096), (8192, 4096), (12288, 4096),
+    ]
+    from tests.test_blockstore import onode_of
+
+    assert onode_of(st, "c", "big").extents == extents
+    assert st.device.writev_calls - w0 == 2  # (0,4k) + (8k..16k) runs
+    r0 = st.device.pread_calls
+    assert st.read("c", "big") == b"G" * 12288
+    assert st.device.pread_calls - r0 == 2
+    d = st.perf.dump()
+    assert d["dev_read_segments"] - d["dev_read_calls"] >= 1
+    assert st.fsck(deep=True) == []
+
+
+def test_deferred_flush_is_one_allocation_one_fsync():
+    st = counting_store(blockstore_deferred_batch_bytes=1 << 30)
+    st.queue_transaction(Transaction().create_collection("c"))
+    for i in range(8):
+        st.queue_transaction(
+            Transaction().write("c", f"s{i}", bytes([i + 1]) * 600)
+        )
+    f0 = st.device.flush_calls
+    w0 = st.device.writev_calls
+    assert st.flush_deferred() == 8
+    assert st.device.flush_calls - f0 == 1  # the whole batch: one fsync
+    # one allocator pass lands the batch contiguously: one vectored write
+    assert st.device.writev_calls - w0 == 1
+    for i in range(8):
+        assert st.read("c", f"s{i}") == bytes([i + 1]) * 600
+    assert st.fsck(deep=True) == []
+
+
+# -- allocator ----------------------------------------------------------------
+
+def test_allocator_prefers_contiguous_whole_fit():
+    a = ExtentAllocator(4096)
+    a.init({0: 4096, 8192: 8192}, 16384)
+    # old first-fit would shred the ask across (0,4096)+(8192,4096);
+    # whole-fit preference serves it in one extent
+    assert a.allocate(8192) == [(8192, 8192)]
+    assert a.allocate(4096) == [(0, 4096)]
+    assert a.free_bytes() == 0
+    # [4096, 8192) was never in the free map: it belongs to whoever
+    # held it before init — include it so the tiling check closes
+    assert a.check([(0, 4096), (4096, 4096), (8192, 8192)]) == []
+
+
+def test_allocate_many_tiles_one_pool():
+    a = ExtentAllocator(4096)
+    lists = a.allocate_many([100, 5000, 4096])
+    assert [sum(ln for _o, ln in ext) for ext in lists] == [
+        4096, 8192, 4096,
+    ]
+    flat = [e for ext in lists for e in ext]
+    assert a.check(flat) == []  # exact tiling, no overlap, no leak
+
+
+# -- crash consistency with an ACTIVE aging flusher ---------------------------
+
+_CHILD_AGED = r"""
+import sys
+sys.path.insert(0, sys.argv[2])
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.kv import FileDB
+from ceph_tpu.osd.blockstore import BlockStore
+from ceph_tpu.osd.objectstore import Transaction
+
+cfg = Config()
+cfg.set("blockstore_deferred_max_age_ms", 15)
+cfg.set("blockstore_deferred_batch_bytes", 1 << 30)  # aging flushes only
+st = BlockStore(FileDB(sys.argv[1]), config=cfg)
+st.queue_transaction(Transaction().create_collection("c"))
+i = 0
+while True:
+    i += 1
+    t = Transaction()
+    name = f"obj-{i % 24}"
+    size = 40 + (i * 131) % 3500  # all sub-min_alloc: every write defers
+    t.write("c", name, bytes([i % 251]) * size, attrs={"ver": i})
+    if i % 7 == 0:
+        t.remove("c", f"obj-{(i + 11) % 24}")
+    st.queue_transaction(t)
+    if i == 3:
+        print("warm", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_kill9_with_populated_queue_and_active_flusher(tmp_path):
+    """SIGKILL a writer whose deferred queue is being drained by the
+    background aging flusher: the reopened store must pass deep fsck
+    with zero errors (no lost or torn blobs) and every object must match
+    the ver its committing transaction stamped."""
+    path = str(tmp_path / "store")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_AGED, path, REPO_ROOT],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert b"warm" in line, proc.stderr.read().decode()
+        time.sleep(0.8)  # dozens of aged flushes race the write storm
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
+    st = BlockStore(FileDB(path), config=make_cfg())
+    assert st.fsck(deep=True) == []
+    names = st.list_objects("c")
+    assert names, "no object survived the write storm"
+    for name in names:
+        data = st.read("c", name)
+        ver = st.getattrs("c", name).get("ver")
+        assert ver is not None
+        assert data == bytes([ver % 251]) * len(data), (
+            f"{name}: content does not match the committed ver {ver}"
+        )
+    st.umount()

@@ -59,6 +59,11 @@ def test_deep_scrub_detects_and_repairs_blockstore_bitrot():
         on = Onode.decode(victim.store.db.get(_ONODE, _okey(coll, "o1")))
         assert on.extents, "8KiB object must live on the device"
         victim.store.device.buf[on.extents[0][0]] ^= 0xFF
+        # the victim's write-through buffer cache still holds the fresh
+        # bytes; drop it (the restart-equivalent) so a plain read sees
+        # the rot — deep scrub needs no such help: its fetches ride
+        # read_verify, which always reads device truth
+        victim.store.drop_caches()
         with pytest.raises(StoreError) as ei:
             victim.store.read(coll, "o1")
         assert ei.value.code == "EIO"
