@@ -127,6 +127,63 @@ def _store_bench_line() -> None:
         pass
 
 
+def _trace_overhead_line() -> None:
+    """Optional JSON line: daemon_bench throughput with the tracer
+    disabled vs enabled-at-rate-1. The disabled figure is the pre-PR
+    parity claim — a disabled span site is one cached flag check, so
+    disabled throughput must sit within noise (<2%) of the pre-PR
+    number (pass it via CEPH_TPU_TRACE_BASELINE_GBPS when the driver
+    has one recorded; the enabled/disabled delta is always reported).
+    Guarded (--trace-overhead / CEPH_TPU_BENCH_TRACE=1) and non-fatal."""
+    try:
+        import subprocess
+
+        from ceph_tpu.common.config import Config
+        from ceph_tpu.common.tracer import Tracer
+
+        # the disabled span-site cost itself, in ns/check
+        tracer = Tracer("bench", config=Config())
+        n = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tracer.child("site")
+        site_ns = (time.perf_counter() - t0) / n * 1e9
+
+        def run_bench(tracer_on: bool) -> float:
+            env = dict(os.environ)
+            env["CEPH_TPU_TRACER_ENABLED"] = (
+                "true" if tracer_on else "false"
+            )
+            env["CEPH_TPU_TRACER_SAMPLE_RATE"] = "1.0"
+            out = subprocess.run(
+                [sys.executable, "tools/daemon_bench.py", "--cpu",
+                 "--osds", "6", "--size", "65536", "--objects", "48",
+                 "--concurrency", "12"],
+                capture_output=True, timeout=600, env=env, check=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            return float(json.loads(out.stdout)["write_gbps"])
+
+        disabled = run_bench(False)
+        enabled = run_bench(True)
+        baseline = os.environ.get("CEPH_TPU_TRACE_BASELINE_GBPS")
+        line = {
+            "metric": "tracer_overhead",
+            "value": round(100 * (disabled - enabled) / disabled, 2),
+            "unit": "%",
+            "disabled_gbps": round(disabled, 3),
+            "enabled_gbps": round(enabled, 3),
+            "disabled_site_ns": round(site_ns, 1),
+        }
+        if baseline is not None:
+            drift = abs(disabled - float(baseline)) / float(baseline)
+            line["baseline_gbps"] = float(baseline)
+            line["within_noise"] = bool(drift < 0.02)
+        print(json.dumps(line))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def main() -> None:
     import jax
 
@@ -167,6 +224,10 @@ def main() -> None:
         "CEPH_TPU_BENCH_STORE"
     ):
         _store_bench_line()
+    if "--trace-overhead" in sys.argv[1:] or os.environ.get(
+        "CEPH_TPU_BENCH_TRACE"
+    ):
+        _trace_overhead_line()
 
 
 if __name__ == "__main__":

@@ -32,16 +32,25 @@ class TrackedOp:
     start: float = field(default_factory=time.time)
     events: list[tuple[float, str]] = field(default_factory=list)
     done: float | None = None
+    #: the op's tracer span when the request is sampled (TrackedOp and
+    #: the trace are two views of one op — dump_historic_ops shows the
+    #: span timeline, dump_tracing the cross-daemon tree)
+    span: Any = None
+    id: int = -1
+    #: slow-request warning already emitted for this op
+    warned: bool = False
 
     def mark_event(self, event: str) -> None:
         self.events.append((time.time(), event))
+        if self.span is not None:
+            self.span.log(event)
 
     @property
     def duration(self) -> float:
         return (self.done or time.time()) - self.start
 
     def dump(self) -> dict[str, Any]:
-        return {
+        out = {
             "description": self.description,
             "initiated_at": self.start,
             "age": self.duration,
@@ -49,19 +58,37 @@ class TrackedOp:
                 {"time": t, "event": e} for t, e in self.events
             ],
         }
+        if self.span is not None:
+            out["trace_id"] = self.span.trace_id
+            out["span"] = {
+                "span_id": self.span.span_id,
+                "name": self.span.name,
+                "duration": self.span.duration,
+                "events": [
+                    {"time": t, "event": e, "offset": t - self.span.start}
+                    for t, e in self.span.events
+                ],
+            }
+        return out
 
 
 class OpTracker:
-    def __init__(self, history_size: int = 20, slow_op_seconds: float = 30.0):
+    def __init__(self, history_size: int = 20,
+                 slow_op_seconds: float = 30.0, on_slow=None):
         self.history_size = history_size
         self.slow_op_seconds = slow_op_seconds
+        #: callback(op_id, op_dump) fired by check_slow() the first time
+        #: an op crosses slow_op_seconds (the "slow request" cluster-log
+        #: warning hook)
+        self.on_slow = on_slow
         self._in_flight: dict[int, TrackedOp] = {}
         self._history: deque[TrackedOp] = deque(maxlen=history_size)
         self._next_id = 0
 
-    def create(self, description: str) -> tuple[int, TrackedOp]:
-        op = TrackedOp(description)
+    def create(self, description: str, span=None) -> tuple[int, TrackedOp]:
+        op = TrackedOp(description, span=span)
         op_id = self._next_id
+        op.id = op_id
         self._next_id += 1
         self._in_flight[op_id] = op
         return op_id, op
@@ -72,9 +99,29 @@ class OpTracker:
             op.done = time.time()
             self._history.append(op)
 
-    def track(self, description: str) -> "_TrackCtx":
+    def track(self, description: str, span=None) -> "_TrackCtx":
         """Context manager tracking one op."""
-        return _TrackCtx(self, description)
+        return _TrackCtx(self, description, span)
+
+    def check_slow(self) -> list[tuple[int, dict]]:
+        """Scan in-flight ops for first-time slow_op_seconds crossings
+        (OpTracker::check_ops_in_flight): each newly-slow op is reported
+        ONCE — via on_slow and the returned list — the moment a periodic
+        check sees it, instead of waiting for someone to poll
+        dump_ops_in_flight."""
+        newly_slow = []
+        for op_id, op in self._in_flight.items():
+            if op.warned or op.duration < self.slow_op_seconds:
+                continue
+            op.warned = True
+            if op.span is not None:
+                op.span.log("slow_request")
+                op.span.set_tag("slow", True)
+            newly_slow.append((op_id, op.dump()))
+        if self.on_slow is not None:
+            for op_id, dump in newly_slow:
+                self.on_slow(op_id, dump)
+        return newly_slow
 
     def dump_ops_in_flight(self) -> dict[str, Any]:
         ops = [op.dump() for op in self._in_flight.values()]
@@ -89,14 +136,17 @@ class OpTracker:
 
 
 class _TrackCtx:
-    __slots__ = ("_tracker", "_description", "_op_id")
+    __slots__ = ("_tracker", "_description", "_span", "_op_id")
 
-    def __init__(self, tracker: OpTracker, description: str):
+    def __init__(self, tracker: OpTracker, description: str, span=None):
         self._tracker = tracker
         self._description = description
+        self._span = span
 
     def __enter__(self) -> TrackedOp:
-        self._op_id, op = self._tracker.create(self._description)
+        self._op_id, op = self._tracker.create(
+            self._description, span=self._span
+        )
         return op
 
     def __exit__(self, *exc):
@@ -107,11 +157,15 @@ class _TrackCtx:
 class AdminCommands:
     """Command-string -> handler table with the reference's built-ins."""
 
-    def __init__(self, perf=None, config=None, op_tracker: OpTracker | None = None):
+    def __init__(self, perf=None, config=None,
+                 op_tracker: OpTracker | None = None, tracer=None):
         self._perf = perf if perf is not None else global_perf
         self._config = config if config is not None else global_config
         self._tracker = op_tracker or OpTracker()
+        self._tracer = tracer
         self._handlers: dict[str, Callable[..., Any]] = {}
+        if tracer is not None:
+            self.register("dump_tracing", tracer.dump_tracing)
         self.register("perf dump", lambda: self._perf.dump())
         self.register("perf schema", lambda: self._perf.schema())
         self.register("config show", lambda: self._config.show())

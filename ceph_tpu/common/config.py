@@ -244,6 +244,27 @@ SCHEMA: dict[str, Option] = {
              "distinct reporters required to mark an OSD down (the "
              "reference's default; one stalled reporter must not be able "
              "to down a healthy daemon)"),
+        # distributed tracing (src/common/tracer: jaeger_tracing_enable
+        # and friends; see ceph_tpu.common.tracer)
+        _opt("tracer_enabled", TYPE_BOOL, LEVEL_ADVANCED, False,
+             "emit Dapper-style spans for sampled ops "
+             "(jaeger_tracing_enable role); disabled cost is one cached "
+             "flag check per span site",
+             see_also=("tracer_sample_rate", "tracer_export_path")),
+        _opt("tracer_sample_rate", TYPE_FLOAT, LEVEL_ADVANCED, 1.0,
+             "fraction of root ops that start a trace; children follow "
+             "the root's decision", min=0.0, max=1.0),
+        _opt("tracer_ring_size", TYPE_UINT, LEVEL_ADVANCED, 1024,
+             "completed spans retained per daemon for `dump_tracing`",
+             min=1),
+        _opt("tracer_export_path", TYPE_STR, LEVEL_ADVANCED, "",
+             "append finished spans as Jaeger-compatible JSONL here "
+             "(tools/trace_tool.py renders trace trees from it); empty "
+             "disables export"),
+        _opt("slow_op_seconds", TYPE_FLOAT, LEVEL_ADVANCED, 30.0,
+             "in-flight op age that triggers an immediate `slow "
+             "request` warning line (osd_op_complaint_time role)",
+             min=0.0),
         # bench / profiling
         _opt("bench_profile_trace_dir", TYPE_STR, LEVEL_DEV, "",
              "write jax.profiler traces here when set",

@@ -25,6 +25,7 @@ from collections import deque
 from typing import Callable
 
 from ceph_tpu.common.config import Config, ConfigError, config as global_config
+from ceph_tpu.common.tracer import current_trace_id
 
 #: default emitted level 1 / gathered (ring) level 5, like the reference's
 #: "1/5"-style subsys defaults (src/common/subsys.h)
@@ -63,6 +64,12 @@ class Logger:
             return None
 
         def sink(message: str) -> None:
+            # correlate with dump_tracing: lines logged inside a traced
+            # op carry its id (the reference prefixes lttng/jaeger ids
+            # the same way); one contextvar read per EMITTED line only
+            tid = current_trace_id()
+            if tid is not None:
+                message = f"trace={tid} {message}"
             record = (time.time(), self.subsys, level, message)
             if gather:
                 self._ring.append(record)

@@ -1,0 +1,378 @@
+"""Distributed tracing — the reference's src/common/tracer + blkin role.
+
+A Dapper-style tracer (Sigelman et al. 2010): every sampled request gets
+a trace id; each timed unit of work is a span (span_id, parent_id) with
+tags and timestamped events; the (trace_id, span_id, sampled) context
+travels across daemons as an optional field on the wire `Message`, so a
+span started in the Rados client continues through the messenger, the
+OSD op queue, the encode service, and the object store, and forks a
+child span per replica/EC-shard sub-op — the same shape Ceph gets from
+jaeger-tracing wired through ProtocolV2 (src/common/tracer.h,
+src/msg/async/ProtocolV2.cc encode_trace).
+
+Pieces:
+
+  * `SpanContext` — the wire form, one compact string
+    "<trace_id>:<span_id>:<flags>" (flags bit0 = sampled), carried by
+    `Message.trace` (msg/frames.py).
+  * `Span` — timed unit with tags + events; `finish()` lands it in the
+    tracer's bounded completed-span ring, feeds a per-span-name
+    PerfCounters latency histogram (picked up by `perf dump` and the
+    Prometheus exporter), and appends one Jaeger-compatible JSON line
+    to `tracer_export_path` when set (tools/trace_tool.py renders it).
+  * `Tracer` — per-daemon factory. Config knobs (central schema):
+    `tracer_enabled`, `tracer_sample_rate`, `tracer_ring_size`,
+    `tracer_export_path`; all observed at runtime like debug levels.
+
+Cost discipline (the dout-gate idiom, common/log.py): the enabled flag
+is CACHED and checked first in every factory method, so a disabled
+tracer costs one flag check per span site and allocates nothing:
+
+    if (sp := tracer.child("blockstore_read")) is not None:
+        sp.set_tag("cache", "hit")
+        sp.finish()
+
+The task-local current context (`use`/`use_wire`) rides a contextvar so
+awaits and `create_task` propagate it without plumbing; `child()`
+returns None when no sampled context is active — interior span sites
+never start traces of their own.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import random
+import time
+from collections import deque
+from typing import Any
+
+from ceph_tpu.common.config import Config, ConfigError
+from ceph_tpu.common.config import config as global_config
+from ceph_tpu.common.perf_counters import PerfCounters
+
+#: the active span context for the op executing in this task/thread
+_current: "contextvars.ContextVar[SpanContext | None]" = (
+    contextvars.ContextVar("ceph_tracer_ctx", default=None)
+)
+
+
+def current_context() -> "SpanContext | None":
+    return _current.get()
+
+
+def current_trace_id() -> str | None:
+    """Trace id of the active context, for log correlation (the
+    `trace=<id>` dout prefix); None when untraced."""
+    ctx = _current.get()
+    return None if ctx is None else ctx.trace_id
+
+
+class SpanContext:
+    """What propagates: ids + the sampled decision, never payload."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def encode(self) -> str:
+        return f"{self.trace_id}:{self.span_id}:{1 if self.sampled else 0}"
+
+    @staticmethod
+    def decode(raw: str | None) -> "SpanContext | None":
+        if not raw:
+            return None
+        parts = raw.split(":")
+        if len(parts) != 3 or not parts[0] or not parts[1]:
+            return None
+        return SpanContext(parts[0], parts[1], parts[2] == "1")
+
+
+class Span:
+    __slots__ = (
+        "_tracer", "trace_id", "span_id", "parent_id", "name",
+        "service", "start", "end", "tags", "events",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 span_id: str, parent_id: str | None,
+                 tags: dict | None, start: float | None):
+        self._tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.service = tracer.service
+        self.start = time.time() if start is None else start
+        self.end: float | None = None
+        self.tags: dict[str, Any] = dict(tags) if tags else {}
+        self.events: list[tuple[float, str]] = []
+
+    # -- recording ------------------------------------------------------------
+
+    def log(self, event: str) -> None:
+        self.events.append((time.time(), event))
+
+    def set_tag(self, key: str, value: Any) -> None:
+        self.tags[key] = value
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id, True)
+
+    @property
+    def duration(self) -> float:
+        return (self.end if self.end is not None else time.time()) - self.start
+
+    def finish(self) -> None:
+        """Close the span (idempotent): ring + perf histogram + export."""
+        if self.end is not None:
+            return
+        self.end = time.time()
+        self._tracer._finished(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.finish()
+        return False
+
+    # -- serialization --------------------------------------------------------
+
+    def dump(self) -> dict:
+        """The admin-surface (`dump_tracing`) form."""
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "service": self.service,
+            "start": self.start,
+            "duration": self.duration,
+            "tags": {k: _jsonable(v) for k, v in self.tags.items()},
+            "events": [
+                {"ts": ts, "event": ev} for ts, ev in self.events
+            ],
+        }
+
+    def to_jaeger(self) -> dict:
+        """One span in Jaeger JSON (the jaeger-ui import format; µs)."""
+        refs = []
+        if self.parent_id:
+            refs.append({
+                "refType": "CHILD_OF",
+                "traceID": self.trace_id,
+                "spanID": self.parent_id,
+            })
+        return {
+            "traceID": self.trace_id,
+            "spanID": self.span_id,
+            "operationName": self.name,
+            "references": refs,
+            "startTime": int(self.start * 1e6),
+            "duration": int(self.duration * 1e6),
+            "tags": [
+                {"key": k, "type": "string", "value": str(v)}
+                for k, v in self.tags.items()
+            ],
+            "logs": [
+                {"timestamp": int(ts * 1e6),
+                 "fields": [{"key": "event", "value": ev}]}
+                for ts, ev in self.events
+            ],
+            "process": {"serviceName": self.service, "tags": []},
+        }
+
+
+def _jsonable(v):
+    return v if isinstance(v, (str, int, float, bool, type(None))) else str(v)
+
+
+class Tracer:
+    """Per-daemon span factory + bounded completed-span ring."""
+
+    def __init__(self, service: str, config: Config | None = None):
+        self.service = service
+        cfg = config if config is not None else global_config
+        self._rng = random.Random()
+        self._on = False
+        self._rate = 1.0
+        self._export_path = ""
+        ring_size = 1024
+        try:
+            self._on = bool(cfg.get("tracer_enabled"))
+            self._rate = float(cfg.get("tracer_sample_rate"))
+            self._export_path = cfg.get("tracer_export_path")
+            ring_size = int(cfg.get("tracer_ring_size"))
+            cfg.observe("tracer_enabled", self._on_enabled)
+            cfg.observe("tracer_sample_rate", self._on_rate)
+            cfg.observe("tracer_export_path", self._on_export)
+            cfg.observe("tracer_ring_size", self._on_ring)
+        except ConfigError:
+            pass  # custom schema without tracer options: stay disabled
+        self._ring: deque[dict] = deque(maxlen=max(1, ring_size))
+        #: span latency histograms (lat_us_<name>), adopted into the
+        #: daemon's PerfCountersCollection so `perf dump` and the
+        #: Prometheus exporter surface span timings as metrics
+        self.perf = PerfCounters("tracer")
+        self._export_fh = None
+
+    # -- config observers (cached-flag refresh, the dout-gate idiom) ----------
+
+    def _on_enabled(self, _n, v) -> None:
+        self._on = bool(v)
+
+    def _on_rate(self, _n, v) -> None:
+        self._rate = float(v)
+
+    def _on_export(self, _n, v) -> None:
+        if self._export_fh is not None:
+            try:
+                self._export_fh.close()
+            except OSError:
+                pass
+            self._export_fh = None
+        self._export_path = v
+
+    def _on_ring(self, _n, v) -> None:
+        self._ring = deque(self._ring, maxlen=max(1, int(v)))
+
+    @property
+    def enabled(self) -> bool:
+        return self._on
+
+    # -- span factories -------------------------------------------------------
+
+    def start(self, name: str, tags: dict | None = None,
+              start: float | None = None) -> Span | None:
+        """Root span: begins a NEW trace, subject to the sample rate.
+        None when disabled or not sampled — the whole trace then costs
+        nothing anywhere downstream (the context never propagates)."""
+        if not self._on:
+            return None
+        if self._rng.random() >= self._rate:
+            return None
+        trace_id = f"{self._rng.getrandbits(64):016x}"
+        return Span(self, name, trace_id, self._new_id(), None, tags, start)
+
+    def child(self, name: str, tags: dict | None = None,
+              start: float | None = None) -> Span | None:
+        """Child of the task-local current context; None when disabled
+        or untraced — interior sites never originate traces."""
+        if not self._on:
+            return None
+        ctx = _current.get()
+        if ctx is None or not ctx.sampled:
+            return None
+        return Span(self, name, ctx.trace_id, self._new_id(),
+                    ctx.span_id, tags, start)
+
+    def join(self, wire: str | None, name: str, tags: dict | None = None,
+             start: float | None = None) -> Span | None:
+        """Continue a trace arriving over the wire (`Message.trace`)."""
+        if not self._on:
+            return None
+        ctx = SpanContext.decode(wire)
+        if ctx is None or not ctx.sampled:
+            return None
+        return Span(self, name, ctx.trace_id, self._new_id(),
+                    ctx.span_id, tags, start)
+
+    def _new_id(self) -> str:
+        return f"{self._rng.getrandbits(64):016x}"
+
+    # -- current-context plumbing ---------------------------------------------
+
+    def use(self, span: Span):
+        """Make `span` the task-local parent for child()/fork sites;
+        returns a token for release()."""
+        return _current.set(span.context())
+
+    def use_wire(self, wire: str | None):
+        """Adopt a wire context as the task-local parent (sub-op
+        handlers: their spans hang off the sender's fork span). Returns
+        a token, or None when there is nothing to adopt."""
+        if not self._on:
+            return None
+        ctx = SpanContext.decode(wire)
+        if ctx is None or not ctx.sampled:
+            return None
+        return _current.set(ctx)
+
+    def release(self, token) -> None:
+        if token is not None:
+            _current.reset(token)
+
+    # -- completion / ring / export -------------------------------------------
+
+    def _finished(self, span: Span) -> None:
+        self._ring.append(span.dump())
+        key = "lat_us_" + "".join(
+            c if c.isalnum() else "_" for c in span.name
+        )
+        if key not in self.perf._counters:
+            self.perf.add_histogram(
+                key, f"span {span.name!r} latency (µs, log2 buckets)"
+            )
+        self.perf.hinc(key, max(1, int(span.duration * 1e6)))
+        if self._export_path:
+            self._export_jsonl(span)
+
+    def _export_jsonl(self, span: Span) -> None:
+        try:
+            if self._export_fh is None:
+                # O_APPEND: many daemons may share one collector file
+                self._export_fh = open(self._export_path, "a")
+            self._export_fh.write(json.dumps(span.to_jaeger()) + "\n")
+            self._export_fh.flush()
+        except OSError:
+            self._export_path = ""  # unwritable path: disable, not crash
+
+    def adopt(self, spans: list[dict]) -> None:
+        """Accept foreign finished spans into the ring — the Jaeger
+        collector role: clients report their half of a trace to the
+        primary OSD so `dump_tracing` there holds the complete tree."""
+        if not self._on:
+            return
+        for s in spans:
+            if isinstance(s, dict) and "trace_id" in s and "span_id" in s:
+                self._ring.append(s)
+
+    def spans_of(self, trace_id: str) -> list[dict]:
+        return [s for s in self._ring if s["trace_id"] == trace_id]
+
+    def dump_tracing(self, drain: bool = True) -> dict:
+        """The `dump_tracing` admin command: completed spans grouped by
+        trace, oldest span first within each; drains the ring."""
+        spans = list(self._ring)
+        if drain:
+            self._ring.clear()
+        traces: dict[str, list[dict]] = {}
+        for s in spans:
+            traces.setdefault(s["trace_id"], []).append(s)
+        return {
+            "num_traces": len(traces),
+            "num_spans": len(spans),
+            "traces": [
+                {"trace_id": tid,
+                 "spans": sorted(ss, key=lambda s: s["start"])}
+                for tid, ss in traces.items()
+            ],
+        }
+
+    def close(self) -> None:
+        if self._export_fh is not None:
+            try:
+                self._export_fh.close()
+            except OSError:
+                pass
+            self._export_fh = None
+
+
+#: export path env override helper for tools; kept trivial on purpose
+def default_tracer(service: str) -> Tracer:
+    return Tracer(service)

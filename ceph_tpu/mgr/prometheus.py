@@ -6,6 +6,13 @@ serves /metrics. Same shape here: per-daemon `perf dump` over the admin
 surface + OSDMap gauges, rendered as `# TYPE` + labeled samples — a
 text-format dump any Prometheus scraper (or the `ceph prometheus` CLI)
 can consume.
+
+Counter-type mapping (the module's _perfvalue/_perfhistogram split):
+TIME_AVG (avgcount/sum pairs) render as `<name>_sum`/`<name>_count`
+sample pairs, HISTOGRAM (log2 bucket counts) as CUMULATIVE
+`<name>_bucket{le="..."}` series plus `_count` — so rate() and
+histogram_quantile() work on them, instead of flat gauges that lose the
+distribution.
 """
 
 from __future__ import annotations
@@ -13,6 +20,40 @@ from __future__ import annotations
 
 def _sanitize(name: str) -> str:
     return "".join(c if c.isalnum() else "_" for c in name)
+
+
+def render_perf_value(emit, key: str, value, labels: dict) -> None:
+    """Render one perf-dump counter as Prometheus samples via
+    `emit(metric_name, value, labels, type, type_name=None)`.
+
+    Plain ints/floats -> one counter sample. TIME_AVG dicts
+    ({avgcount, sum}) -> `_sum` + `_count`. HISTOGRAM dicts (power-of-2
+    lower bound -> count) -> cumulative `_bucket{le=...}` + `+Inf` +
+    `_count`, the native Prometheus histogram convention."""
+    if isinstance(value, dict):
+        if "avgcount" in value and "sum" in value:
+            emit(f"{key}_sum", value["sum"], labels, "counter")
+            emit(f"{key}_count", value["avgcount"], labels, "counter")
+            return
+        try:
+            bounds = sorted((int(b), n) for b, n in value.items())
+        except (TypeError, ValueError):
+            return  # not a perf histogram shape; skip
+        total = 0
+        for lower, n in bounds:
+            total += n
+            # bucket holds values in [2^b, 2^(b+1)); le is inclusive,
+            # so the upper edge for integer samples is 2^(b+1) - 1
+            emit(f"{key}_bucket", total,
+                 {**labels, "le": str(2 * lower - 1)},
+                 "histogram", type_name=key)
+        emit(f"{key}_bucket", total, {**labels, "le": "+Inf"},
+             "histogram", type_name=key)
+        emit(f"{key}_count", total, labels, "histogram",
+             type_name=key)
+        return
+    if isinstance(value, (int, float)):
+        emit(key, value, labels, "counter")
 
 
 class PrometheusExporter:
@@ -24,13 +65,22 @@ class PrometheusExporter:
     async def collect(self) -> str:
         osdmap = self.objecter.osdmap
         lines: list[str] = []
+        #: metric name -> already emitted a # TYPE line (the old scan
+        #: over `lines` was O(n²) across a large perf dump)
+        typed: set[str] = set()
 
         def gauge(name: str, value, labels: dict | None = None,
-                  mtype: str = "gauge") -> None:
+                  mtype: str = "gauge", type_name: str | None = None) -> None:
             full = f"{self.PREFIX}_{_sanitize(name)}"
-            if not any(line.startswith(f"# TYPE {full} ")
-                       for line in lines):
-                lines.append(f"# TYPE {full} {mtype}")
+            # TYPE is declared once per metric FAMILY: histogram series
+            # (_bucket/_count) share their base name's declaration
+            tname = (
+                f"{self.PREFIX}_{_sanitize(type_name)}"
+                if type_name is not None else full
+            )
+            if tname not in typed:
+                typed.add(tname)
+                lines.append(f"# TYPE {tname} {mtype}")
             lab = ""
             if labels:
                 inner = ",".join(
@@ -69,7 +119,8 @@ class PrometheusExporter:
             gauge("pool_pg_num", pool.pg_num, {"pool": pid})
             gauge("pool_size", pool.size, {"pool": pid})
 
-        # per-daemon perf counters
+        # per-daemon perf counters (TIME_AVG/HISTOGRAM expanded into
+        # their native Prometheus representations)
         for osd in range(osdmap.max_osd):
             if osdmap.is_down(osd):
                 continue
@@ -81,12 +132,12 @@ class PrometheusExporter:
                 continue
             for logger, counters in sorted(dump.items()):
                 for key, value in sorted(counters.items()):
-                    v = value.get("value") if isinstance(
-                        value, dict
-                    ) else value
-                    if isinstance(v, (int, float)):
-                        gauge(
-                            f"daemon_{key}", v,
-                            {"daemon": logger}, mtype="counter",
-                        )
+                    render_perf_value(
+                        lambda n, v, lab, t, type_name=None: gauge(
+                            f"daemon_{n}", v, lab, t,
+                            type_name=(None if type_name is None
+                                       else f"daemon_{type_name}"),
+                        ),
+                        key, value, {"daemon": logger},
+                    )
         return "\n".join(lines) + "\n"

@@ -130,12 +130,16 @@ class Message:
     #: free, halving frame count (each frame is a context switch when
     #: daemons are separate processes)
     ack: int = 0
+    #: optional trace context "trace_id:span_id:flags" (the reference
+    #: encodes a jaeger trace context into ProtocolV2 message frames the
+    #: same way); empty = op is untraced, zero downstream cost
+    trace: str = ""
 
     def encode(self) -> bytes:
         return (
             Encoder()
             .struct(
-                3,
+                4,
                 1,
                 lambda b: b.string(self.type)
                 .u64(self.tid)
@@ -143,7 +147,8 @@ class Message:
                 .u64(self.epoch)
                 .blob(self.data)
                 .blob(self.raw)
-                .u64(self.ack),
+                .u64(self.ack)
+                .string(self.trace),
             )
             .bytes()
         )
@@ -159,6 +164,7 @@ class Message:
                 data=b.blob(),
                 raw=b.blob() if version >= 2 else b"",
                 ack=b.u64() if version >= 3 else 0,
+                trace=b.string() if version >= 4 else "",
             )
 
         return Decoder(raw).struct(1, body)

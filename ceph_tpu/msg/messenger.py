@@ -209,6 +209,16 @@ class Connection:
         """Queue a message; never blocks (AsyncConnection::send_message)."""
         if self._closed:
             return
+        tracer = self.messenger.tracer
+        if tracer is not None and msg.trace:
+            # send-side messenger span: queue wait + encode, finished by
+            # _encode_msg_frame when the frame actually hits the stream
+            sp = tracer.join(
+                msg.trace, "msg_send",
+                tags={"type": msg.type, "from": self.messenger.name},
+            )
+            if sp is not None:
+                msg._send_span = sp
         self.out_seq += 1
         msg.seq = self.out_seq
         if not self.policy.lossy:
@@ -445,6 +455,10 @@ class Connection:
     def _encode_msg_frame(self, msg: Message) -> Frame:
         """MESSAGE frame, compressed above the configured floor (the
         msgr2 compression mode via the compressor registry)."""
+        sp = getattr(msg, "_send_span", None)
+        if sp is not None:
+            sp.finish()
+            msg._send_span = None  # lossless replays re-encode; once only
         if not self.policy.lossy and self._ack_owed > self._ack_sent:
             msg.ack = self._ack_owed
             self._ack_sent = self._ack_owed
@@ -517,11 +531,21 @@ class Connection:
                         continue
                     m._peer_in_seq[key] = msg.seq
                 size = max(1, len(msg.data))
+                # receive-side messenger span: throttle wait + handler
+                # (fast-dispatch leg); only traced messages pay anything
+                dsp = None
+                if m.tracer is not None and msg.trace:
+                    dsp = m.tracer.join(
+                        msg.trace, "msg_dispatch",
+                        tags={"type": msg.type, "at": m.name},
+                    )
                 await m.dispatch_throttle.get(size)
                 try:
                     await _call(m.dispatcher.ms_dispatch, self, msg)
                 finally:
                     await m.dispatch_throttle.put(size)
+                    if dsp is not None:
+                        dsp.finish()
             elif frame.tag == Tag.ACK:
                 self._apply_peer_ack(Decoder(frame.payload).u64())
             elif frame.tag == Tag.KEEPALIVE:
@@ -554,6 +578,10 @@ class Messenger:
         self.name = name
         self.config = config if config is not None else Config()
         self.keyring = keyring
+        #: optional distributed tracer (common/tracer): when set, traced
+        #: messages get msg_send/msg_dispatch spans; untraced messages
+        #: cost one `msg.trace` truthiness check per hop
+        self.tracer = None
         self.dispatcher: Dispatcher = Dispatcher()
         self.dispatch_throttle = AsyncThrottle(dispatch_throttle_bytes)
         self._server: asyncio.base_events.Server | None = None

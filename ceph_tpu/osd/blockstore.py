@@ -255,6 +255,8 @@ class BlockStore(KStore):
     so transaction compilation may re-enter `read`).
     """
 
+    KIND = "blockstore"
+
     def __init__(self, db: KeyValueDB | None = None, config=None,
                  block_path: str | None = None):
         super().__init__(db)
@@ -318,6 +320,10 @@ class BlockStore(KStore):
         self._pending_release: list[tuple[int, int]] = []
         self._batch_allocs: list[tuple[int, int]] = []
         self._batch_drops: set[bytes] = set()
+        self._batch_deferred_n = 0
+        self._batch_big_n = 0
+        self._last_deferred_n = 0
+        self._last_big_n = 0
         self._mount(geom is None)
 
     def _make_perf(self) -> PerfCounters:
@@ -457,14 +463,28 @@ class BlockStore(KStore):
     # -- transaction compilation ----------------------------------------------
 
     def queue_transaction(self, txn) -> None:
-        with self._lock:
-            super().queue_transaction(txn)
+        sp = None if self.tracer is None else self.tracer.child(
+            "blockstore_txn", tags={"ops": len(txn.ops)}
+        )
+        try:
+            with self._lock:
+                super().queue_transaction(txn)
+                if sp is not None:
+                    # write-path classification of the batch just
+                    # committed (deferred = rode the KV WAL)
+                    sp.set_tag("deferred", self._last_deferred_n)
+                    sp.set_tag("big", self._last_big_n)
+        finally:
+            if sp is not None:
+                sp.finish()
 
     def _begin_batch(self) -> None:
         self._staged = {}
         self._pending_release = []
         self._batch_allocs = []
         self._batch_drops = set()
+        self._batch_deferred_n = 0
+        self._batch_big_n = 0
 
     def _abort_batch(self) -> None:
         # compile failed before the commit point: hand batch allocations
@@ -506,6 +526,8 @@ class BlockStore(KStore):
         else:
             self._deferred_since = None
         self._sync_gauges()
+        self._last_deferred_n = self._batch_deferred_n
+        self._last_big_n = self._batch_big_n
         self._begin_batch()
         if self._deferred_bytes > self.deferred_batch_bytes:
             self.flush_deferred()
@@ -598,11 +620,13 @@ class BlockStore(KStore):
             kv.set(_DEFER, key, payload)
             self._deferred_bytes += len(payload)
             self._deferred_ops += 1
+            self._batch_deferred_n += 1
             self.perf.inc("write_deferred")
         elif payload:
             on.extents = self.alloc.allocate(len(payload))
             self._batch_allocs.extend(on.extents)
             self._write_extents(on.extents, payload)
+            self._batch_big_n += 1
             self.perf.inc("write_big")
         kv.set(_ONODE, key, on.encode())
         self._staged[key] = (on, data)
@@ -730,6 +754,18 @@ class BlockStore(KStore):
         then ONE KV batch repoints the onodes and drops the WAL rows.
         Crash-safe at any point — until that batch commits, the _DEFER
         rows remain authoritative. Returns the number of payloads moved."""
+        # flushes are their own (root) traces: they run from the aging
+        # thread or byte pressure, not inside any one client op
+        sp = None if self.tracer is None else self.tracer.start(
+            "blockstore_flush", tags={"deferred": True}
+        )
+        try:
+            return self._flush_deferred_inner(sp)
+        finally:
+            if sp is not None:
+                sp.finish()
+
+    def _flush_deferred_inner(self, sp) -> int:
         with self._lock:
             t0 = time.perf_counter()
             rows = [(k[1], v) for k, v in self.db.iterate(_DEFER)]
@@ -774,6 +810,8 @@ class BlockStore(KStore):
             self.perf.inc("deferred_flush")
             self.perf.inc("deferred_flush_ops", len(moved))
             self.perf.tinc("l_flush", time.perf_counter() - t0)
+            if sp is not None:
+                sp.set_tag("ops", len(moved))
             self._sync_gauges()
             return len(moved)
 
@@ -819,23 +857,41 @@ class BlockStore(KStore):
             return self.db.get(_ONODE, key) is not None
 
     def read(self, coll: str, name: str) -> bytes:
-        with self._lock:
-            key = _okey(coll, name)
-            data = self._buffer_cache.get(key)
-            if data is not None:
-                self._buffer_cache.move_to_end(key)
-                self.perf.inc("buffer_hit")
-                return data
-            self.perf.inc("buffer_miss")
-            return self._read_cold(coll, name, key)
+        sp = None if self.tracer is None else self.tracer.child(
+            "blockstore_read"
+        )
+        try:
+            with self._lock:
+                key = _okey(coll, name)
+                data = self._buffer_cache.get(key)
+                if data is not None:
+                    self._buffer_cache.move_to_end(key)
+                    self.perf.inc("buffer_hit")
+                    if sp is not None:
+                        sp.set_tag("cache", "hit")
+                    return data
+                self.perf.inc("buffer_miss")
+                if sp is not None:
+                    sp.set_tag("cache", "miss")
+                return self._read_cold(coll, name, key)
+        finally:
+            if sp is not None:
+                sp.finish()
 
     def read_verify(self, coll: str, name: str) -> bytes:
         """Read device truth: bypass the buffer cache, re-run the stored
         checksum verification, and refresh the cache with the verified
         bytes. Deep scrub reads through this so cached data can never
         mask at-rest corruption."""
-        with self._lock:
-            return self._read_cold(coll, name, _okey(coll, name))
+        sp = None if self.tracer is None else self.tracer.child(
+            "blockstore_read", tags={"verify": True, "cache": "bypass"}
+        )
+        try:
+            with self._lock:
+                return self._read_cold(coll, name, _okey(coll, name))
+        finally:
+            if sp is not None:
+                sp.finish()
 
     def _read_cold(self, coll: str, name: str, key: bytes) -> bytes:
         on = self._get_onode(key)

@@ -382,9 +382,16 @@ class OSDService(Dispatcher):
         # blockstore` opts into the allocator/at-rest-checksum store
         # (its block file lands beside a FileDB's WAL)
         self.store = create_store(db, self.config)
+        # distributed tracer (common/tracer): spans at every layer of
+        # the op path; disabled cost is one cached-flag check per site
+        from ceph_tpu.common.tracer import Tracer
+
+        self.tracer = Tracer(self.name, config=self.config)
+        self.store.tracer = self.tracer
         self.messenger = Messenger(
             self.name, config=self.config, keyring=keyring
         )
+        self.messenger.tracer = self.tracer
         self.messenger.dispatcher = self
         # MonClient chains itself in front of us on the shared messenger
         self.mon = MonClient(
@@ -398,7 +405,8 @@ class OSDService(Dispatcher):
         from ceph_tpu.osd.encode_service import EncodeService
 
         self.encode_service = EncodeService(
-            window=self.config.get("osd_ec_batch_window")
+            window=self.config.get("osd_ec_batch_window"),
+            tracer=self.tracer,
         )
         # per-daemon perf counters, dumped via the admin surface the way
         # `ceph daemon osd.N perf dump` reads the admin socket
@@ -412,6 +420,9 @@ class OSDService(Dispatcher):
         store_perf = getattr(self.store, "perf", None)
         if store_perf is not None:
             self.perf_collection.add(store_perf)
+        # span latency histograms land beside the op counters, so the
+        # Prometheus exporter scrapes trace timings as metrics
+        self.perf_collection.add(self.tracer.perf)
         for key, desc in (
             ("op_w", "client writes served as primary"),
             ("op_w_partial", "EC writes served via sub-stripe RMW"),
@@ -465,7 +476,9 @@ class OSDService(Dispatcher):
         # per-op event timeline ("slow request" reporting, TrackedOp.h)
         from ceph_tpu.common.admin import OpTracker
 
-        self.op_tracker = OpTracker()
+        self.op_tracker = OpTracker(
+            slow_op_seconds=self.config.get("slow_op_seconds")
+        )
         #: (pool, ps) -> error count from the last deep scrub of that PG
         #: (primary-side); feeds the PG_DAMAGED health check and clears
         #: when a rescrub comes back clean
@@ -555,6 +568,7 @@ class OSDService(Dispatcher):
             d(f"osd.{self.id} booted at {self.messenger.my_addr}, "
               f"epoch {self.osdmap.epoch}")
         self._tasks.append(asyncio.create_task(self._loop_lag_watchdog()))
+        self._tasks.append(asyncio.create_task(self._slow_op_loop()))
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._peering_loop()))
         self._tasks.append(asyncio.create_task(self._resub_loop()))
@@ -617,6 +631,30 @@ class OSDService(Dispatcher):
             "mon_osd_full_ratio"
         )
 
+    async def _slow_op_loop(self) -> None:
+        """Warn the MOMENT an op crosses slow_op_seconds (the reference's
+        op_tracker check_ops_in_flight -> cluster-log "slow request"
+        lines, OSD.cc tick path) — slow ops must not stay invisible
+        until someone polls dump_ops_in_flight. One line per op."""
+        interval = min(
+            1.0, max(0.05, self.op_tracker.slow_op_seconds / 4)
+        )
+        while not self._stopped:
+            await asyncio.sleep(interval)
+            for op_id, dump in self.op_tracker.check_slow():
+                if (d := self.dlog.dout(0)) is not None:
+                    last = (
+                        dump["events"][-1]["event"]
+                        if dump["events"] else "none"
+                    )
+                    tr = dump.get("trace_id")
+                    d(
+                        f"slow request: op {op_id} "
+                        f"({dump['description']}) blocked for "
+                        f"{dump['age']:.3f}s, last event: {last}"
+                        + (f" trace={tr}" if tr else "")
+                    )
+
     async def _loop_lag_watchdog(self) -> None:
         """Samples how late a 10ms sleep fires: the single cheapest
         signal for 'something blocked the event loop' (jax dispatch, a
@@ -654,6 +692,7 @@ class OSDService(Dispatcher):
             except Exception:  # noqa: BLE001 - shutdown must not throw
                 if (d := self.dlog.dout(1)) is not None:
                     d(f"osd.{self.id}: store umount failed at stop")
+        self.tracer.close()
 
     # -- placement helpers ----------------------------------------------------
 
@@ -719,17 +758,27 @@ class OSDService(Dispatcher):
         if trace_id is not None:
             payload["trace_id"] = trace_id
             self._trace(trace_id, f"{msg_type} -> osd.{osd}")
+        # fork a child span per sub-op (the per-replica/EC-shard leg):
+        # covers send -> peer apply -> ack, and its context rides the
+        # Message so the peer's spans hang off it
+        sp = self.tracer.child(
+            f"subop_{msg_type}", tags={"to": f"osd.{osd}"}
+        )
         fut = asyncio.get_event_loop().create_future()
         self._waiters[tid] = fut
         try:
             self._osd_conn(osd).send_message(
                 Message(type=msg_type, tid=tid,
                         epoch=self.osdmap.epoch,
-                        data=json.dumps(payload).encode(), raw=raw)
+                        data=json.dumps(payload).encode(), raw=raw,
+                        trace="" if sp is None
+                        else sp.context().encode())
             )
             return await asyncio.wait_for(fut, timeout)
         finally:
             self._waiters.pop(tid, None)
+            if sp is not None:
+                sp.finish()
 
     def _reply_peer(
         self, conn, tid: int, payload: dict, raw: bytes = b""
@@ -747,6 +796,8 @@ class OSDService(Dispatcher):
     async def ms_dispatch(self, conn, msg: Message) -> None:
         p = json.loads(msg.data) if msg.data else {}
         p["_raw"] = msg.raw  # the bulk data segment, bytes verbatim
+        if msg.trace:
+            p["_trace"] = msg.trace  # span context rides to the handler
         if msg.type == "sub_reply":
             fut = self._waiters.get(p.get("tid"))
             if fut is not None and not fut.done():
@@ -2524,6 +2575,9 @@ class OSDService(Dispatcher):
         if "_sent_at" in p:
             self.perf.tinc("l_subop_transit", time.time() - p["_sent_at"])
         p["_queued_at"] = time.time()
+        qs = self.tracer.join(p.get("_trace"), "op_queue")
+        if qs is not None:
+            p["_qspan"] = qs
         pg.subop_q.put_nowait((fn, conn, p))
 
     async def _subop_worker(self, pg: PG) -> None:
@@ -2533,12 +2587,20 @@ class OSDService(Dispatcher):
                 self.perf.tinc(
                     "l_subop_queue", time.time() - p["_queued_at"]
                 )
+            qs = p.pop("_qspan", None)
+            if qs is not None:
+                qs.finish()
+            # sub-op handlers run under the SENDER's fork span, so the
+            # shard-side journal/store spans attach to the right branch
+            stoken = self.tracer.use_wire(p.get("_trace"))
             try:
                 await fn(conn, p)
             except asyncio.CancelledError:
                 raise
             except Exception:
                 pass  # the sender retries; never kill the worker
+            finally:
+                self.tracer.release(stoken)
 
     # -- client ops (the primary path) ----------------------------------------
 
@@ -2570,6 +2632,14 @@ class OSDService(Dispatcher):
         shard = self._op_shards[
             zlib.crc32(p["name"].encode()) % len(self._op_shards)
         ]
+        # queue-wait span: enqueue here, finished when the shard worker
+        # picks the op — the ShardedOpWQ wait is a first-class trace leg
+        qs = self.tracer.join(
+            p.get("_trace"), "op_queue",
+            tags={"klass": conn.peer_name},
+        )
+        if qs is not None:
+            p["_qspan"] = qs
         shard.queue.enqueue(
             63,  # osd_client_op_priority
             max(1, len(p["_raw"]) // 4096),
@@ -2646,6 +2716,18 @@ class OSDService(Dispatcher):
         pool_id = p["pool"]
         name = p["name"]
         token = _trace_ctx.set(p.get("trace_id"))
+        qs = p.pop("_qspan", None)
+        if qs is not None:
+            qs.finish()
+        # execution span: child of the client's op_submit root; made the
+        # task-local current context so every downstream site — sub-op
+        # forks, encode batches, journal commits, store reads — parents
+        # to it without plumbing
+        span = self.tracer.join(
+            p.get("_trace"), "osd_op",
+            tags={"op": p.get("op"), "object": f"{pool_id}/{name}"},
+        )
+        stoken = None if span is None else self.tracer.use(span)
         self._trace(
             p.get("trace_id"),
             f"op_execute {p.get('op')} {pool_id}/{name}",
@@ -2653,11 +2735,14 @@ class OSDService(Dispatcher):
         try:
             with self.op_tracker.track(
                 f"osd_op({p.get('op')} {pool_id}/{name} "
-                f"from {conn.peer_name})"
+                f"from {conn.peer_name})", span=span
             ) as tracked, self.perf.time("l_op_total"):
                 await self._do_osd_op(conn, p, pool_id, name, tracked)
             self._trace(p.get("trace_id"), "op_replied")
         finally:
+            if span is not None:
+                span.finish()
+                self.tracer.release(stoken)
             _trace_ctx.reset(token)
 
     async def _do_osd_op(self, conn, p, pool_id, name, tracked) -> None:
@@ -4119,6 +4204,13 @@ class OSDService(Dispatcher):
                         self.traces.get(p.get("trace_id", ""), [])
                     )
                 }
+            elif cmd == "dump_tracing":
+                # drain the completed-span ring (client spans reported
+                # via trace_report included, so one call returns whole
+                # client->messenger->osd->store trees)
+                result = self.tracer.dump_tracing(
+                    drain=not p.get("keep")
+                )
             elif cmd == "dump_ops_in_flight":
                 result = self.op_tracker.dump_ops_in_flight()
             elif cmd == "dump_historic_ops":
@@ -4138,6 +4230,11 @@ class OSDService(Dispatcher):
             Message(type="osd_admin_reply", tid=p["tid"],
                     data=json.dumps(reply).encode())
         )
+
+    async def _h_trace_report(self, conn, p) -> None:
+        """Adopt a client's finished spans (the Jaeger agent->collector
+        hop): one-way, no reply — tracing must never add an RTT."""
+        self.tracer.adopt(p.get("spans") or [])
 
     async def _scrub_fetch(self, pg, sname: str, osd: int,
                            verify: bool = False):

@@ -48,8 +48,16 @@ _UNSET = object()
 class EncodeService:
     def __init__(
         self, window: float = 0.002, max_batch: int = 128,
-        mesh_min_bytes: int = 8192,
+        mesh_min_bytes: int = 8192, tracer=None,
     ):
+        #: optional distributed tracer: traced ops get an encode_wait
+        #: span (enqueue -> result) and each device launch an
+        #: encode_batch span tagged with batch size and whether this
+        #: planar shape compiled fresh or reused a cached executable
+        self.tracer = tracer
+        #: (kind, k, m, bucket width) planar shapes already launched —
+        #: a first launch at a shape pays the jit compile
+        self._seen_shapes: set[tuple] = set()
         #: seconds the first op of a batch waits for company
         self.window = window
         self.max_batch = max_batch
@@ -112,13 +120,23 @@ class EncodeService:
         fut = asyncio.get_event_loop().create_future()
         q = self._enc_q.setdefault(key, [])
         q.append((data, blocksize, fut))
+        sp = None if self.tracer is None else self.tracer.child(
+            "encode_wait", tags={"bytes": len(data)}
+        )
         if len(q) >= self.max_batch:
             self._flush_encode(key)
         elif len(q) == 1:
+            # call_later captures the current context, so the flush
+            # callback's encode_batch span parents to THIS op's trace
             self._enc_timers[key] = asyncio.get_event_loop().call_later(
                 self.window, self._flush_encode, key
             )
-        return await fut
+        if sp is None:
+            return await fut
+        try:
+            return await fut
+        finally:
+            sp.finish()
 
     def _flush_encode(self, key: int) -> None:
         timer = self._enc_timers.pop(key, None)
@@ -129,6 +147,16 @@ class EncodeService:
             return
         codec = self._codecs[key]
         k, n = codec.k, codec.get_chunk_count()
+        sp = None if self.tracer is None else self.tracer.child(
+            "encode_batch", tags={"batch": len(q)}
+        )
+        try:
+            self._flush_encode_inner(key, q, codec, k, n, sp)
+        finally:
+            if sp is not None:
+                sp.finish()
+
+    def _flush_encode_inner(self, key, q, codec, k, n, sp) -> None:
         try:
             # pack every object's chunk j end-to-end into planar row j
             rows: list[list[np.ndarray]] = [[] for _ in range(k)]
@@ -139,10 +167,13 @@ class EncodeService:
                     rows[i].append(padded[i * bs: (i + 1) * bs])
             planes = np.stack([np.concatenate(r) for r in rows])
             mesh = self._mesh(planes.shape[1])
+            path, bucket = "numpy", planes.shape[1]
             if mesh is not None:
                 from ceph_tpu.parallel import sharding
 
                 padded, width = _bucket_pad(planes)
+                path, bucket = "mesh", padded.shape[-1]
+                self._note_launch(sp, path, k, n, bucket, len(q))
                 parity = sharding.mesh_encode_planar(
                     codec, padded, mesh
                 )[:, :width]
@@ -152,6 +183,8 @@ class EncodeService:
                     [np.concatenate(r).view(np.int32) for r in rows]
                 )
                 words, width = _bucket_pad(words)
+                path, bucket = "pallas", words.shape[-1]
+                self._note_launch(sp, path, k, n, bucket, len(q))
                 parity = np.asarray(
                     codec.encode_words(words)
                 )[:, :width].view(np.uint8)
@@ -161,6 +194,7 @@ class EncodeService:
                 # jit-per-width (tiny batches would otherwise recompile
                 # for every composition)
                 parity_mat = codec._gen[codec.k:]
+                self._note_launch(sp, path, k, n, bucket, len(q))
                 if getattr(codec, "_xor_ok", False):
                     parity = np.bitwise_xor.reduce(
                         planes, axis=0
@@ -187,6 +221,21 @@ class EncodeService:
             for _data, _bs, fut in q:
                 if not fut.done():
                     fut.set_exception(e)
+
+    def _note_launch(self, sp, path: str, k: int, n: int,
+                     bucket: int, batch: int) -> None:
+        """Tag the batch span with the compile-vs-execute split: a
+        planar shape's FIRST launch pays the jit compile, later ones
+        reuse the cached executable — the difference dominates tail
+        latency and must be attributable in a trace."""
+        shape = (path, k, n, bucket)
+        fresh = shape not in self._seen_shapes
+        self._seen_shapes.add(shape)
+        if sp is not None:
+            sp.set_tag("path", path)
+            sp.set_tag("width", bucket)
+            sp.set_tag("compile", fresh)
+            sp.set_tag("batch", batch)
 
     # -- decode ---------------------------------------------------------------
 
@@ -247,6 +296,18 @@ class EncodeService:
             return
         codec_id, present, targets = key
         codec = self._codecs[codec_id]
+        sp = None if self.tracer is None else self.tracer.child(
+            "decode_batch",
+            tags={"batch": len(q), "targets": len(targets)},
+        )
+        try:
+            self._flush_decode_inner(key, q, codec, sp)
+        finally:
+            if sp is not None:
+                sp.finish()
+
+    def _flush_decode_inner(self, key, q, codec, sp) -> None:
+        codec_id, present, targets = key
         try:
             rows: list[list[np.ndarray]] = [[] for _ in present]
             for chunks, bs, _want, _fut in q:

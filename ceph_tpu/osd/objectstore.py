@@ -171,6 +171,12 @@ def _decode_attrs(raw: bytes) -> dict:
 class KStore:
     """ObjectStore over a KeyValueDB; see module docstring."""
 
+    KIND = "kstore"
+    #: optional distributed tracer (set by the owning daemon): traced
+    #: ops get a journal_commit span per transaction; untraced cost is
+    #: one attribute check
+    tracer = None
+
     def __init__(self, db: KeyValueDB | None = None):
         self.db = db if db is not None else MemDB()
 
@@ -187,15 +193,23 @@ class KStore:
 
     def queue_transaction(self, txn: Transaction) -> None:
         """Compile to one KV batch and commit atomically."""
-        kv = KVTransaction()
-        self._begin_batch()
+        sp = None if self.tracer is None else self.tracer.child(
+            "journal_commit",
+            tags={"store": self.KIND, "ops": len(txn.ops)},
+        )
         try:
-            for op in txn.ops:
-                self._compile_op(kv, op)
-        except BaseException:
-            self._abort_batch()
-            raise
-        self._commit_batch(kv)
+            kv = KVTransaction()
+            self._begin_batch()
+            try:
+                for op in txn.ops:
+                    self._compile_op(kv, op)
+            except BaseException:
+                self._abort_batch()
+                raise
+            self._commit_batch(kv)
+        finally:
+            if sp is not None:
+                sp.finish()
 
     def _begin_batch(self) -> None:
         """Per-transaction compile state reset (backend hook)."""

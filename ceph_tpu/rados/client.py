@@ -64,6 +64,15 @@ class Objecter(Dispatcher):
         #: multi-daemon timeline from the daemons' span stores
         self.trace_all = False
         self.traces: dict[str, list] = {}
+        #: Dapper-style span tracer (common/tracer): samples op_submit
+        #: roots per tracer_sample_rate and propagates the context on
+        #: Message.trace; finished client spans are reported to the
+        #: primary OSD (the Jaeger collector role) so `dump_tracing`
+        #: there holds the complete client->osd->store tree
+        from ceph_tpu.common.tracer import Tracer
+
+        self.tracer = Tracer(name, config=self.config)
+        self.messenger.tracer = self.tracer
         self.mon.on_map_change(self._rewatch_on_map)
 
     async def start(self) -> None:
@@ -115,6 +124,7 @@ class Objecter(Dispatcher):
             except (asyncio.CancelledError, Exception):
                 pass
         await self.messenger.shutdown()
+        self.tracer.close()
 
     @property
     def osdmap(self):
@@ -295,6 +305,47 @@ class Objecter(Dispatcher):
             self.traces[trace_id] = [(
                 _time.time(), self.name, f"op_submit {op} {name}"
             )]
+        # Dapper-style root span (sampled): covers submit -> completion
+        # including every retarget/resend; the context rides the wire
+        span = self.tracer.start(
+            "op_submit", tags={"pool": pool_id, "object": name, "op": op}
+        )
+        wire_ctx = "" if span is None else span.context().encode()
+        try:
+            return await self._op_submit_inner(
+                pool_id, name, op, data, deadline, last_error, tid,
+                trace_id, span, wire_ctx, extra,
+            )
+        except BaseException as e:
+            if span is not None:
+                span.set_tag("error", str(e) or type(e).__name__)
+            raise
+        finally:
+            if span is not None:
+                span.finish()
+                self._report_trace(span.trace_id)
+
+    def _report_trace(self, trace_id: str) -> None:
+        """Ship this client's finished spans of one trace to the primary
+        it last talked to — the Jaeger agent->collector hop, so a single
+        `dump_tracing` on the OSD returns the COMPLETE tree."""
+        spans = self.tracer.spans_of(trace_id)
+        conn = self._last_conn
+        if spans and conn is not None:
+            conn.send_message(
+                Message(
+                    type="trace_report",
+                    data=json.dumps({"spans": spans}).encode(),
+                )
+            )
+
+    #: connection of the most recent op send (trace reporting target)
+    _last_conn = None
+
+    async def _op_submit_inner(
+        self, pool_id, name, op, data, deadline, last_error, tid,
+        trace_id, span, wire_ctx, extra,
+    ) -> dict:
         while asyncio.get_event_loop().time() < deadline:
             try:
                 eff_pool = self._effective_pool(pool_id)
@@ -315,17 +366,24 @@ class Objecter(Dispatcher):
             fut = asyncio.get_event_loop().create_future()
             self._waiters[tid] = fut
             try:
-                self.messenger.connect(
+                conn = self.messenger.connect(
                     tuple(addr), Policy.lossless_client()
-                ).send_message(
+                )
+                self._last_conn = conn
+                if span is not None:
+                    span.log(f"sent to osd.{primary}")
+                conn.send_message(
                     Message(type="osd_op", tid=tid,
                             epoch=self.osdmap.epoch,
                             data=json.dumps(payload).encode(),
-                            raw=data or b"")
+                            raw=data or b"",
+                            trace=wire_ctx)
                 )
                 reply = await asyncio.wait_for(fut, timeout=3.0)
             except asyncio.TimeoutError:
                 # primary silent (died?): refresh the map and re-target
+                if span is not None:
+                    span.log(f"resend: osd.{primary} silent")
                 await self._refresh_map()
                 continue
             finally:
@@ -338,9 +396,14 @@ class Objecter(Dispatcher):
                         (_time.time(), self.name, "op_reply")
                     )
                     reply["trace_id"] = trace_id
+                if span is not None:
+                    span.log("op_reply")
+                    reply["trace"] = span.trace_id
                 return reply
             if reply.get("wrong_primary"):
                 # our map was stale; catch up past the OSD's epoch
+                if span is not None:
+                    span.log(f"retarget: osd.{primary} not primary")
                 await self._refresh_map()
                 continue
             errno = reply.get("errno")

@@ -3,11 +3,18 @@ src/common/zipkin_trace.h role): a traced client op carries its trace id
 through client -> primary -> shard sub-op hops; every daemon records
 span events; `dump_trace` on the admin surface hands them out and the
 client stitches the full multi-daemon timeline.
+
+Plus the Dapper-style span tracer (common/tracer): a sampled client
+write produces ONE trace whose spans cover client -> messenger -> osd
+op-queue -> journal/blockstore with parent links forming a single tree,
+drained via the `dump_tracing` admin command and rendered (critical
+path included) by tools/trace_tool.py.
 """
 
 import asyncio
 
 import numpy as np
+import pytest
 
 from ceph_tpu.rados.client import Rados
 from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster
@@ -15,6 +22,181 @@ from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster
 
 def run(coro):
     return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+def traced_cluster_cfg(**overrides):
+    from tests.test_cluster_live import live_config
+
+    cfg = live_config()
+    cfg.set("tracer_enabled", True)
+    cfg.set("tracer_sample_rate", 1.0)
+    cfg.set("osd_objectstore", "blockstore")
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return cfg
+
+
+def assert_single_tree(spans):
+    """Parent links form ONE tree: exactly one root, every non-root
+    parent resolves inside the trace (no cycles by construction)."""
+    ids = {s["span_id"] for s in spans}
+    roots = [s for s in spans if s["parent_id"] not in ids]
+    assert len(roots) == 1, [
+        (s["service"], s["name"], s["parent_id"]) for s in roots
+    ]
+    assert roots[0]["parent_id"] is None
+    return roots[0]
+
+
+def test_traced_write_spans_client_to_blockstore():
+    """One sampled replicated write against blockstore-backed OSDs:
+    `dump_tracing` at the primary returns the COMPLETE tree — the
+    client's op_submit root (reported collector-style), messenger
+    send/dispatch, the op-queue wait, the op execution, and the
+    journal/blockstore commit — as one trace."""
+
+    async def main():
+        cluster = Cluster(cfg=traced_cluster_cfg())
+        await cluster.start()
+        rados = Rados("client.sp", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        io = rados.io_ctx(REP_POOL)
+
+        await io.write_full("traced-obj", b"t" * 9000)
+        roots = [
+            s for s in list(rados.objecter.tracer._ring)
+            if s["name"] == "op_submit"
+            and s["tags"].get("object") == "traced-obj"
+        ]
+        assert roots, "client root span missing"
+        trace_id = roots[-1]["trace_id"]
+        await asyncio.sleep(0.3)  # let the trace_report land
+
+        primary = rados.objecter._calc_target(REP_POOL, "traced-obj")
+        dump = await rados.objecter.osd_admin(primary, "dump_tracing")
+        assert dump["num_traces"] >= 1
+        trace = next(
+            t for t in dump["traces"] if t["trace_id"] == trace_id
+        )
+        spans = trace["spans"]
+        names = {s["name"] for s in spans}
+        services = {s["service"] for s in spans}
+        # every layer of the acceptance criterion is present
+        assert "op_submit" in names          # client
+        assert "msg_dispatch" in names       # messenger
+        assert "op_queue" in names           # osd op-queue wait
+        assert "osd_op" in names             # osd execution
+        assert "blockstore_txn" in names     # blockstore commit
+        assert "journal_commit" in names     # KV WAL commit
+        assert "client.sp" in services
+        assert f"osd.{primary}" in services
+        root = assert_single_tree(spans)
+        assert root["name"] == "op_submit"
+        # replica fan-out forked child sub-op spans
+        assert any(
+            s["name"] == "subop_rep_ops" for s in spans
+        ), names
+        # timings are sane: children start at/after the root
+        t0 = root["start"]
+        assert all(s["start"] >= t0 - 0.001 for s in spans)
+
+        # an UNSAMPLED op leaves nothing behind
+        cluster.cfg.set("tracer_sample_rate", 0.0)
+        await io.write_full("untraced", b"u" * 2000)
+        await asyncio.sleep(0.1)
+        dump2 = await rados.objecter.osd_admin(primary, "dump_tracing")
+        assert not any(
+            s["tags"].get("object", "").endswith("untraced")
+            for t in dump2["traces"] for s in t["spans"]
+        )
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_vstart_traced_slow_write_renders_critical_path(tmp_path):
+    """The thorough variant: an EC write traced end to end with JSONL
+    export; the op is forced over slow_op_seconds so the slow-request
+    warning fires (with its trace id) the moment the periodic check
+    sees it; trace_tool renders the tree + critical path from the
+    export file."""
+
+    async def main():
+        export = tmp_path / "trace.jsonl"
+        cfg = traced_cluster_cfg(
+            tracer_export_path=str(export),
+            slow_op_seconds=0.0,  # every in-flight op is "slow"
+        )
+        cluster = Cluster(cfg=cfg)
+        await cluster.start()
+        rados = Rados("client.tv", cluster.monmap, config=cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        io = rados.io_ctx(EC_POOL)
+        data = np.random.default_rng(7).integers(
+            0, 256, 30_000, np.uint8
+        ).tobytes()
+        await io.write_full("slow-obj", data)
+        assert await io.read("slow-obj") == data
+
+        roots = [
+            s for s in list(rados.objecter.tracer._ring)
+            if s["name"] == "op_submit"
+            and s["tags"].get("object") == "slow-obj"
+        ]
+        trace_id = roots[0]["trace_id"]
+        await asyncio.sleep(0.5)  # slow-op scan + trace_report + export
+
+        # the slow-request warning line appeared in the primary's log
+        # ring, tagged with the op's trace id
+        primary = rados.objecter._calc_target(EC_POOL, "slow-obj")
+        logd = await rados.objecter.osd_admin(primary, "log dump")
+        slow_lines = [
+            e["message"] for e in logd["entries"]
+            if "slow request" in e["message"]
+        ]
+        assert slow_lines, "no slow-request warning emitted"
+        assert any("trace=" in line for line in slow_lines)
+
+        # EC fan-out: the trace covers shard sub-ops + the encode leg
+        dump = await rados.objecter.osd_admin(primary, "dump_tracing")
+        trace = next(
+            t for t in dump["traces"] if t["trace_id"] == trace_id
+        )
+        names = {s["name"] for s in trace["spans"]}
+        assert "subop_ec_sub_write" in names
+        assert "encode_wait" in names or "encode_batch" in names
+        assert_single_tree(trace["spans"])
+
+        # historic ops carry the span timeline
+        hist = await rados.objecter.osd_admin(
+            primary, "dump_historic_ops"
+        )
+        traced_ops = [
+            o for o in hist["ops"] if o.get("trace_id") == trace_id
+        ]
+        assert traced_ops and traced_ops[0]["span"]["duration"] > 0
+
+        await rados.shutdown()
+        await cluster.stop()
+
+        # exported JSONL renders with a critical path starting at the
+        # client root
+        from tools import trace_tool
+
+        spans = trace_tool.load_spans(str(export))
+        mine = [s for s in spans if s["trace_id"] == trace_id]
+        assert mine
+        text = trace_tool.render_trace(mine)
+        assert "critical path" in text
+        assert "op_submit" in text
+        cp = trace_tool.critical_path(mine)
+        assert cp and cp[0]["name"] == "op_submit"
+
+    run(main())
 
 
 def test_traced_ec_write_shows_multi_daemon_timeline():

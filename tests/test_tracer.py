@@ -1,0 +1,396 @@
+"""Distributed tracer unit tier (common/tracer + its integrations).
+
+Covers the tentpole contracts: sampling decisions, the bounded
+completed-span ring, disabled-tracer-is-free, wire context survival
+across a real messenger round-trip, Jaeger JSONL export consumed by
+tools/trace_tool.py, span latencies feeding PerfCounters histograms,
+the OpTracker slow-request warning, the dout `trace=` prefix, and the
+Prometheus TIME_AVG/HISTOGRAM rendering. These run with
+tracer_enabled=true in tier-1 (the enabled path is exercised on every
+CI run, not only in slow live tests).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.common.admin import OpTracker
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.tracer import SpanContext, Tracer
+
+
+def traced_config(**overrides) -> Config:
+    cfg = Config()
+    cfg.set("tracer_enabled", True)
+    cfg.set("tracer_sample_rate", 1.0)
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return cfg
+
+
+# -- core tracer ------------------------------------------------------------
+
+
+def test_disabled_tracer_is_free():
+    """Default config: every factory returns None immediately — one
+    cached flag check, no allocation, nothing recorded anywhere."""
+    tr = Tracer("osd.0", config=Config())  # tracer_enabled defaults off
+    assert not tr.enabled
+    assert tr.start("op_submit") is None
+    assert tr.child("blockstore_read") is None
+    assert tr.join("aa:bb:1", "osd_op") is None
+    assert tr.use_wire("aa:bb:1") is None
+    assert tr.dump_tracing() == {
+        "num_traces": 0, "num_spans": 0, "traces": []
+    }
+    assert tr.perf.dump() == {}
+
+
+def test_enable_disable_is_config_observed():
+    cfg = Config()
+    tr = Tracer("osd.0", config=cfg)
+    assert tr.start("x") is None
+    cfg.set("tracer_enabled", True)
+    sp = tr.start("x")
+    assert sp is not None
+    sp.finish()
+    cfg.set("tracer_enabled", False)
+    assert tr.start("x") is None
+
+
+def test_sample_rate_zero_samples_nothing():
+    tr = Tracer("c", config=traced_config(tracer_sample_rate=0.0))
+    assert all(tr.start("op") is None for _ in range(50))
+
+
+def test_ring_is_bounded_and_drained_by_dump():
+    tr = Tracer("osd.1", config=traced_config(tracer_ring_size=4))
+    for i in range(10):
+        tr.start(f"op{i}").finish()
+    out = tr.dump_tracing()
+    assert out["num_spans"] == 4  # bounded
+    # newest survive
+    names = {s["name"] for t in out["traces"] for s in t["spans"]}
+    assert names == {"op6", "op7", "op8", "op9"}
+    # dump drained the ring
+    assert tr.dump_tracing()["num_spans"] == 0
+
+
+def test_context_roundtrip_and_parent_links():
+    tr = Tracer("client.x", config=traced_config())
+    root = tr.start("op_submit", tags={"op": "write"})
+    wire = root.context().encode()
+    ctx = SpanContext.decode(wire)
+    assert (ctx.trace_id, ctx.span_id, ctx.sampled) == (
+        root.trace_id, root.span_id, True
+    )
+    child = tr.join(wire, "osd_op")
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    # task-local propagation: child() parents to the current span
+    token = tr.use(child)
+    try:
+        grand = tr.child("journal_commit")
+        assert grand.parent_id == child.span_id
+        assert grand.trace_id == root.trace_id
+    finally:
+        tr.release(token)
+    assert tr.child("orphan") is None  # no current ctx -> no span
+    # malformed wire contexts never throw on the hot path
+    assert SpanContext.decode("") is None
+    assert SpanContext.decode("junk") is None
+    assert tr.join("::", "x") is None
+
+
+def test_span_latency_feeds_perf_histogram():
+    tr = Tracer("osd.2", config=traced_config())
+    for _ in range(3):
+        tr.start("osd_op").finish()
+    dump = tr.perf.dump()
+    assert "lat_us_osd_op" in dump
+    assert sum(dump["lat_us_osd_op"].values()) == 3
+    assert tr.perf.schema()["lat_us_osd_op"]["type"] == "hist"
+
+
+def test_jaeger_jsonl_export_and_trace_tool(tmp_path):
+    from tools import trace_tool
+
+    path = tmp_path / "spans.jsonl"
+    tr = Tracer(
+        "osd.0", config=traced_config(tracer_export_path=str(path))
+    )
+    root = tr.start("op_submit", tags={"op": "write"})
+    child = tr.join(root.context().encode(), "osd_op")
+    leaf = None
+    token = tr.use(child)
+    try:
+        leaf = tr.child("blockstore_txn", tags={"deferred": 1})
+        leaf.log("staged")
+    finally:
+        tr.release(token)
+    leaf.finish()
+    child.finish()
+    root.finish()
+    tr.close()
+
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) == 3
+    for j in lines:
+        assert {"traceID", "spanID", "operationName", "startTime",
+                "duration", "process"} <= set(j)
+    by_name = {j["operationName"]: j for j in lines}
+    ref = by_name["osd_op"]["references"][0]
+    assert ref["refType"] == "CHILD_OF"
+    assert ref["spanID"] == by_name["op_submit"]["spanID"]
+
+    spans = trace_tool.load_spans(str(path))
+    assert len(spans) == 3
+    text = trace_tool.render_trace(spans)
+    assert "op_submit" in text and "critical path" in text
+    path_spans = trace_tool.critical_path(spans)
+    assert [s["name"] for s in path_spans] == [
+        "op_submit", "osd_op", "blockstore_txn"
+    ]
+
+
+def test_trace_tool_critical_path_picks_latest_finishing_chain():
+    from tools import trace_tool
+
+    spans = [
+        {"trace_id": "t", "span_id": "r", "parent_id": None,
+         "name": "root", "service": "c", "start": 0.0, "duration": 1.0,
+         "tags": {}, "events": []},
+        {"trace_id": "t", "span_id": "a", "parent_id": "r",
+         "name": "fast", "service": "o", "start": 0.1, "duration": 0.1,
+         "tags": {}, "events": []},
+        {"trace_id": "t", "span_id": "b", "parent_id": "r",
+         "name": "slowleg", "service": "o", "start": 0.1,
+         "duration": 0.8, "tags": {}, "events": []},
+        {"trace_id": "t", "span_id": "b1", "parent_id": "b",
+         "name": "inner", "service": "o", "start": 0.2,
+         "duration": 0.6, "tags": {}, "events": []},
+    ]
+    assert [s["name"] for s in trace_tool.critical_path(spans)] == [
+        "root", "slowleg", "inner"
+    ]
+
+
+def test_adopt_foreign_spans_into_ring():
+    tr = Tracer("osd.0", config=traced_config())
+    tr.adopt([{"trace_id": "t1", "span_id": "s1", "parent_id": None,
+               "name": "op_submit", "service": "client.x",
+               "start": 1.0, "duration": 0.5, "tags": {},
+               "events": []},
+              {"bogus": True}])  # malformed entries are dropped
+    out = tr.dump_tracing()
+    assert out["num_spans"] == 1
+    assert out["traces"][0]["spans"][0]["service"] == "client.x"
+
+
+# -- messenger propagation --------------------------------------------------
+
+
+def test_trace_context_survives_messenger_roundtrip():
+    """The wire contract: Message.trace arrives intact on the far side,
+    and both ends record their messenger spans (send queue wait /
+    dispatch) under the propagated trace id."""
+    from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy
+
+    async def main():
+        cfg = traced_config()
+        server = Messenger("osd.9", config=cfg)
+        client = Messenger("client.t", config=cfg)
+        server.tracer = Tracer("osd.9", config=cfg)
+        client.tracer = Tracer("client.t", config=cfg)
+        got = asyncio.Event()
+        seen = {}
+
+        class Sink(Dispatcher):
+            async def ms_dispatch(self, conn, msg):
+                seen["trace"] = msg.trace
+                seen["type"] = msg.type
+                got.set()
+
+        server.dispatcher = Sink()
+        await server.bind()
+        root = client.tracer.start("op_submit")
+        conn = client.connect(server.my_addr, Policy.lossless_client())
+        conn.send_message(
+            Message(type="osd_op", tid=1, data=b"{}",
+                    trace=root.context().encode())
+        )
+        await asyncio.wait_for(got.wait(), 10)
+        assert seen["trace"] == root.context().encode()
+        ctx = SpanContext.decode(seen["trace"])
+        assert ctx.trace_id == root.trace_id and ctx.sampled
+        # both messenger legs produced spans of THIS trace
+        await asyncio.sleep(0.05)  # let the send span finish
+        snd = client.tracer.spans_of(root.trace_id)
+        assert any(s["name"] == "msg_send" for s in snd)
+        rcv = server.tracer.dump_tracing()
+        names = {
+            s["name"] for t in rcv["traces"] for s in t["spans"]
+            if t["trace_id"] == root.trace_id
+        }
+        assert "msg_dispatch" in names
+        # untraced messages stay untraced end to end
+        got.clear()
+        conn.send_message(Message(type="osd_op", tid=2, data=b"{}"))
+        await asyncio.wait_for(got.wait(), 10)
+        assert seen["trace"] == ""
+        await client.shutdown()
+        await server.shutdown()
+
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+# -- OpTracker slow-request warning ----------------------------------------
+
+
+def test_optracker_warns_once_when_op_crosses_slow_threshold():
+    warned = []
+    tracker = OpTracker(
+        slow_op_seconds=0.0, on_slow=lambda i, d: warned.append((i, d))
+    )
+    op_id, op = tracker.create("osd_op(write 1/obj)")
+    op.mark_event("queued")
+    newly = tracker.check_slow()
+    assert [i for i, _ in newly] == [op_id]
+    assert warned and warned[0][0] == op_id
+    assert warned[0][1]["events"][-1]["event"] == "queued"
+    # the warning fires ONCE per op, not per scan
+    assert tracker.check_slow() == []
+    tracker.finish(op_id)
+    assert tracker.check_slow() == []
+
+
+def test_optracker_slow_marks_span():
+    tr = Tracer("osd.0", config=traced_config())
+    sp = tr.start("osd_op")
+    tracker = OpTracker(slow_op_seconds=0.0)
+    op_id, op = tracker.create("osd_op(write)", span=sp)
+    tracker.check_slow()
+    assert sp.tags.get("slow") is True
+    assert any(e == "slow_request" for _t, e in sp.events)
+    dump = tracker.dump_ops_in_flight()["ops"][0]
+    assert dump["trace_id"] == sp.trace_id
+    tracker.finish(op_id)
+    hist = tracker.dump_historic_ops()["ops"][0]
+    assert hist["span"]["name"] == "osd_op"
+
+
+# -- dout correlation -------------------------------------------------------
+
+
+def test_dout_lines_carry_trace_prefix():
+    from ceph_tpu.common.log import LogRegistry
+
+    cfg = traced_config()
+    tr = Tracer("osd.0", config=cfg)
+    logs = LogRegistry(cfg)
+    log = logs.get_logger("osd")
+    span = tr.start("osd_op")
+    token = tr.use(span)
+    try:
+        if (d := log.dout(5)) is not None:
+            d("applying write")
+    finally:
+        tr.release(token)
+    if (d := log.dout(5)) is not None:
+        d("untraced line")
+    msgs = [e["message"] for e in logs.dump_recent()]
+    assert f"trace={span.trace_id} applying write" in msgs
+    assert "untraced line" in msgs
+
+
+# -- prometheus rendering ---------------------------------------------------
+
+
+def collect_rendered(key, value):
+    out = []
+
+    def emit(name, v, labels, mtype, type_name=None):
+        out.append((name, v, dict(labels), mtype, type_name))
+
+    from ceph_tpu.mgr.prometheus import render_perf_value
+
+    render_perf_value(emit, key, value, {"daemon": "osd.0"})
+    return out
+
+
+def test_prometheus_renders_time_avg_as_sum_count():
+    out = collect_rendered("op_lat", {"avgcount": 7, "sum": 1.25})
+    assert ("op_lat_sum", 1.25, {"daemon": "osd.0"}, "counter", None) \
+        in out
+    assert ("op_lat_count", 7, {"daemon": "osd.0"}, "counter", None) \
+        in out
+
+
+def test_prometheus_renders_histogram_as_cumulative_buckets():
+    # perf histogram dump: power-of-two lower bound -> count
+    out = collect_rendered(
+        "lat_us_osd_op", {"1": 2, "4": 3, "1024": 1}
+    )
+    buckets = [
+        (o[2]["le"], o[1]) for o in out if o[0].endswith("_bucket")
+    ]
+    # cumulative, ascending, closed with +Inf
+    assert buckets == [("1", 2), ("7", 5), ("2047", 6), ("+Inf", 6)]
+    count = [o for o in out if o[0].endswith("_count")]
+    assert count and count[0][1] == 6
+    assert all(o[3] == "histogram" for o in out)
+    assert all(o[4] == "lat_us_osd_op" for o in out)
+
+
+def test_prometheus_renders_plain_counter_unchanged():
+    out = collect_rendered("op_w", 41)
+    assert out == [("op_w", 41, {"daemon": "osd.0"}, "counter", None)]
+    assert collect_rendered("weird", {"not": "a-counter"}) == []
+
+
+def test_prometheus_exporter_text_has_single_type_per_family():
+    """End-to-end shape check against a fake perf dump: # TYPE lines
+    are deduped by family (the O(n^2) scan is gone — now set-backed)."""
+
+    class FakeMap:
+        epoch = 3
+        max_osd = 1
+        pools: dict = {}
+
+        @staticmethod
+        def is_down(_o):
+            return False
+
+    class FakeMon:
+        @staticmethod
+        async def command(*_a, **_k):
+            raise RuntimeError("no mon")
+
+    class FakeObjecter:
+        osdmap = FakeMap()
+        mon = FakeMon()
+
+        @staticmethod
+        async def osd_admin(_osd, _cmd, timeout=0):
+            return {
+                "osd.0": {
+                    "op_w": 5,
+                    "l_op_total": {"avgcount": 5, "sum": 0.5},
+                },
+                "tracer": {"lat_us_osd_op": {"64": 5}},
+            }
+
+    from ceph_tpu.mgr.prometheus import PrometheusExporter
+
+    text = asyncio.run(PrometheusExporter(FakeObjecter()).collect())
+    assert text.count("# TYPE ceph_tpu_daemon_op_w ") == 1
+    assert "ceph_tpu_daemon_l_op_total_sum" in text
+    assert "ceph_tpu_daemon_l_op_total_count" in text
+    assert 'ceph_tpu_daemon_lat_us_osd_op_bucket{' in text
+    assert 'le="+Inf"' in text
+    assert text.count(
+        "# TYPE ceph_tpu_daemon_lat_us_osd_op histogram"
+    ) == 1

@@ -27,7 +27,12 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def _parse_kv(pairs):
