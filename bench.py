@@ -127,6 +127,71 @@ def _store_bench_line() -> None:
         pass
 
 
+def _fault_overhead_line() -> None:
+    """Optional JSON line: BlockStore throughput with every device-fault
+    knob at 0 (the shipped default) plus the measured per-site cost of a
+    DISARMED injection check — one cached flag read, the same
+    disabled-cost rule the tracer follows. Pass
+    CEPH_TPU_FAULT_BASELINE_MBPS to assert reread parity (<2%) against a
+    recorded pre-fault-layer number. Guarded (--fault-overhead /
+    CEPH_TPU_BENCH_FAULT=1) and non-fatal."""
+    try:
+        import io
+        import tempfile
+        from contextlib import redirect_stderr, redirect_stdout
+
+        from ceph_tpu.common.config import Config
+        from ceph_tpu.common.kv import MemDB
+        from ceph_tpu.osd.blockstore import BlockStore
+        from tools import store_bench
+
+        # the disarmed site check itself, in ns (the read hot path's
+        # single `_inj_read_armed` flag)
+        store = BlockStore(MemDB(), config=Config())
+        n = 200_000
+        sink = 0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if store._inj_read_armed:
+                sink += 1
+        site_ns = (time.perf_counter() - t0) / n * 1e9
+        store.umount()
+
+        with tempfile.TemporaryDirectory(prefix="bench_fault_") as d:
+            out = os.path.join(d, "store.json")
+            with redirect_stdout(io.StringIO()), \
+                    redirect_stderr(io.StringIO()):
+                store_bench.main([
+                    "--backend", "blockstore",
+                    "--sizes", "65536",
+                    "--small-sizes", "1024",
+                    "--bytes-per-case", str(4 << 20),
+                    "--dir", d,
+                    "--out", out,
+                ])
+            with open(out) as f:
+                results = json.load(f)["results"]
+        rw = next(r for r in results if r["workload"] == "rw")
+        line = {
+            "metric": "fault_injection_overhead",
+            "value": round(site_ns, 1),
+            "unit": "ns/site",
+            "write_mbps": round(rw["write_mbps"], 1),
+            "read_mbps": round(rw["read_mbps"], 1),
+            "reread_mbps": round(rw["reread_mbps"], 1),
+        }
+        baseline = os.environ.get("CEPH_TPU_FAULT_BASELINE_MBPS")
+        if baseline is not None:
+            drift = (
+                abs(rw["reread_mbps"] - float(baseline)) / float(baseline)
+            )
+            line["baseline_mbps"] = float(baseline)
+            line["within_noise"] = bool(drift < 0.02)
+        print(json.dumps(line))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def _trace_overhead_line() -> None:
     """Optional JSON line: daemon_bench throughput with the tracer
     disabled vs enabled-at-rate-1. The disabled figure is the pre-PR
@@ -228,6 +293,10 @@ def main() -> None:
         "CEPH_TPU_BENCH_TRACE"
     ):
         _trace_overhead_line()
+    if "--fault-overhead" in sys.argv[1:] or os.environ.get(
+        "CEPH_TPU_BENCH_FAULT"
+    ):
+        _fault_overhead_line()
 
 
 if __name__ == "__main__":

@@ -118,6 +118,20 @@ SCHEMA: dict[str, Option] = {
              "inject heartbeat failures for N seconds"),
         _opt("objecter_inject_no_watch_ping", TYPE_BOOL, LEVEL_DEV, False,
              "suppress watch pings"),
+        # device-fault injection (the filestore_debug_inject_read_err /
+        # bluestore debug-omit family): 1-in-N rates per device IO; 0
+        # disables and the hook costs one cached flag check per site
+        _opt("blockstore_inject_read_eio", TYPE_UINT, LEVEL_DEV, 0,
+             "raise EIO on 1-in-N BlockStore device/payload reads "
+             "(self-healing read path exercise); 0 disables",
+             see_also=("blockstore_inject_write_eio",)),
+        _opt("blockstore_inject_write_eio", TYPE_UINT, LEVEL_DEV, 0,
+             "fail 1-in-N BlockStore device writes; a write error FENCES "
+             "the store (fail-stop: no further acks); 0 disables"),
+        _opt("blockstore_inject_fsync_fail", TYPE_UINT, LEVEL_DEV, 0,
+             "fail 1-in-N BlockStore device fsyncs; an fsync error FENCES "
+             "the store — never retried-and-forgotten (Rebello et al., "
+             "ATC '20); 0 disables"),
         # data path
         _opt("osd_pool_default_size", TYPE_UINT, LEVEL_BASIC, 3,
              "replicas per replicated pool"),
@@ -194,6 +208,11 @@ SCHEMA: dict[str, Option] = {
         _opt("blockstore_block_path", TYPE_STR, LEVEL_ADVANCED, "",
              "explicit block file path; empty = <kv dir>/block beside a "
              "FileDB, or an in-memory device over MemDB"),
+        _opt("blockstore_block_size", TYPE_UINT, LEVEL_ADVANCED, 0,
+             "hard cap on the block file size (the fixed-disk role): "
+             "allocation beyond it fails cleanly with ENOSPC — never "
+             "EIO, never a fence — and frees make the store writable "
+             "again; 0 = grow-on-demand (unbounded)"),
         _opt("osd_min_pg_log_entries", TYPE_UINT, LEVEL_ADVANCED, 500,
              "log entries retained per PG; peers further behind than "
              "this take a full backfill instead of log recovery"),
@@ -257,6 +276,18 @@ SCHEMA: dict[str, Option] = {
         _opt("tracer_ring_size", TYPE_UINT, LEVEL_ADVANCED, 1024,
              "completed spans retained per daemon for `dump_tracing`",
              min=1),
+        # per-op-type sample-rate overrides: recovery reads can be traced
+        # at 100% while steady-state IO stays sampled; -1 inherits the
+        # base tracer_sample_rate
+        *[
+            _opt(f"tracer_sample_rate_{t}", TYPE_FLOAT, LEVEL_ADVANCED,
+                 -1.0,
+                 f"sample-rate override for {t!r} root ops; -1 inherits "
+                 "tracer_sample_rate", min=-1.0, max=1.0,
+                 see_also=("tracer_sample_rate",))
+            for t in ("read", "write", "ops", "delete", "call", "stat",
+                      "recovery")
+        ],
         _opt("tracer_export_path", TYPE_STR, LEVEL_ADVANCED, "",
              "append finished spans as Jaeger-compatible JSONL here "
              "(tools/trace_tool.py renders trace trees from it); empty "

@@ -22,7 +22,10 @@ Pieces:
     to `tracer_export_path` when set (tools/trace_tool.py renders it).
   * `Tracer` — per-daemon factory. Config knobs (central schema):
     `tracer_enabled`, `tracer_sample_rate`, `tracer_ring_size`,
-    `tracer_export_path`; all observed at runtime like debug levels.
+    `tracer_export_path`, plus per-op-type `tracer_sample_rate_<type>`
+    root-rate overrides (-1 inherits; recovery reads can run at 100%
+    while steady-state IO stays sampled); all observed at runtime like
+    debug levels.
 
 Cost discipline (the dout-gate idiom, common/log.py): the enabled flag
 is CACHED and checked first in every factory method, so a disabled
@@ -67,6 +70,13 @@ def current_trace_id() -> str | None:
     `trace=<id>` dout prefix); None when untraced."""
     ctx = _current.get()
     return None if ctx is None else ctx.trace_id
+
+
+#: op types with a `tracer_sample_rate_<type>` schema entry — keeps the
+#: cached-rate table in lockstep with common/config.py
+_OP_RATE_TYPES = (
+    "read", "write", "ops", "delete", "call", "stat", "recovery",
+)
 
 
 class SpanContext:
@@ -201,6 +211,10 @@ class Tracer:
         self._rng = random.Random()
         self._on = False
         self._rate = 1.0
+        #: per-op-type sample-rate overrides (tracer_sample_rate_<type>):
+        #: only types with a non-negative override are present, so the
+        #: common case stays one dict-get against an empty dict
+        self._op_rates: dict[str, float] = {}
         self._export_path = ""
         ring_size = 1024
         try:
@@ -212,6 +226,15 @@ class Tracer:
             cfg.observe("tracer_sample_rate", self._on_rate)
             cfg.observe("tracer_export_path", self._on_export)
             cfg.observe("tracer_ring_size", self._on_ring)
+            for t in _OP_RATE_TYPES:
+                name = f"tracer_sample_rate_{t}"
+                try:
+                    rate = float(cfg.get(name))
+                except ConfigError:
+                    continue  # older/custom schema without this type
+                if rate >= 0:
+                    self._op_rates[t] = rate
+                cfg.observe(name, self._make_op_rate_cb(t))
         except ConfigError:
             pass  # custom schema without tracer options: stay disabled
         self._ring: deque[dict] = deque(maxlen=max(1, ring_size))
@@ -241,6 +264,16 @@ class Tracer:
     def _on_ring(self, _n, v) -> None:
         self._ring = deque(self._ring, maxlen=max(1, int(v)))
 
+    def _make_op_rate_cb(self, op_type: str):
+        def cb(_n, v) -> None:
+            rate = float(v)
+            if rate < 0:
+                self._op_rates.pop(op_type, None)  # back to inheriting
+            else:
+                self._op_rates[op_type] = rate
+
+        return cb
+
     @property
     def enabled(self) -> bool:
         return self._on
@@ -248,13 +281,20 @@ class Tracer:
     # -- span factories -------------------------------------------------------
 
     def start(self, name: str, tags: dict | None = None,
-              start: float | None = None) -> Span | None:
+              start: float | None = None,
+              op_type: str | None = None) -> Span | None:
         """Root span: begins a NEW trace, subject to the sample rate.
-        None when disabled or not sampled — the whole trace then costs
-        nothing anywhere downstream (the context never propagates)."""
+        `op_type` selects a `tracer_sample_rate_<type>` override when one
+        is set (recovery reads at 100% while steady-state IO stays
+        sampled); unknown/unset types inherit the base rate. None when
+        disabled or not sampled — the whole trace then costs nothing
+        anywhere downstream (the context never propagates)."""
         if not self._on:
             return None
-        if self._rng.random() >= self._rate:
+        rate = self._rate
+        if op_type is not None and self._op_rates:
+            rate = self._op_rates.get(op_type, rate)
+        if self._rng.random() >= rate:
             return None
         trace_id = f"{self._rng.getrandbits(64):016x}"
         return Span(self, name, trace_id, self._new_id(), None, tags, start)

@@ -92,6 +92,15 @@ async def _call(fn, *args):
         await r
 
 
+def backoff_with_jitter(backoff: float, rng) -> float:
+    """Reconnect sleep for one attempt: uniform in [backoff/2, backoff].
+    A fenced/killed daemon has EVERY peer's reconnect loop pointed at it;
+    without jitter they all wake in lockstep on the shared doubling
+    schedule and hammer the returning address together (thundering herd —
+    the reference staggers the same way in its backoff paths)."""
+    return backoff * (0.5 + 0.5 * rng.random())
+
+
 class AsyncThrottle:
     """asyncio flavor of common/Throttle: bounds in-flight units."""
 
@@ -320,7 +329,9 @@ class Connection:
                         self.messenger.dispatcher.ms_handle_reset, self
                     )
                 return
-            await asyncio.sleep(backoff)
+            await asyncio.sleep(
+                backoff_with_jitter(backoff, self.messenger._rng)
+            )
             backoff = min(backoff * 2, 1.0)
 
     async def _client_handshake(self, stream: _InjectingStream) -> None:

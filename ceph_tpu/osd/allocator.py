@@ -16,9 +16,13 @@ compact), min_alloc_size rounding (bluestore_min_alloc_size), and
 KV batch — the delta discipline FreelistManager's merge ops give the
 reference, sized for a Python dict instead of a bitmap.
 
-The device is a grow-on-demand file, so there is no fixed capacity:
-allocation beyond the current high-water mark extends `size` (persisted
-alongside the rows). `check()` is the fsck cross-check: given every
+The device is a grow-on-demand file by default: allocation beyond the
+current high-water mark extends `size` (persisted alongside the rows).
+An optional `capacity` cap (`blockstore_block_size`) plays the
+fixed-disk role: an allocation that cannot be met from free space plus
+growth headroom raises `StoreError("ENOSPC")` *before* mutating any
+state — clean, un-fenced, and retryable once frees land. `check()` is
+the fsck cross-check: given every
 extent the onodes reference, verify allocated ∪ free tiles [0, size)
 exactly — overlaps and leaks are each reported, never repaired silently.
 """
@@ -26,6 +30,7 @@ exactly — overlaps and leaks are each reported, never repaired silently.
 from __future__ import annotations
 
 from ceph_tpu.common.encoding import Encoder
+from ceph_tpu.osd.objectstore import StoreError
 
 
 def _row_key(off: int) -> bytes:
@@ -36,12 +41,15 @@ def _row_key(off: int) -> bytes:
 class ExtentAllocator:
     """First-fit extent allocator with persistent free-list deltas."""
 
-    def __init__(self, min_alloc_size: int = 4096):
+    def __init__(self, min_alloc_size: int = 4096, capacity: int = 0):
         if min_alloc_size <= 0 or min_alloc_size & (min_alloc_size - 1):
             raise ValueError(
                 f"min_alloc_size must be a power of two, got {min_alloc_size}"
             )
         self.min_alloc_size = min_alloc_size
+        #: hard device-size cap (bytes; the fixed-disk role): allocation
+        #: that would grow past it raises ENOSPC; 0 = grow-on-demand
+        self.capacity = capacity
         #: disjoint, coalesced free extents: offset -> length
         self.free: dict[int, int] = {}
         #: device high-water mark (the grow-on-demand "disk size")
@@ -81,6 +89,18 @@ class ExtentAllocator:
         first-fit spanning across fragments; spanning still beats
         growing the device, which keeps the block file compact."""
         need = self.round_up(length)
+        # capacity gate BEFORE any mutation, so a failed ask leaves the
+        # free map untouched: ENOSPC must be clean and retryable after
+        # frees — never a half-allocated state
+        if self.capacity and need > self.free_bytes() + max(
+            0, self.capacity - self.size
+        ):
+            raise StoreError(
+                "ENOSPC",
+                f"allocating {need} bytes: {self.free_bytes()} free + "
+                f"{max(0, self.capacity - self.size)} growable of a "
+                f"{self.capacity}-byte device",
+            )
         if need:
             for off in sorted(self.free):
                 ln = self.free[off]

@@ -57,11 +57,32 @@ The fast path (BlueStore's cache trio + deferred aging):
 Per-store `PerfCounters` (cache hits/misses, deferred queue depth/age,
 flush latency, device call/segment counts) make the wins observable via
 `perf dump` when a daemon adopts the block.
+
+The device fault layer (the one fault domain `ms_inject_*` can't reach):
+
+  * **injection** — config-driven hooks at the device IO sites, the
+    `_InjectingStream` idiom with its disabled-cost rule (one cached
+    flag check per site): `blockstore_inject_read_eio` /
+    `blockstore_inject_write_eio` / `blockstore_inject_fsync_fail` as
+    1-in-N rates, plus `inject_data_error()` — the `injectdataerr`
+    admin-command analogue — arming a deterministic per-object read EIO
+    that clears when the object is rewritten (so a write-back repair
+    genuinely heals it). Injected counts ride the perf block;
+  * **error taxonomy** (see `StoreError`): a READ error is `EIO` and
+    recoverable above the store — the OSD heals the object from
+    replicas/EC survivors; a WRITE or FSYNC error is `StoreFatalError`
+    and **fences** the store: it flips read-only (`fenced`), refuses
+    every further transaction with `EROFS` so no ack can lie about
+    durability, and fires `on_fatal` so the owning OSD can fail-stop
+    (report itself to the mon and shut down). ENOSPC from a
+    capacity-capped allocator (`blockstore_block_size`) is transient:
+    nothing fences, and frees make the store writable again.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 from collections import OrderedDict
@@ -77,6 +98,7 @@ from ceph_tpu.osd.objectstore import (
     _OMAP,
     KStore,
     StoreError,
+    StoreFatalError,
     _encode_attrs,
     _okey,
     _okey_decode,
@@ -272,7 +294,9 @@ class BlockStore(KStore):
         if geom is not None:
             d = Decoder(geom)
             min_alloc, self.csum_block = int(d.u64()), int(d.u64())
-        self.alloc = ExtentAllocator(min_alloc)
+        self.alloc = ExtentAllocator(
+            min_alloc, capacity=int(config.get("blockstore_block_size"))
+        )
         self.comp_mode = config.get("blockstore_compression_mode")
         self.comp_min = int(
             config.get("blockstore_compression_min_blob_size")
@@ -314,6 +338,30 @@ class BlockStore(KStore):
         self._flusher: threading.Thread | None = None
         self._flusher_stop = threading.Event()
         self._closed = False
+        # device fault layer: cached 1-in-N rates (config-observed so
+        # injectargs flips them live) + the deterministic per-object set;
+        # `_inj_read_armed` folds both into the ONE flag the read hot
+        # path checks (the _InjectingStream disabled-cost rule)
+        self._inj_read_rate = int(config.get("blockstore_inject_read_eio"))
+        self._inj_write_rate = int(
+            config.get("blockstore_inject_write_eio")
+        )
+        self._inj_fsync_rate = int(
+            config.get("blockstore_inject_fsync_fail")
+        )
+        self._inject_read_keys: set[bytes] = set()
+        self._inj_read_armed = bool(self._inj_read_rate)
+        self._inj_rng = random.Random()
+        config.observe("blockstore_inject_read_eio", self._on_inj_read)
+        config.observe("blockstore_inject_write_eio", self._on_inj_write)
+        config.observe("blockstore_inject_fsync_fail", self._on_inj_fsync)
+        #: fail-stop state: a write/fsync device error flips this and the
+        #: store refuses every further transaction with EROFS
+        self._fenced = False
+        #: callback(reason) fired exactly once when the store fences —
+        #: the owning OSD hooks its fail-stop here; may be invoked from
+        #: the flusher thread, so implementations must only schedule
+        self.on_fatal = None
         self.perf = self._make_perf()
         # per-transaction compile state
         self._staged: dict[bytes, tuple[Onode, bytes]] = {}
@@ -351,6 +399,12 @@ class BlockStore(KStore):
                                   "(segments - calls = coalescing win)"),
             ("dev_write_calls", "device pwrite(v) calls issued"),
             ("dev_write_segments", "extents those pwrites covered"),
+            ("inject_read_eio", "reads failed by the fault-injection "
+                                "layer (rate or per-object)"),
+            ("inject_write_eio", "device writes failed by injection "
+                                 "(each one fences the store)"),
+            ("inject_fsync_fail", "device fsyncs failed by injection "
+                                  "(each one fences the store)"),
         ):
             perf.add_u64_counter(key, desc)
         for key, desc in (
@@ -362,6 +416,8 @@ class BlockStore(KStore):
                                 "write at the last queue/flush event"),
             ("buffer_bytes", "bytes held by the buffer cache"),
             ("onode_entries", "onodes held by the LRU"),
+            ("fenced", "1 when a write/fsync error has fenced the store "
+                       "(read-only fail-stop state)"),
         ):
             perf.add_u64(key, desc)
         perf.add_time_avg(
@@ -393,6 +449,81 @@ class BlockStore(KStore):
                 .u64(self.csum_block).bytes(),
             )
             self.db.submit_transaction(kv)
+
+    # -- device fault layer (injection + fail-stop fencing) --------------------
+
+    def _on_inj_read(self, _n, v) -> None:
+        self._inj_read_rate = int(v)
+        self._inj_read_armed = bool(
+            self._inj_read_rate or self._inject_read_keys
+        )
+
+    def _on_inj_write(self, _n, v) -> None:
+        self._inj_write_rate = int(v)
+
+    def _on_inj_fsync(self, _n, v) -> None:
+        self._inj_fsync_rate = int(v)
+
+    @property
+    def fenced(self) -> bool:
+        return self._fenced
+
+    def inject_data_error(self, coll: str, name: str) -> None:
+        """Arm a deterministic read EIO for one object (the reference's
+        `injectdataerr` admin command): every read of it fails until a
+        write rewrites the object — which is exactly what a write-back
+        repair does, so a healed object reads clean again. Drops the
+        object's cached data so the next read really hits the fault."""
+        with self._lock:
+            key = _okey(coll, name)
+            self._inject_read_keys.add(key)
+            self._inj_read_armed = True
+            self._buffer_drop(key)
+
+    def _maybe_inject_read(self, key: bytes, label: str) -> None:
+        """Slow path behind the single `_inj_read_armed` check."""
+        if key in self._inject_read_keys:
+            self.perf.inc("inject_read_eio")
+            raise StoreError(
+                "EIO", f"{label}: injected per-object read error"
+            )
+        rate = self._inj_read_rate
+        if rate and self._inj_rng.randrange(rate) == 0:
+            self.perf.inc("inject_read_eio")
+            raise StoreError(
+                "EIO",
+                f"{label}: injected device read error (1-in-{rate})",
+            )
+
+    def _fatal(self, reason: str) -> None:
+        """Fail-stop: fence the store (no further acks can lie about
+        durability), fire on_fatal ONCE, and raise the fatal error. The
+        callback only schedules (it may run on the flusher thread)."""
+        if not self._fenced:
+            self._fenced = True
+            self.perf.set("fenced", 1)
+            cb = self.on_fatal
+            if cb is not None:
+                try:
+                    cb(reason)
+                except Exception:  # noqa: BLE001 - fencing must proceed
+                    pass
+        raise StoreFatalError("EIO", f"store fenced: {reason}")
+
+    def _device_flush(self) -> None:
+        """The one fsync site: injection check, then the real flush; a
+        failure of either fences the store — an fsync error can never be
+        retried-and-forgotten."""
+        if (
+            self._inj_fsync_rate
+            and self._inj_rng.randrange(self._inj_fsync_rate) == 0
+        ):
+            self.perf.inc("inject_fsync_fail")
+            self._fatal("injected fsync failure")
+        try:
+            self.device.flush()
+        except OSError as e:
+            self._fatal(f"device fsync failed: {e}")
 
     # -- caches ---------------------------------------------------------------
 
@@ -463,6 +594,15 @@ class BlockStore(KStore):
     # -- transaction compilation ----------------------------------------------
 
     def queue_transaction(self, txn) -> None:
+        if self._fenced:
+            # fail-stop contract: a fenced store refuses every write up
+            # front — acking from a store that failed a write/fsync
+            # would lie about durability
+            raise StoreError(
+                "EROFS",
+                "store is fenced after a device write/fsync error; "
+                "refusing writes",
+            )
         sp = None if self.tracer is None else self.tracer.child(
             "blockstore_txn", tags={"ops": len(txn.ops)}
         )
@@ -509,7 +649,7 @@ class BlockStore(KStore):
         # extent can never be rewritten before the free itself commits
         self.alloc.release(self._pending_release)
         self.alloc.flush(kv, _FREE, _BMETA)
-        self.device.flush()  # data durable BEFORE metadata references it
+        self._device_flush()  # data durable BEFORE metadata references it
         self.db.submit_transaction(kv)
         # the batch is durable: fold its effects into the caches (drops
         # first — a remove-then-write of one key re-stages it)
@@ -530,7 +670,14 @@ class BlockStore(KStore):
         self._last_big_n = self._batch_big_n
         self._begin_batch()
         if self._deferred_bytes > self.deferred_batch_bytes:
-            self.flush_deferred()
+            try:
+                self.flush_deferred()
+            except StoreError as e:
+                # the batch ITSELF committed; a full device just leaves
+                # the backlog on the (still authoritative) KV WAL until
+                # frees open headroom — fatal errors still propagate
+                if e.code != "ENOSPC":
+                    raise
 
     def _compile_op(self, kv: KVTransaction, op: tuple) -> None:
         kind = op[0]
@@ -630,6 +777,14 @@ class BlockStore(KStore):
             self.perf.inc("write_big")
         kv.set(_ONODE, key, on.encode())
         self._staged[key] = (on, data)
+        if self._inject_read_keys:
+            # a rewrite replaces the faulty extents, so the armed
+            # per-object error heals with the data (injectdataerr
+            # semantics: repair write-backs read clean afterwards)
+            self._inject_read_keys.discard(key)
+            self._inj_read_armed = bool(
+                self._inj_read_rate or self._inject_read_keys
+            )
 
     def _compile_read(self, coll: str, name: str, key: bytes) -> bytes:
         """Object content as visible to the op being compiled: what an
@@ -670,22 +825,34 @@ class BlockStore(KStore):
 
     def _write_plan(self, plan) -> None:
         """Issue [(device offset, bytes)] writes, coalescing runs that
-        are adjacent on the device into single vectored pwrites."""
+        are adjacent on the device into single vectored pwrites. A device
+        write failure — injected or real — fences the store (fail-stop):
+        the bytes under an extent the metadata is about to reference can
+        no longer be trusted."""
         if not plan:
             return
+        if (
+            self._inj_write_rate
+            and self._inj_rng.randrange(self._inj_write_rate) == 0
+        ):
+            self.perf.inc("inject_write_eio")
+            self._fatal("injected device write error")
         plan = sorted(plan)
         run_off, run = plan[0][0], [plan[0][1]]
         run_end = run_off + len(plan[0][1])
         calls = 0
-        for off, data in plan[1:]:
-            if off == run_end:
-                run.append(data)
-            else:
-                self.device.pwritev(run_off, run)
-                calls += 1
-                run_off, run = off, [data]
-            run_end = off + len(data)
-        self.device.pwritev(run_off, run)
+        try:
+            for off, data in plan[1:]:
+                if off == run_end:
+                    run.append(data)
+                else:
+                    self.device.pwritev(run_off, run)
+                    calls += 1
+                    run_off, run = off, [data]
+                run_end = off + len(data)
+            self.device.pwritev(run_off, run)
+        except OSError as e:
+            self._fatal(f"device write failed: {e}")
         self.perf.inc("dev_write_calls", calls + 1)
         self.perf.inc("dev_write_segments", len(plan))
 
@@ -704,7 +871,7 @@ class BlockStore(KStore):
         moved."""
         with self._lock:
             self._sync_gauges()
-            if self._closed or self._deferred_bytes <= 0:
+            if self._closed or self._fenced or self._deferred_bytes <= 0:
                 return 0
             if self.deferred_max_age <= 0:
                 return 0
@@ -767,6 +934,8 @@ class BlockStore(KStore):
 
     def _flush_deferred_inner(self, sp) -> int:
         with self._lock:
+            if self._fenced:
+                return 0  # rows stay authoritative on the WAL; no acks lie
             t0 = time.perf_counter()
             rows = [(k[1], v) for k, v in self.db.iterate(_DEFER)]
             if not rows:
@@ -799,7 +968,7 @@ class BlockStore(KStore):
                     plan.extend(self._extent_chunks(extents, payload))
                 self._write_plan(plan)
             self.alloc.flush(kv, _FREE, _BMETA)
-            self.device.flush()
+            self._device_flush()
             self.db.submit_transaction(kv)
             for key, on, _payload in moved:
                 if key in self._onode_cache:
@@ -828,7 +997,13 @@ class BlockStore(KStore):
         self._stop_flusher()
         with self._lock:
             if not self._closed:
-                self.flush_deferred()
+                try:
+                    self.flush_deferred()
+                except StoreError:
+                    # full (ENOSPC) or failing device at shutdown: the
+                    # WAL rows stay authoritative and replay on the next
+                    # mount — close must still proceed
+                    pass
             self._closed = True
             self.device.close()
             if hasattr(self.db, "close"):
@@ -919,6 +1094,8 @@ class BlockStore(KStore):
         return data
 
     def _read_payload(self, key: bytes, on: Onode, label: str) -> bytes:
+        if self._inj_read_armed:
+            self._maybe_inject_read(key, label)
         if on.flags & FLAG_INLINE:
             payload = self.db.get(_DEFER, key)
             if payload is None:
@@ -934,7 +1111,14 @@ class BlockStore(KStore):
                     takes.append((off, take))
                 remaining -= take
             runs = _coalesce(takes)
-            parts = [self.device.pread(off, ln) for off, ln in runs]
+            try:
+                parts = [self.device.pread(off, ln) for off, ln in runs]
+            except OSError as e:
+                # a read error is recoverable ABOVE the store (the OSD
+                # heals from replicas/EC survivors): surface, don't fence
+                raise StoreError(
+                    "EIO", f"{label}: device read failed: {e}"
+                ) from e
             self.perf.inc("dev_read_calls", len(runs))
             self.perf.inc("dev_read_segments", len(takes))
             payload = b"".join(parts)

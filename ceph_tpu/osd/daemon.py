@@ -60,7 +60,12 @@ from ceph_tpu.osd.extent_cache import (
     patch_window,
     write_column_intervals,
 )
-from ceph_tpu.osd.objectstore import StoreError, Transaction, create_store
+from ceph_tpu.osd.objectstore import (
+    StoreError,
+    StoreFatalError,
+    Transaction,
+    create_store,
+)
 from ceph_tpu.osd.ops import (
     ObjectState,
     OpError,
@@ -433,6 +438,9 @@ class OSDService(Dispatcher):
             ("recovery_pulls", "objects/shards pulled during peering"),
             ("recovery_sub_bytes",
              "helper bytes read via fractional sub-chunk repair"),
+            ("read_error_repaired",
+             "primary read EIOs healed from replicas/EC survivors "
+             "before the client saw them (rep_repair_primary_object)"),
             ("scrub_errors", "inconsistencies found by scrub"),
             ("heartbeat_failures", "peer failures reported to the mon"),
             ("tier_hit", "cache-pool ops served from the cache"),
@@ -529,6 +537,16 @@ class OSDService(Dispatcher):
             self.config.get("osd_max_backfills")
         )
         self._stopped = False
+        #: fail-stop in progress (a fatal store error fenced us); set
+        #: once so repeated store failures schedule one shutdown
+        self._fencing = False
+        self._loop: asyncio.AbstractEventLoop | None = None
+        # fail-stop contract: a write/fsync device error fences the
+        # store (no further acks can lie about durability) and the
+        # daemon reports itself to the mon + shuts down cleanly — the
+        # callback may fire on the store's flusher thread, so it only
+        # schedules onto our event loop
+        self.store.on_fatal = self._note_store_fatal
         self.mon.on_map_change(self._note_map)
         self._map_dirty = asyncio.Event()
 
@@ -539,6 +557,7 @@ class OSDService(Dispatcher):
         return self.mon.osdmap
 
     async def start(self) -> None:
+        self._loop = asyncio.get_event_loop()
         await self.messenger.bind()
         self.mon.subscribe()
         await self.mon.wait_for_map()
@@ -675,9 +694,16 @@ class OSDService(Dispatcher):
 
     async def stop(self) -> None:
         self._stopped = True
-        for t in list(self._tasks) + list(self._ephemeral):
+        # never cancel the task running stop() itself (the fail-stop
+        # path shuts the daemon down from inside an ephemeral task)
+        cur = asyncio.current_task()
+        tasks = [
+            t for t in list(self._tasks) + list(self._ephemeral)
+            if t is not cur
+        ]
+        for t in tasks:
             t.cancel()
-        for t in list(self._tasks) + list(self._ephemeral):
+        for t in tasks:
             try:
                 await t
             except (asyncio.CancelledError, Exception):
@@ -693,6 +719,41 @@ class OSDService(Dispatcher):
                 if (d := self.dlog.dout(1)) is not None:
                     d(f"osd.{self.id}: store umount failed at stop")
         self.tracer.close()
+
+    # -- fail-stop fencing (the Rebello et al. fsync-error contract) ----------
+
+    def _note_store_fatal(self, reason: str) -> None:
+        """The store fenced itself after a write/fsync device error.
+        May be called from the store's flusher thread mid-lock: only
+        schedule the fail-stop onto the event loop here."""
+        if self._fencing or self._stopped:
+            return
+        self._fencing = True
+        loop = self._loop
+        if loop is None:
+            return  # never started; nothing to tear down
+        loop.call_soon_threadsafe(
+            lambda: self._spawn(self._fail_stop(reason))
+        )
+
+    async def _fail_stop(self, reason: str) -> None:
+        """Fail-stop: the store can no longer promise acks imply
+        durability, so the daemon must go down rather than keep serving
+        (RADOS assumes fail-stop OSDs). Report ourselves to the mon via
+        the existing failure path — heartbeat peers confirm as our pings
+        go silent — then shut down cleanly; the mon marks us down,
+        peering re-targets, and data stays available on the survivors."""
+        if (d := self.dlog.dout(0)) is not None:
+            d(f"osd.{self.id}: store fenced ({reason}); fail-stop: "
+              f"reporting ourselves to the mon and shutting down")
+        try:
+            self.mon.report_failure(self.id)
+        except Exception:  # noqa: BLE001 - peers will report us anyway
+            pass
+        # give the one-way report a beat on the wire before the
+        # messenger dies with the rest of the daemon
+        await asyncio.sleep(0.05)
+        await self.stop()
 
     # -- placement helpers ----------------------------------------------------
 
@@ -2919,10 +2980,17 @@ class OSDService(Dispatcher):
                 raise RuntimeError(f"unknown op {p['op']!r}")
             reply = {"tid": p["tid"], "ok": True, **result}
         except (StoreError, ClsError, OpError) as e:
-            # permanent, client-visible errno (ENOENT/EBUSY/...): the
-            # client surfaces these instead of retrying
-            reply = {"tid": p["tid"], "ok": False, "error": str(e),
-                     "errno": e.code}
+            if isinstance(e, StoreFatalError) or e.code == "EROFS":
+                # fail-stop: our store just fenced (we are about to go
+                # down) — never surface a terminal errno for an op we
+                # could not durably apply; the client retries against
+                # the re-targeted acting set once the mon marks us down
+                reply = {"tid": p["tid"], "ok": False, "error": str(e)}
+            else:
+                # permanent, client-visible errno (ENOENT/EBUSY/...):
+                # the client surfaces these instead of retrying
+                reply = {"tid": p["tid"], "ok": False, "error": str(e),
+                         "errno": e.code}
             reply_raw = b""
         except Exception as e:
             reply = {"tid": p["tid"], "ok": False, "error": str(e)}
@@ -3818,6 +3886,62 @@ class OSDService(Dispatcher):
         if waits:
             await asyncio.gather(*waits)
 
+    async def _recover_read_error(
+        self, pg: PG, acting: list[int], name: str, shard: int | None,
+        entry: dict,
+    ):
+        """Self-healing read (PrimaryLogPG::rep_repair_primary_object):
+        our local copy/shard raised EIO — pull the object from a peer
+        replica (replicated) or reconstruct the lost shard by decoding
+        the survivors (EC), write-back-repair the local copy, and hand
+        the recovered (data, attrs) to the caller so the client op
+        succeeds without ever seeing the error. None when no verified
+        source is reachable (the caller falls back / retries)."""
+        ver = entry["obj_ver"]
+        sname = shard_name(name, shard)
+        # recovery reads are traced at their own rate
+        # (tracer_sample_rate_recovery): a child span when the op is
+        # already sampled, else a fresh root so operators can run
+        # recovery at 100% while steady-state IO stays sampled
+        sp = self.tracer.child("recovery_read")
+        if sp is None:
+            sp = self.tracer.start("recovery_read", op_type="recovery")
+        if sp is not None:
+            sp.set_tag("recovery_read", 1)
+            sp.set_tag("object", f"{pg.pool}/{sname}")
+        try:
+            if shard is None:
+                got = await self._fetch_copy(
+                    pg, sname, ver,
+                    [o for o in self._holders_for(acting, None)
+                     if o != self.id and o not in pg.backfill_targets],
+                )
+            else:
+                got = await self._rebuild_shard(
+                    pg, name, shard, acting, ver, exclude=self.id
+                )
+            if got is None:
+                if sp is not None:
+                    sp.set_tag("error", "no verified source reachable")
+                return None
+            data, attrs = got
+            try:
+                txn = Transaction()
+                self._write_fetched(txn, pg.coll, sname, data, attrs)
+                self.store.queue_transaction(txn)
+            except StoreError:
+                # a store that cannot take the write-back (fenced, full)
+                # still serves the client from the recovered bytes
+                pass
+            self.perf.inc("read_error_repaired")
+            if (d := self.dlog.dout(0)) is not None:
+                d(f"osd.{self.id}: read error on {pg.coll}/{sname} "
+                  f"healed from peers (recovery read, ver {ver})")
+            return data, attrs
+        finally:
+            if sp is not None:
+                sp.finish()
+
     async def _primary_read(
         self, pg: PG, acting: list[int], name: str
     ) -> bytes:
@@ -3831,8 +3955,15 @@ class OSDService(Dispatcher):
                 attrs = self.store.getattrs(pg.coll, name)
                 if attrs.get("ver") == entry["obj_ver"]:
                     return data
-            except StoreError:
-                pass
+            except StoreError as e:
+                if e.code == "EIO":
+                    # at-rest corruption / device read error: heal from
+                    # a replica before the client ever sees it
+                    got = await self._recover_read_error(
+                        pg, acting, name, None, entry
+                    )
+                    if got is not None:
+                        return got[0]
             # local copy missing/stale (self-backfilling primary):
             # serve from any current-version holder instead of wedging
             got = await self._fetch_copy(
@@ -3860,7 +3991,20 @@ class OSDService(Dispatcher):
                     attrs = self.store.getattrs(
                         pg.coll, shard_name(name, pos)
                     )
-                except StoreError:
+                except StoreError as e:
+                    if e.code == "EIO":
+                        # our shard is rotten: reconstruct it from the
+                        # survivors, rewrite it, and serve the read
+                        got = await self._recover_read_error(
+                            pg, acting, name, pos, entry
+                        )
+                        if (
+                            got is not None
+                            and got[1].get("ver") == entry["obj_ver"]
+                        ):
+                            available[pos] = osd
+                            chunks[pos] = got[0]
+                            size = got[1].get("size", size)
                     continue
                 if attrs.get("ver") == entry["obj_ver"]:
                     available[pos] = osd
@@ -4215,6 +4359,34 @@ class OSDService(Dispatcher):
                 result = self.op_tracker.dump_ops_in_flight()
             elif cmd == "dump_historic_ops":
                 result = self.op_tracker.dump_historic_ops()
+            elif cmd == "injectargs":
+                # runtime config overrides (`ceph tell osd.N injectargs`):
+                # flips the fault knobs, tracer rates, etc. live — the
+                # config observers refresh every cached flag, so no
+                # restart is needed to arm/disarm faults mid-run
+                applied = {}
+                for k, v in (p.get("args") or {}).items():
+                    self.config.set(k, v)
+                    applied[k] = self.config.get(k)
+                result = {"applied": applied}
+            elif cmd == "injectdataerr":
+                # deterministic per-object read EIO on OUR copy/shard
+                # (the reference's `injectdataerr` admin command); heals
+                # when the object is rewritten, e.g. by a recovery read
+                pool_id = p["pool"]
+                ps = self.object_pg(pool_id, p["name"])
+                pg = self._pg_of((pool_id, ps))
+                acting, _primary = self.acting_of(pool_id, ps)
+                shard = self._my_shard(pg, acting)
+                sname = shard_name(p["name"], shard)
+                inject = getattr(self.store, "inject_data_error", None)
+                if inject is None:
+                    raise RuntimeError(
+                        f"{self.store.KIND} has no device-fault surface "
+                        "(osd_objectstore=blockstore required)"
+                    )
+                inject(pg.coll, sname)
+                result = {"injected": sname, "coll": pg.coll}
             elif cmd == "scrub":
                 result = await self._scrub(
                     p["pool"], deep=p.get("deep", False)

@@ -47,9 +47,34 @@ _COLL = b"col"  # collection existence rows
 
 
 class StoreError(Exception):
+    """Typed store error — the errno taxonomy the OSD's handling keys on:
+
+      * ``ENOENT`` / ``EEXIST`` — namespace errors, client-visible as-is.
+      * ``ENOSPC`` — allocation failed against a capacity-capped device.
+        Transient by contract: nothing is fenced, reads keep working, and
+        frees make the store writable again.
+      * ``EIO`` on a READ — at-rest corruption or a device read error.
+        Recoverable above the store: the primary heals the object from
+        replicas/EC survivors before the client ever sees it.
+      * ``EIO`` on a WRITE/FSYNC path — raised as `StoreFatalError`: the
+        store can no longer promise that an ack implies durability, so it
+        fences itself (fail-stop) and the owning daemon must go down.
+      * ``EROFS`` — the store is already fenced; every further write is
+        refused up front so no ack can lie about durability.
+    """
+
     def __init__(self, code: str, message: str):
         super().__init__(message)
-        self.code = code  # "ENOENT" | "EEXIST"
+        self.code = code  # "ENOENT" | "EEXIST" | "ENOSPC" | "EIO" | "EROFS"
+
+
+class StoreFatalError(StoreError):
+    """A write-path or fsync device error (the fail-stop class): the
+    lesson of Rebello et al., "Can Applications Recover from fsync
+    Failures?" (ATC '20) layered on RADOS's fail-stop OSD assumption —
+    an fsync error must never be retried-and-forgotten. The store fences
+    itself before raising this; the owning OSD reports itself to the mon
+    and shuts down rather than ack another write."""
 
 
 def _okey(coll: str, name: str, extra: bytes = b"") -> bytes:

@@ -308,7 +308,9 @@ class Objecter(Dispatcher):
         # Dapper-style root span (sampled): covers submit -> completion
         # including every retarget/resend; the context rides the wire
         span = self.tracer.start(
-            "op_submit", tags={"pool": pool_id, "object": name, "op": op}
+            "op_submit",
+            tags={"pool": pool_id, "object": name, "op": op},
+            op_type=op,
         )
         wire_ctx = "" if span is None else span.context().encode()
         try:

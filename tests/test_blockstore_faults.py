@@ -1,0 +1,224 @@
+"""The device fault layer, deterministic tier (no cluster, no clock):
+per-object `injectdataerr` read EIOs and their heal-on-rewrite contract,
+1-in-N rate injection flipped at runtime through config observers (the
+`injectargs` tier), fail-stop write/fsync fencing (EROFS, on_fatal fired
+once, reads keep working), capacity-capped ENOSPC (clean, un-fenced,
+retryable after frees), and the error taxonomy itself."""
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.kv import MemDB
+from ceph_tpu.osd.allocator import ExtentAllocator
+from ceph_tpu.osd.blockstore import BlockStore
+from ceph_tpu.osd.objectstore import (
+    StoreError,
+    StoreFatalError,
+    Transaction,
+)
+
+BIG = 8192  # >= min_alloc: takes the COW device-write path
+
+
+def mkstore(**settings) -> tuple[BlockStore, Config]:
+    cfg = Config()
+    for k, v in settings.items():
+        cfg.set(k, v)
+    st = BlockStore(MemDB(), config=cfg)
+    st.queue_transaction(Transaction().create_collection("c"))
+    return st, cfg
+
+
+def put(st, name, data):
+    st.queue_transaction(Transaction().write("c", name, data))
+
+
+# -- per-object injection (the injectdataerr analogue) ------------------------
+
+def test_injectdataerr_raises_eio_until_rewritten():
+    st, _cfg = mkstore()
+    put(st, "o", b"x" * BIG)
+    assert st.read("c", "o") == b"x" * BIG
+    st.inject_data_error("c", "o")
+    # persistent: every read path fails, including the cached one (the
+    # armed object's buffer entry is dropped so the fault is reachable)
+    for _ in range(2):
+        with pytest.raises(StoreError) as ei:
+            st.read("c", "o")
+        assert ei.value.code == "EIO"
+    with pytest.raises(StoreError):
+        st.read_verify("c", "o")
+    # deep fsck sees the same injected fault the scrub path would
+    assert any(
+        "injected" in e.get("error", "") for e in st.fsck(deep=True)
+    )
+    # other objects are untouched
+    put(st, "other", b"y" * BIG)
+    assert st.read("c", "other") == b"y" * BIG
+    # a rewrite (what a write-back repair does) heals the object
+    put(st, "o", b"z" * BIG)
+    assert st.read("c", "o") == b"z" * BIG
+    assert st.read_verify("c", "o") == b"z" * BIG
+    assert st.fsck(deep=True) == []
+    assert st.perf.dump()["inject_read_eio"] >= 3
+    st.umount()
+
+
+def test_injectdataerr_hits_inline_deferred_payloads_too():
+    st, _cfg = mkstore()
+    put(st, "small", b"s" * 100)  # rides the KV WAL (FLAG_INLINE)
+    st.inject_data_error("c", "small")
+    with pytest.raises(StoreError) as ei:
+        st.read("c", "small")
+    assert ei.value.code == "EIO"
+    put(st, "small", b"t" * 100)
+    assert st.read("c", "small") == b"t" * 100
+    st.umount()
+
+
+# -- rate injection + the runtime (injectargs) tier ---------------------------
+
+def test_rate_read_injection_flips_live_via_config_observer():
+    st, cfg = mkstore()
+    put(st, "o", b"x" * BIG)
+    cfg.set("blockstore_inject_read_eio", 1)  # every device read fails
+    st.drop_caches()
+    with pytest.raises(StoreError) as ei:
+        st.read("c", "o")
+    assert ei.value.code == "EIO"
+    # read_verify bypasses the cache: it must hit the fault as well
+    with pytest.raises(StoreError):
+        st.read_verify("c", "o")
+    # disarm at runtime: the very next read is clean — no restart needed
+    cfg.set("blockstore_inject_read_eio", 0)
+    assert st.read("c", "o") == b"x" * BIG
+    assert not st.fenced  # read faults NEVER fence
+    st.umount()
+
+
+def test_disabled_injection_is_one_cached_flag_check():
+    st, _cfg = mkstore()
+    # the hot-path gate is a single attribute; disabled means falsy so
+    # the slow path (set lookup + rng) is never entered
+    assert st._inj_read_armed is False
+    st.inject_data_error("c", "o")
+    assert st._inj_read_armed is True
+    put(st, "o", b"x" * BIG)  # rewrite clears the last armed key
+    assert st._inj_read_armed is False
+    st.umount()
+
+
+# -- fail-stop fencing --------------------------------------------------------
+
+def test_write_injection_fences_the_store():
+    st, cfg = mkstore()
+    put(st, "keep", b"k" * BIG)
+    fatal = []
+    st.on_fatal = fatal.append
+    cfg.set("blockstore_inject_write_eio", 1)
+    with pytest.raises(StoreFatalError):
+        put(st, "doomed", b"d" * BIG)
+    assert st.fenced
+    assert len(fatal) == 1  # fired exactly once
+    assert st.perf.dump()["fenced"] == 1
+    # fail-stop: every further write is refused up front with EROFS,
+    # so no ack can lie about durability...
+    with pytest.raises(StoreError) as ei:
+        put(st, "more", b"m" * BIG)
+    assert ei.value.code == "EROFS"
+    assert len(fatal) == 1  # ...and on_fatal does not re-fire
+    # ...but the store stays readable (read-only fenced state)
+    assert st.read("c", "keep") == b"k" * BIG
+    assert st.flush_deferred() == 0
+    st.umount()  # clean close of a fenced store must not throw
+
+
+def test_fsync_injection_fences_before_the_commit_point():
+    st, cfg = mkstore()
+    put(st, "keep", b"k" * BIG)
+    cfg.set("blockstore_inject_fsync_fail", 1)
+    with pytest.raises(StoreFatalError):
+        put(st, "doomed", b"d" * BIG)
+    assert st.fenced
+    # the failed batch never reached the KV commit: the doomed object
+    # does not exist, and the earlier commit is intact
+    with pytest.raises(StoreError) as ei:
+        st.read("c", "doomed")
+    assert ei.value.code == "ENOENT"
+    assert st.read("c", "keep") == b"k" * BIG
+    st.umount()
+
+
+def test_deferred_flush_write_error_fences_without_losing_the_wal():
+    st, cfg = mkstore()
+    put(st, "small", b"s" * 100)  # backlog on the KV WAL
+    fatal = []
+    st.on_fatal = fatal.append
+    cfg.set("blockstore_inject_write_eio", 1)
+    with pytest.raises(StoreFatalError):
+        st.flush_deferred()
+    assert st.fenced and fatal
+    # the WAL row stayed authoritative: the payload is still readable
+    assert st.read("c", "small") == b"s" * 100
+    st.umount()
+
+
+# -- ENOSPC: transient by contract --------------------------------------------
+
+def test_enospc_is_clean_unfenced_and_retryable_after_frees():
+    st, _cfg = mkstore(blockstore_block_size=4 * 4096)
+    fatal = []
+    st.on_fatal = fatal.append
+    put(st, "a", b"a" * BIG)
+    put(st, "b", b"b" * BIG)  # device exactly full
+    with pytest.raises(StoreError) as ei:
+        put(st, "c1", b"c" * BIG)
+    assert ei.value.code == "ENOSPC"  # NOT EIO
+    assert not st.fenced and not fatal  # NOT a fence
+    # existing data unaffected; the store still serves reads and
+    # space-freeing writes
+    assert st.read("c", "a") == b"a" * BIG
+    assert st.read("c", "b") == b"b" * BIG
+    st.queue_transaction(Transaction().remove("c", "a"))
+    put(st, "c1", b"c" * BIG)  # frees made it writable again
+    assert st.read("c", "c1") == b"c" * BIG
+    assert st.fsck(deep=True) == []
+    st.umount()
+
+
+def test_enospc_leaves_deferred_backlog_on_the_wal():
+    st, _cfg = mkstore(blockstore_block_size=2 * 4096)
+    put(st, "a", b"a" * BIG)  # device full
+    put(st, "small", b"s" * 100)  # deferred: no allocation yet
+    assert st.read("c", "small") == b"s" * 100
+    with pytest.raises(StoreError) as ei:
+        st.flush_deferred()  # nowhere to land the payload
+    assert ei.value.code == "ENOSPC"
+    assert not st.fenced
+    # the WAL row is still authoritative and readable
+    assert st.read("c", "small") == b"s" * 100
+    # freeing device space lets the same flush succeed
+    st.queue_transaction(Transaction().remove("c", "a"))
+    assert st.flush_deferred() == 1
+    assert st.read("c", "small") == b"s" * 100
+    assert st.fsck(deep=True) == []
+    st.umount()
+
+
+def test_allocator_capacity_gate_mutates_nothing_on_failure():
+    a = ExtentAllocator(4096, capacity=8192)
+    a.allocate(4096)
+    with pytest.raises(StoreError) as ei:
+        a.allocate(8192)
+    assert ei.value.code == "ENOSPC"
+    # the failed ask left no partial state: the remaining block is whole
+    assert a.allocate(4096) == [(4096, 4096)]
+    assert a.size == 8192
+
+
+# -- taxonomy -----------------------------------------------------------------
+
+def test_fatal_errors_are_store_errors_with_eio():
+    e = StoreFatalError("EIO", "boom")
+    assert isinstance(e, StoreError)
+    assert e.code == "EIO"

@@ -45,8 +45,12 @@ def initial_osdmap() -> OSDMap:
 class Cluster:
     """Helper owning mons + osds for one test."""
 
-    def __init__(self, cfg=None):
+    def __init__(self, cfg=None, osd_configs=None):
         self.cfg = cfg or live_config()
+        #: per-OSD Config overrides (osd_id -> Config): fault-injection
+        #: tests arm knobs on ONE daemon without the shared-config object
+        #: arming the whole fleet
+        self.osd_configs = osd_configs or {}
         self.monmap = MonMap(addrs=[("127.0.0.1", 0)] * 3)
         self.mons: list[Monitor] = []
         self.osds: dict[int, OSDService] = {}
@@ -64,8 +68,11 @@ class Cluster:
         for osd_id in range(N_OSDS):
             await self.start_osd(osd_id)
 
-    async def start_osd(self, osd_id: int, db=None) -> OSDService:
-        osd = OSDService(osd_id, self.monmap, db=db, config=self.cfg)
+    async def start_osd(self, osd_id: int, db=None, config=None) -> OSDService:
+        osd = OSDService(
+            osd_id, self.monmap, db=db,
+            config=config or self.osd_configs.get(osd_id) or self.cfg,
+        )
         await osd.start()
         self.osds[osd_id] = osd
         return osd

@@ -1,0 +1,199 @@
+"""The device-fault tier over the LIVE cluster: the one fault domain
+`ms_inject_*` can't reach. Two scenarios from the self-healing contract:
+
+  * read EIO on a primary (per-object `injectdataerr` + the 1-in-N
+    `blockstore_inject_read_eio` rate armed live via `injectargs`):
+    every client read of replicated AND EC objects still succeeds — the
+    primary pulls the object from a replica / reconstructs the shard
+    from survivors, write-back-repairs its local copy, and serves the
+    op; `read_error_repaired` climbs on the injected OSDs and a
+    subsequent deep scrub is CLEAN (the repair really rewrote the bad
+    extent/shard, which is what clears the armed fault);
+  * an injected fsync failure (the kill-free thrash variant): the store
+    fences (fail-stop, EROFS locally), the OSD reports itself to the
+    mon and shuts down, heartbeat peers confirm, the mon marks it down
+    within the grace, peering re-targets, every previously-acked byte
+    stays readable from the survivors, and new writes keep landing.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.osd.objectstore import StoreError, Transaction
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import (
+    EC_POOL,
+    N_OSDS,
+    REP_POOL,
+    Cluster,
+    live_config,
+    wait_until,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 300))
+
+
+def fault_config():
+    cfg = live_config()
+    cfg.set("osd_objectstore", "blockstore")
+    # every read must reach the device (not the buffer cache) so the
+    # injected device faults are actually on the read path
+    cfg.set("blockstore_buffer_cache_bytes", 0)
+    return cfg
+
+
+def fault_cluster() -> Cluster:
+    # one Config PER OSD: arming a fault knob on one daemon must not arm
+    # the fleet (the shared-config object is observed by every store)
+    return Cluster(
+        cfg=fault_config(),
+        osd_configs={i: fault_config() for i in range(N_OSDS)},
+    )
+
+
+@pytest.mark.slow
+def test_live_read_eio_self_healing_and_clean_scrub():
+    async def main():
+        cluster = fault_cluster()
+        await cluster.start()
+        rados = Rados("client.heal", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        rep = rados.io_ctx(REP_POOL)
+        ec = rados.io_ctx(EC_POOL)
+
+        payloads = {}
+        for i in range(8):
+            payloads[("r", i)] = bytes([65 + i]) * (8192 + 37 * i)
+            await rep.write_full(f"r{i}", payloads[("r", i)])
+            payloads[("e", i)] = bytes([97 + i]) * (8192 + 53 * i)
+            await ec.write_full(f"e{i}", payloads[("e", i)])
+
+        # arm a deterministic read EIO on every object AT ITS PRIMARY
+        # (the injectdataerr admin command), plus the 1-in-N rate knob
+        # live on one OSD via injectargs — no restart
+        calc = rados.objecter._calc_target
+        injected = set()
+        for i in range(8):
+            for pool, pref in ((REP_POOL, "r"), (EC_POOL, "e")):
+                primary = calc(pool, f"{pref}{i}")
+                await rados.objecter.osd_admin(
+                    primary, "injectdataerr",
+                    {"pool": pool, "name": f"{pref}{i}"},
+                )
+                injected.add(primary)
+        victim = calc(REP_POOL, "r0")
+        got = await rados.objecter.osd_admin(
+            victim, "injectargs",
+            {"args": {"blockstore_inject_read_eio": 4}},
+        )
+        assert got["applied"]["blockstore_inject_read_eio"] == 4
+
+        # every read succeeds: replicated objects heal from a replica,
+        # EC objects reconstruct the rotten shard from the survivors
+        for i in range(8):
+            assert await rep.read(f"r{i}") == payloads[("r", i)]
+            assert await ec.read(f"e{i}") == payloads[("e", i)]
+
+        repaired = sum(
+            cluster.osds[o].perf.dump()["read_error_repaired"]
+            for o in injected
+        )
+        # one heal per armed object at minimum (16 objects), plus
+        # whatever the rate knob added on the victim
+        assert repaired >= 16, repaired
+        assert (
+            cluster.osds[victim].perf.dump()["read_error_repaired"] > 0
+        )
+        # the store-side counters surfaced the injections too
+        assert (
+            cluster.osds[victim].store.perf.dump()["inject_read_eio"] > 0
+        )
+
+        # disarm the rate knob (injectargs again), then a deep scrub of
+        # every PG must be CLEAN: the write-back repairs really rewrote
+        # the bad extents/shards — nothing armed, nothing rotten remains
+        await rados.objecter.osd_admin(
+            victim, "injectargs",
+            {"args": {"blockstore_inject_read_eio": 0}},
+        )
+        for pool in (REP_POOL, EC_POOL):
+            for osd in sorted(cluster.osds):
+                rep_scrub = await rados.objecter.osd_admin(
+                    osd, "scrub", {"pool": pool, "deep": True},
+                    timeout=60.0,
+                )
+                assert rep_scrub["errors"] == [], (pool, osd, rep_scrub)
+
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_live_fsync_failure_fences_and_cluster_heals():
+    async def main():
+        cluster = fault_cluster()
+        await cluster.start()
+        rados = Rados("client.fence", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        rep = rados.io_ctx(REP_POOL)
+        ec = rados.io_ctx(EC_POOL)
+
+        model = {}
+        for i in range(8):
+            model[(REP_POOL, f"f{i}")] = bytes([48 + i]) * (8192 + 31 * i)
+            await rep.write_full(f"f{i}", model[(REP_POOL, f"f{i}")])
+            model[(EC_POOL, f"f{i}")] = bytes([80 + i]) * (8192 + 41 * i)
+            await ec.write_full(f"f{i}", model[(EC_POOL, f"f{i}")])
+
+        victim = rados.objecter._calc_target(REP_POOL, "f0")
+        vosd = cluster.osds[victim]
+        await rados.objecter.osd_admin(
+            victim, "injectargs",
+            {"args": {"blockstore_inject_fsync_fail": 1}},
+        )
+
+        # the next write through the victim trips the fault BEFORE its
+        # commit point: the victim fences + fail-stops, the client
+        # retries, and the op lands on the re-targeted acting set
+        new = b"v2" * 4096
+        await rados.objecter.op_submit(
+            REP_POOL, "f0", "write", new, timeout=120.0
+        )
+        model[(REP_POOL, "f0")] = new
+
+        # fail-stop observed end to end: fenced store refuses writes
+        # locally, daemon took itself down, mon marked it down
+        def leader():
+            return next(m for m in cluster.mons if m.is_leader)
+
+        await wait_until(lambda: vosd.store.fenced, timeout=30)
+        with pytest.raises(StoreError) as ei:
+            vosd.store.queue_transaction(
+                Transaction().write("pg_1_0", "x", b"x")
+            )
+        assert ei.value.code == "EROFS"
+        await wait_until(lambda: vosd._stopped, timeout=30)
+        await wait_until(
+            lambda: leader().osdmap.is_down(victim), timeout=30
+        )
+
+        # every previously-acked byte is still readable from survivors
+        for (pool, name), want in sorted(model.items()):
+            got = await rados.io_ctx(pool).read(name)
+            assert got == want, (pool, name)
+
+        # and the cluster keeps taking writes on the re-targeted sets
+        await rep.write_full("g0", b"after" * 2000)
+        assert await rep.read("g0") == b"after" * 2000
+
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())

@@ -256,3 +256,30 @@ def test_dispatch_backpressure_bounds_inflight_bytes():
         await server.shutdown()
 
     run(main())
+
+
+# -- reconnect backoff jitter -------------------------------------------------
+
+def test_reconnect_backoff_jitter_bounds():
+    """A fenced/killed daemon's peers must not reconnect in lockstep:
+    each attempt sleeps uniformly in [backoff/2, backoff], the doubling
+    schedule stays capped at 1.0s, and two peers draw different sleeps
+    (the thundering-herd stagger)."""
+    import random
+
+    from ceph_tpu.msg.messenger import backoff_with_jitter
+
+    rng = random.Random(7)
+    backoff = 0.01
+    while True:
+        samples = [backoff_with_jitter(backoff, rng) for _ in range(200)]
+        assert all(backoff / 2 <= s <= backoff for s in samples)
+        assert len({round(s, 9) for s in samples}) > 100  # real spread
+        if backoff >= 1.0:
+            break
+        backoff = min(backoff * 2, 1.0)
+    assert backoff == 1.0  # the cap is the ceiling of the schedule
+
+    # two peers on the same schedule desynchronize immediately
+    a, b = random.Random(1), random.Random(2)
+    assert backoff_with_jitter(0.5, a) != backoff_with_jitter(0.5, b)

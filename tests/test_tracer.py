@@ -66,6 +66,40 @@ def test_sample_rate_zero_samples_nothing():
     assert all(tr.start("op") is None for _ in range(50))
 
 
+def test_per_op_type_rate_overrides_base():
+    """tracer_sample_rate_<optype>: recovery reads trace at 100% while
+    steady-state IO (base rate 0) stays unsampled; types without an
+    override inherit the base."""
+    tr = Tracer("osd.0", config=traced_config(
+        tracer_sample_rate=0.0, tracer_sample_rate_recovery=1.0,
+    ))
+    for _ in range(20):
+        sp = tr.start("recovery_read", op_type="recovery")
+        assert sp is not None
+        sp.finish()
+        assert tr.start("op_submit", op_type="read") is None  # inherits 0
+        assert tr.start("op_submit") is None  # untyped inherits too
+
+
+def test_per_op_type_rate_flips_at_runtime():
+    """The injectargs tier: flipping the override live retargets the
+    very next root; -1 returns the type to inheriting the base rate."""
+    cfg = traced_config(tracer_sample_rate=1.0)
+    tr = Tracer("osd.0", config=cfg)
+    assert tr.start("op", op_type="write") is not None  # inherits 1.0
+    cfg.set("tracer_sample_rate_write", 0.0)
+    assert all(
+        tr.start("op", op_type="write") is None for _ in range(20)
+    )
+    sp = tr.start("op", op_type="read")  # other types unaffected
+    assert sp is not None
+    sp.finish()
+    cfg.set("tracer_sample_rate_write", -1.0)  # back to inheriting
+    sp = tr.start("op", op_type="write")
+    assert sp is not None
+    sp.finish()
+
+
 def test_ring_is_bounded_and_drained_by_dump():
     tr = Tracer("osd.1", config=traced_config(tracer_ring_size=4))
     for i in range(10):
