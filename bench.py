@@ -249,6 +249,35 @@ def _trace_overhead_line() -> None:
         pass
 
 
+def _ckpt_line() -> None:
+    """Optional JSON line: checkpoint save/restore GB/s through the full
+    stack (CkptStore -> RADOS client -> OSD daemons -> EC encode), via
+    tools/ckpt_tool.py's in-process bench. Guarded (--ckpt /
+    CEPH_TPU_BENCH_CKPT=1) and non-fatal."""
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            [sys.executable, "tools/ckpt_tool.py", "bench",
+             "--mb", os.environ.get("CEPH_TPU_BENCH_CKPT_MB", "16"),
+             "--pool-kind", "ec"],
+            capture_output=True, timeout=600, check=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        r = json.loads(out.stdout.strip().splitlines()[-1])
+        print(json.dumps({
+            "metric": "ckpt_save_throughput",
+            "value": r["save_gbps"],
+            "unit": "GB/s",
+            "restore_gbps": r["restore_gbps"],
+            "bytes": r["bytes"],
+            "chunks": r["chunks"],
+            "pool": r["pool"],
+        }))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def main() -> None:
     import jax
 
@@ -297,6 +326,8 @@ def main() -> None:
         "CEPH_TPU_BENCH_FAULT"
     ):
         _fault_overhead_line()
+    if "--ckpt" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_CKPT"):
+        _ckpt_line()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,27 @@
+"""ceph_tpu.ckpt: crash-consistent, sharding-aware training-checkpoint
+store over RADOS (the framework's Orbax/TensorStore role).
+
+A checkpoint is a pytree of arrays laid out as:
+
+  <name>@<save_id>.%016x       fixed-size chunk objects (striper naming),
+                               EC-full-stripe aligned, per-chunk crc32c
+  <name>@<save_id>.manifest    the deterministic manifest (layout.py)
+  <name>.ckpt-head             HEAD pointer, advanced by an in-OSD
+                               compare-and-swap (cls ckpt.cas_head)
+
+Commit order is chunks -> manifest -> HEAD CAS, so a crash at ANY instant
+leaves the previous complete checkpoint restorable; `gc` reclaims the
+orphans of aborted saves. Restore is sharding-aware: each host fetches
+only the byte ranges its addressable shards need and a checkpoint saved
+under one device mesh restores under a different device count
+(reshard-on-load via parallel/sharding.py).
+"""
+
+from ceph_tpu.ckpt.layout import (  # noqa: F401
+    build_manifest,
+    chunk_object_name,
+    head_object,
+    manifest_object,
+    pool_alignment,
+)
+from ceph_tpu.ckpt.store import CkptStore  # noqa: F401

@@ -1,0 +1,81 @@
+"""Checkpoint garbage collection: reclaim orphans of aborted saves.
+
+A saver that died before its HEAD CAS leaves `<name>@<save_id>.*`
+objects that no pointer references. GC enumerates the pool (the PGLS
+primitive, `pg ls` on every up OSD), keeps everything belonging to the
+committed HEAD save (plus any save_ids the caller pins), and removes the
+rest. Removal is idempotent and crash-safe: a half-finished gc just
+leaves fewer orphans for the next pass.
+
+The one documented race: a save that is between put_chunks and commit
+when gc runs looks orphaned. gc is an operator/ckpt_tool action, not a
+background loop, so the operator serializes it against in-flight saves
+(the reference's rados-level gc tools share this contract).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.ckpt import layout
+from ceph_tpu.rados.client import ObjectNotFound, RadosError
+
+
+def save_id_of(obj: str, name: str) -> str | None:
+    """The save_id of a `<name>@<save_id>[...]` object, else None."""
+    prefix = f"{name}@"
+    if not obj.startswith(prefix):
+        return None
+    rest = obj[len(prefix):]
+    return rest.split(".", 1)[0]
+
+
+async def list_objects(ioctx, prefix: str = "") -> list[str]:
+    """Pool enumeration via PGLS on every up OSD (each reports the head
+    objects of the PGs it leads; the union covers the pool)."""
+    objecter = ioctx.objecter
+    osdmap = objecter.osdmap
+    names: set[str] = set()
+
+    async def ls(osd: int) -> None:
+        try:
+            rep = await objecter.osd_admin(
+                osd, "pg ls", {"pool": ioctx.pool_id}
+            )
+        except (RadosError, asyncio.TimeoutError):
+            return  # a down/slow OSD's PGs have failed over; peers report
+        names.update(rep.get("objects", []))
+
+    await asyncio.gather(*(
+        ls(osd) for osd in range(osdmap.max_osd) if osdmap.osd_up[osd]
+    ))
+    return sorted(n for n in names if n.startswith(prefix))
+
+
+async def collect(ioctx, name: str, *, keep=(), perf=None) -> dict:
+    """Remove every `<name>@*` object whose save_id is neither HEAD nor
+    pinned in `keep`. Returns {"head", "removed", "kept"}."""
+    keep_ids = set(keep)
+    try:
+        raw = await ioctx.read(layout.head_object(name))
+        head_id = json.loads(raw.decode()).get("save_id")
+    except ObjectNotFound:
+        head_id = None
+    if head_id is not None:
+        keep_ids.add(head_id)
+
+    removed, kept = [], []
+    for obj in await list_objects(ioctx, prefix=f"{name}@"):
+        sid = save_id_of(obj, name)
+        if sid in keep_ids:
+            kept.append(obj)
+            continue
+        try:
+            await ioctx.remove(obj)
+            removed.append(obj)
+        except ObjectNotFound:
+            pass  # lost a race with another gc; already gone
+    if perf is not None:
+        perf.inc("gc_removed", len(removed))
+    return {"head": head_id, "removed": removed, "kept": kept}

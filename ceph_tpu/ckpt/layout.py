@@ -1,0 +1,225 @@
+"""Checkpoint layout: pytree -> deterministic manifest + chunk table.
+
+The manifest records, per array, dtype/shape/PartitionSpec and the byte
+offset of its row-major serialization in one concatenated stream; the
+stream is cut into fixed-size chunk objects whose size is rounded UP to a
+full EC stripe (k * stripe_unit) so chunk puts on EC pools are whole-
+object, whole-stripe writes — never a read-modify-write. Chunk objects
+reuse the striper's `<soid>.%016x` naming (rados/striper.py contract,
+property-tested in tests/test_striper.py) with soid = `<name>@<save_id>`.
+
+Everything here is pure and deterministic: the same pytree + save_id
+yields byte-identical manifests, which is what makes `verify` and the
+crash-consistency story auditable.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ceph_tpu.rados.striper import object_name
+
+FORMAT = 1
+#: replicated pools have no stripe constraint; align to the allocator page
+MIN_ALIGN = 4096
+
+
+def head_object(name: str) -> str:
+    return f"{name}.ckpt-head"
+
+
+def save_soid(name: str, save_id: str) -> str:
+    return f"{name}@{save_id}"
+
+
+def manifest_object(name: str, save_id: str) -> str:
+    return f"{save_soid(name, save_id)}.manifest"
+
+
+def chunk_object_name(name: str, save_id: str, index: int) -> str:
+    """Chunk `index` of one save: the striper's `%016x` convention."""
+    return object_name(save_soid(name, save_id), index)
+
+
+def pool_alignment(osdmap, pool_id: int) -> int:
+    """Chunk-size alignment for a pool: a full EC stripe (k data chunks
+    of stripe_unit each) so every chunk put encodes whole stripes, or
+    the allocator page for replicated pools."""
+    pool = osdmap.pools[pool_id]
+    profile = osdmap.erasure_code_profiles.get(
+        getattr(pool, "erasure_code_profile", "") or ""
+    )
+    if not profile:
+        return MIN_ALIGN
+    k = int(profile.get("k", 1))
+    stripe_unit = int(profile.get("stripe_unit", 1 << 16))
+    return max(k * stripe_unit, MIN_ALIGN)
+
+
+def chunk_bytes(target: int, alignment: int) -> int:
+    """Round the configured chunk target UP to the pool alignment."""
+    target = max(int(target), 1)
+    return ((target + alignment - 1) // alignment) * alignment
+
+
+# -- pytree <-> flat paths ----------------------------------------------------
+#
+# Paths serialize as [["k", key] | ["i", index], ...] so restore can
+# rebuild dict/list/tuple nests without a pickled treedef (the manifest
+# stays JSON, inspectable by ckpt_tool).
+
+
+def _path_entries(path) -> list:
+    from jax.tree_util import DictKey, GetAttrKey, SequenceKey
+
+    out = []
+    for entry in path:
+        if isinstance(entry, DictKey):
+            out.append(["k", entry.key])
+        elif isinstance(entry, SequenceKey):
+            out.append(["i", entry.idx])
+        elif isinstance(entry, GetAttrKey):
+            out.append(["k", entry.name])
+        else:  # FlattenedIndexKey and friends
+            out.append(["i", getattr(entry, "key", 0)])
+    return out
+
+
+def _spec_of(leaf):
+    """The leaf's PartitionSpec as JSON (None | str | [str...] entries),
+    or None for unsharded/replicated arrays."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append(list(entry))
+        else:
+            out.append(entry)
+    return out
+
+
+def flatten_tree(tree) -> list[dict]:
+    """Pytree -> ordered leaf records {path, dtype, shape, spec, leaf}.
+
+    Order is jax's flatten order (deterministic per structure); arrays
+    stay as-is — serialization happens in the writer so sharded jax
+    arrays are gathered at most once."""
+    from jax.tree_util import tree_flatten_with_path
+
+    leaves, _ = tree_flatten_with_path(tree)
+    records = []
+    for path, leaf in leaves:
+        arr = np.asarray(leaf) if np.isscalar(leaf) else leaf
+        records.append({
+            "path": _path_entries(path),
+            "dtype": np.dtype(arr.dtype).str,
+            "shape": [int(d) for d in arr.shape],
+            "spec": _spec_of(leaf),
+            "leaf": leaf,
+        })
+    return records
+
+
+def unflatten(records: list[tuple[list, object]]):
+    """[(path_entries, value)] -> the nested dict/list/tuple structure.
+    Lists are rebuilt as lists (tuple-ness is not round-tripped; training
+    states are dict-of-dict pytrees in practice)."""
+    if not records:
+        return {}
+    if records == [([], records[0][1])]:
+        return records[0][1]
+    root: dict | list = [] if records[0][0][0][0] == "i" else {}
+
+    def put(container, entries, value):
+        kind, key = entries[0]
+        if len(entries) == 1:
+            if kind == "i":
+                while len(container) <= key:
+                    container.append(None)
+                container[key] = value
+            else:
+                container[key] = value
+            return
+        nxt_kind = entries[1][0]
+        if kind == "i":
+            while len(container) <= key:
+                container.append(None)
+            if container[key] is None:
+                container[key] = [] if nxt_kind == "i" else {}
+            put(container[key], entries[1:], value)
+        else:
+            if key not in container:
+                container[key] = [] if nxt_kind == "i" else {}
+            put(container[key], entries[1:], value)
+
+    for entries, value in records:
+        put(root, entries, value)
+    return root
+
+
+# -- manifest -----------------------------------------------------------------
+
+
+def build_manifest(
+    name: str,
+    save_id: str,
+    records: list[dict],
+    *,
+    chunk_size: int,
+    compress: str = "",
+) -> dict:
+    """The array table + chunk table (crc/stored fields filled by the
+    writer as chunks go out)."""
+    arrays, offset = [], 0
+    for r in records:
+        nbytes = int(np.dtype(r["dtype"]).itemsize * int(np.prod(r["shape"], dtype=np.int64)))
+        arrays.append({
+            "path": r["path"],
+            "dtype": r["dtype"],
+            "shape": r["shape"],
+            "spec": r["spec"],
+            "offset": offset,
+            "nbytes": nbytes,
+        })
+        offset += nbytes
+    stream = offset
+    n_chunks = (stream + chunk_size - 1) // chunk_size if stream else 0
+    chunks = []
+    for i in range(n_chunks):
+        off = i * chunk_size
+        chunks.append({
+            "object": chunk_object_name(name, save_id, i),
+            "offset": off,
+            "length": min(chunk_size, stream - off),
+            "crc": None,        # crc32c of the uncompressed payload
+            "stored": None,     # bytes on the wire (== length uncompressed)
+            "compressed": False,
+        })
+    return {
+        "format": FORMAT,
+        "name": name,
+        "save_id": save_id,
+        "chunk_bytes": chunk_size,
+        "compress": compress,
+        "stream_bytes": stream,
+        "arrays": arrays,
+        "chunks": chunks,
+    }
+
+
+def encode_manifest(manifest: dict) -> bytes:
+    return json.dumps(manifest, sort_keys=True).encode()
+
+
+def decode_manifest(raw: bytes) -> dict:
+    m = json.loads(raw.decode())
+    if m.get("format") != FORMAT:
+        raise ValueError(f"unsupported manifest format {m.get('format')!r}")
+    return m

@@ -1,0 +1,105 @@
+"""CkptStore: the user-facing checkpoint handle (save/restore/ls/
+verify/gc over one IoCtx + checkpoint name), with the per-store perf
+block the acceptance tests and ckpt_tool read."""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.ckpt import gc as gc_mod
+from ceph_tpu.ckpt import layout
+from ceph_tpu.ckpt.reader import CkptReader
+from ceph_tpu.ckpt.writer import CkptWriter
+from ceph_tpu.common.perf_counters import PerfCounters
+from ceph_tpu.rados.client import ObjectNotFound
+
+
+class CkptStore:
+    def __init__(self, ioctx, name: str, *, config=None):
+        self.ioctx = ioctx
+        self.name = name
+        self.config = config if config is not None else ioctx.objecter.config
+        self.perf = self._make_perf(name)
+
+    @staticmethod
+    def _make_perf(name: str) -> PerfCounters:
+        p = PerfCounters(f"ckpt.{name}")
+        p.add_u64_counter("save_bytes", "logical bytes written by saves")
+        p.add_u64_counter("save_chunks", "chunk objects written")
+        p.add_u64_counter("save_commits", "HEAD CAS commits")
+        p.add_u64_counter("restore_bytes", "logical bytes restored")
+        p.add_u64_counter(
+            "restore_read_bytes",
+            "bytes actually fetched from RADOS (partial-read savings "
+            "show up here)",
+        )
+        p.add_u64_counter("gc_removed", "orphaned objects reclaimed")
+        p.add_u64("inflight_peak", "peak concurrent chunk ops")
+        p.add_time_avg("save_latency", "wall time per save()")
+        p.add_time_avg("restore_latency", "wall time per restore()")
+        return p
+
+    # -- write path ------------------------------------------------------------
+
+    def writer(self, tree, *, save_id: str | None = None) -> CkptWriter:
+        """A staged writer (prepare/put_chunks/put_manifest/commit) —
+        the crash-consistency tests drive the stages directly."""
+        return CkptWriter(
+            self.ioctx, self.name, tree,
+            save_id=save_id, config=self.config, perf=self.perf,
+        )
+
+    async def save(self, tree, *, save_id: str | None = None) -> str:
+        return await self.writer(tree, save_id=save_id).save()
+
+    # -- read path -------------------------------------------------------------
+
+    def reader(self) -> CkptReader:
+        return CkptReader(
+            self.ioctx, self.name, config=self.config, perf=self.perf
+        )
+
+    async def restore(self, *, mesh=None, save_id: str | None = None):
+        return await self.reader().restore(mesh=mesh, save_id=save_id)
+
+    async def head(self) -> dict | None:
+        try:
+            raw = await self.ioctx.read(layout.head_object(self.name))
+        except ObjectNotFound:
+            return None
+        return json.loads(raw.decode())
+
+    async def ls(self) -> dict:
+        """Every save_id present in the pool for this name, annotated
+        with HEAD/manifest status (aborted saves show committed=False)."""
+        head = await self.head()
+        head_id = None if head is None else head.get("save_id")
+        saves: dict[str, dict] = {}
+        for obj in await gc_mod.list_objects(
+            self.ioctx, prefix=f"{self.name}@"
+        ):
+            sid = gc_mod.save_id_of(obj, self.name)
+            entry = saves.setdefault(
+                sid, {"save_id": sid, "objects": 0, "manifest": False}
+            )
+            entry["objects"] += 1
+            if obj == layout.manifest_object(self.name, sid):
+                entry["manifest"] = True
+        for sid, entry in saves.items():
+            entry["committed"] = sid == head_id
+        return {
+            "name": self.name,
+            "head": head_id,
+            "saves": sorted(saves.values(), key=lambda e: e["save_id"]),
+        }
+
+    async def verify(self, save_id: str | None = None) -> dict:
+        return await self.reader().verify(save_id)
+
+    async def gc(self, *, keep=()) -> dict:
+        return await gc_mod.collect(
+            self.ioctx, self.name, keep=keep, perf=self.perf
+        )
+
+    def perf_dump(self) -> dict:
+        return self.perf.dump()

@@ -1,0 +1,204 @@
+"""Checkpoint writer: parallel chunk puts + atomic HEAD commit.
+
+The save is staged so crash-consistency is testable at every boundary:
+
+  prepare()       pytree -> manifest + serialized stream (no IO)
+  put_chunks()    bounded-window parallel `write_full` per chunk, each
+                  crc32c'd (and optionally compressed) before send
+  put_manifest()  the manifest object
+  commit()        compare-and-swap of the HEAD pointer (cls ckpt.cas_head
+                  inside the primary) — THE commit point
+
+`save()` runs all four under one traced root. Dying before commit()
+(the kill -9 window) leaves HEAD on the previous complete checkpoint;
+the new save's chunks are orphans for gc.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+
+import numpy as np
+
+from ceph_tpu.ckpt import layout
+from ceph_tpu.common.compressor import factory as compressor_factory
+from ceph_tpu.common.crc import ceph_crc32c
+from ceph_tpu.rados.client import ObjectNotFound, RadosError
+
+
+class CkptConflict(RadosError):
+    """Another saver advanced HEAD between our read and our CAS."""
+
+
+class CkptWriter:
+    def __init__(self, ioctx, name: str, tree, *, save_id: str | None = None,
+                 config=None, perf=None):
+        self.ioctx = ioctx
+        self.name = name
+        self.tree = tree
+        self.config = config if config is not None else ioctx.objecter.config
+        self.perf = perf
+        self.save_id = save_id or uuid.uuid4().hex[:16]
+        self.manifest: dict | None = None
+        self._stream: bytes | None = None
+        alg = self.config.get("ckpt_compression_algorithm")
+        self._compressor = compressor_factory(alg) if alg else None
+
+    @property
+    def tracer(self):
+        return self.ioctx.objecter.tracer
+
+    # -- stage 1: layout (pure) ----------------------------------------------
+
+    def prepare(self) -> dict:
+        records = layout.flatten_tree(self.tree)
+        alignment = layout.pool_alignment(
+            self.ioctx.objecter.osdmap, self.ioctx.pool_id
+        )
+        chunk_size = layout.chunk_bytes(
+            self.config.get("ckpt_chunk_target_bytes"), alignment
+        )
+        self.manifest = layout.build_manifest(
+            self.name, self.save_id, records,
+            chunk_size=chunk_size,
+            compress=self.config.get("ckpt_compression_algorithm"),
+        )
+        # one gather per sharded leaf; row-major bytes, manifest order
+        self._stream = b"".join(
+            np.asarray(r["leaf"]).tobytes() for r in records
+        )
+        assert len(self._stream) == self.manifest["stream_bytes"]
+        return self.manifest
+
+    # -- stage 2: chunk puts --------------------------------------------------
+
+    async def put_chunks(self) -> None:
+        assert self.manifest is not None, "call prepare() first"
+        window = asyncio.Semaphore(
+            max(1, self.config.get("ckpt_max_inflight"))
+        )
+        inflight = 0
+
+        async def put(chunk: dict) -> None:
+            nonlocal inflight
+            async with window:
+                inflight += 1
+                if self.perf is not None:
+                    self.perf.set_max("inflight_peak", inflight)
+                try:
+                    await self._put_one(chunk)
+                finally:
+                    inflight -= 1
+
+        await asyncio.gather(
+            *(put(c) for c in self.manifest["chunks"])
+        )
+
+    async def _put_one(self, chunk: dict) -> None:
+        payload = self._stream[
+            chunk["offset"]:chunk["offset"] + chunk["length"]
+        ]
+        chunk["crc"] = ceph_crc32c(0xFFFFFFFF, payload)
+        if self._compressor is not None:
+            compressed, payload = self._compressor.maybe_compress(payload)
+            chunk["compressed"] = bool(compressed)
+        chunk["stored"] = len(payload)
+        span = self.tracer.child(
+            "chunk_put",
+            tags={"object": chunk["object"], "bytes": len(payload)},
+        )
+        token = self.tracer.use(span) if span is not None else None
+        try:
+            await self.ioctx.write_full(chunk["object"], payload)
+        finally:
+            if span is not None:
+                self.tracer.release(token)
+                span.finish()
+        if self.perf is not None:
+            self.perf.inc("save_chunks")
+            self.perf.inc("save_bytes", chunk["length"])
+
+    # -- stage 3: manifest -----------------------------------------------------
+
+    async def put_manifest(self) -> None:
+        assert self.manifest is not None
+        await self.ioctx.write_full(
+            layout.manifest_object(self.name, self.save_id),
+            layout.encode_manifest(self.manifest),
+        )
+
+    # -- stage 4: HEAD CAS (the commit point) ---------------------------------
+
+    async def read_head(self):
+        """Current HEAD save_id, or None before the first commit."""
+        import json
+
+        try:
+            raw = await self.ioctx.read(layout.head_object(self.name))
+        except ObjectNotFound:
+            return None
+        return json.loads(raw.decode()).get("save_id")
+
+    _UNSET = object()
+
+    async def commit(self, expect=_UNSET) -> str:
+        """CAS the HEAD pointer to this save. `expect` pins the HEAD the
+        caller observed (lost-update guard for concurrent savers); by
+        default the current HEAD is read just before the swap."""
+        assert self.manifest is not None
+        if expect is self._UNSET:
+            expect = await self.read_head()
+        head = {
+            "name": self.name,
+            "save_id": self.save_id,
+            "manifest": layout.manifest_object(self.name, self.save_id),
+            "stream_bytes": self.manifest["stream_bytes"],
+            "chunks": len(self.manifest["chunks"]),
+        }
+        try:
+            await self.ioctx.exec(
+                layout.head_object(self.name), "ckpt", "cas_head",
+                {"expect": expect, "head": head},
+            )
+        except RadosError as e:
+            if "ECANCELED" in str(e):
+                raise CkptConflict(str(e)) from e
+            raise
+        if self.perf is not None:
+            self.perf.inc("save_commits")
+        return self.save_id
+
+    # -- the whole save, traced ------------------------------------------------
+
+    async def save(self) -> str:
+        span = self.tracer.start(
+            "ckpt_save",
+            tags={"name": self.name, "save_id": self.save_id},
+            op_type="write",
+        )
+        token = self.tracer.use(span) if span is not None else None
+        try:
+            if self.manifest is None:
+                self.prepare()
+            if self.perf is not None:
+                with self.perf.time("save_latency"):
+                    await self.put_chunks()
+                    await self.put_manifest()
+                    save_id = await self.commit()
+            else:
+                await self.put_chunks()
+                await self.put_manifest()
+                save_id = await self.commit()
+            if span is not None:
+                span.set_tag("bytes", self.manifest["stream_bytes"])
+            return save_id
+        except BaseException as e:
+            if span is not None:
+                span.set_tag("error", str(e) or type(e).__name__)
+            raise
+        finally:
+            if span is not None:
+                self.tracer.release(token)
+                span.finish()
+                self.ioctx.objecter._report_trace(span.trace_id)

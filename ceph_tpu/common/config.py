@@ -296,6 +296,27 @@ SCHEMA: dict[str, Option] = {
              "in-flight op age that triggers an immediate `slow "
              "request` warning line (osd_op_complaint_time role)",
              min=0.0),
+        _opt("osd_scrub_auto_repair", TYPE_BOOL, LEVEL_ADVANCED, False,
+             "deep scrub that finds a repairable inconsistency "
+             "(digest mismatch, read EIO, missing hinfo) kicks off the "
+             "primary-driven repair in place instead of only flagging "
+             "it (the reference's osd_scrub_auto_repair)"),
+        _opt("mon_cluster_log_entries", TYPE_UINT, LEVEL_ADVANCED, 1000,
+             "cluster-log lines the mon leader retains for "
+             "`log last <n>` (LogMonitor summary role)", min=1),
+        # checkpoint store (ceph_tpu.ckpt: Orbax/TensorStore-style
+        # manifest + chunk layout over RADOS)
+        _opt("ckpt_chunk_target_bytes", TYPE_UINT, LEVEL_ADVANCED,
+             1 << 20,
+             "target chunk-object size for checkpoint saves; rounded "
+             "up to a full EC stripe so chunk puts never read-modify-"
+             "write", min=4096),
+        _opt("ckpt_max_inflight", TYPE_UINT, LEVEL_ADVANCED, 8,
+             "bounded window of concurrent chunk puts/gets per "
+             "checkpoint save/restore", min=1),
+        _opt("ckpt_compression_algorithm", TYPE_STR, LEVEL_ADVANCED, "",
+             "compress checkpoint chunks with this algorithm "
+             "(zlib|lzma|zstd); empty disables compression"),
         # bench / profiling
         _opt("bench_profile_trace_dir", TYPE_STR, LEVEL_DEV, "",
              "write jax.profiler traces here when set",

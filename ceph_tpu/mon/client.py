@@ -207,6 +207,21 @@ class MonClient(Dispatcher):
             Message(type="osd_boot", data=json.dumps(payload).encode())
         )
 
+    def cluster_log(self, level: str, message: str) -> None:
+        """Forward a warning-level daemon event to the mon cluster log
+        (the clog/LogClient role; `log last <n>` reads the tail). One-way
+        and best-effort, like every daemon report."""
+        import time
+
+        self._conn().send_message(
+            Message(type="log",
+                    data=json.dumps({
+                        "level": level,
+                        "message": message,
+                        "stamp": time.time(),
+                    }).encode())
+        )
+
     def send_pg_temp(self, pgid: tuple[int, int], acting: list[int]) -> None:
         self._conn().send_message(
             Message(type="pg_temp",

@@ -165,6 +165,11 @@ class Monitor(Dispatcher):
         self._failure_reports: dict[int, set[str]] = {}
         #: reports received while leaderless, flushed post-election
         self._stashed_reports: list[tuple[str, dict]] = []
+        #: cluster log (LogMonitor summary role): daemon warning events
+        #: forwarded via MonClient.cluster_log, leader-local and bounded
+        #: (non-durable — a leader change starts a fresh tail, like the
+        #: reference's in-memory summary before the paxos write)
+        self._cluster_log: list[dict] = []
         #: pool -> highest snap id handed out but possibly uncommitted
         self._pending_snap_seq: dict[int, int] = {}
         self._tasks: list[asyncio.Task] = []
@@ -1009,6 +1014,25 @@ class Monitor(Dispatcher):
                             new_down=[target])
             )
 
+    async def _h_log(self, conn, p) -> None:
+        """LogMonitor-lite: daemons clog warning events (fence, read-EIO
+        repair, slow request) here so self-heal activity is clusterwide
+        visible via `log last <n>` instead of daemon-local dout lines."""
+        if self._forward_to_leader("log", p, conn):
+            return
+        entry = {
+            "stamp": p.get("stamp"),
+            "who": p.get("reporter") or (
+                conn.peer_name if conn is not None else self.name
+            ),
+            "level": p.get("level", "WRN"),
+            "message": p.get("message", ""),
+        }
+        self._cluster_log.append(entry)
+        limit = int(self.config.get("mon_cluster_log_entries"))
+        if len(self._cluster_log) > limit:
+            del self._cluster_log[: len(self._cluster_log) - limit]
+
     async def _h_osd_boot(self, conn, p) -> None:
         if self._forward_to_leader("osd_boot", p, conn):
             return
@@ -1455,6 +1479,7 @@ class Monitor(Dispatcher):
             now_df = asyncio.get_event_loop().time()
             per_osd = {}
             total = used = 0
+            compressed = comp_original = 0
             for osd, (t, stats) in sorted(self._pg_stats.items()):
                 st = stats.get("statfs")
                 if not st or now_df - t > 30 or self.osdmap.is_down(
@@ -1464,12 +1489,26 @@ class Monitor(Dispatcher):
                 per_osd[str(osd)] = st
                 total += st["total"]
                 used += st["used"]
-            return {
+                compressed += st.get("data_compressed", 0)
+                comp_original += st.get("data_compressed_original", 0)
+            out = {
                 "total_bytes": total,
                 "used_bytes": used,
                 "avail_bytes": max(0, total - used),
                 "osds": per_osd,
             }
+            if comp_original:
+                # the bluestore compression stat pair + derived ratio
+                out["data_compressed"] = compressed
+                out["data_compressed_original"] = comp_original
+                out["compress_ratio"] = round(
+                    compressed / comp_original, 4
+                )
+            return out
+        if cmd == "log last":
+            # `ceph log last <n>`: the tail of the cluster log
+            n = int(args.get("n", 20) or 20)
+            return {"lines": self._cluster_log[-n:]}
         if cmd == "pg stats report":
             # primaries report PG state sums (num/degraded/undersized/
             # backfilling/peering/inconsistent) — the PGStats flow that

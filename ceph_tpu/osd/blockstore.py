@@ -1184,6 +1184,28 @@ class BlockStore(KStore):
         with self._lock:
             return super().used_bytes() + self.alloc.allocated_bytes()
 
+    def compression_stats(self) -> dict:
+        """Per-blob compressed-length bookkeeping rolled up for `ceph
+        df` (the bluestore_compressed/_original stat pair): logical vs
+        stored bytes of every compressed onode. Scans the onode rows —
+        the statfs caller caches, so the scan is off the hot path."""
+        original = stored = blobs = 0
+        with self._lock:
+            for _k, raw in list(self.db.iterate(_ONODE)):
+                try:
+                    on = Onode.decode(raw)
+                except Exception:  # fsck's department, not stats'
+                    continue
+                if on.flags & FLAG_COMPRESSED:
+                    blobs += 1
+                    original += on.size
+                    stored += on.stored_len
+        return {
+            "compressed_blobs": blobs,
+            "data_compressed_original": original,
+            "data_compressed": stored,
+        }
+
     # -- fsck -----------------------------------------------------------------
 
     def fsck(self, deep: bool = False) -> list[dict]:

@@ -233,6 +233,40 @@ def _break_lock(ctx: MethodContext, inp: dict):
     return {"ok": True}
 
 
+# -- cls_ckpt (ceph_tpu.ckpt HEAD pointer guard) ------------------------------
+#
+# Compare-and-swap of a checkpoint HEAD pointer, the commit point of the
+# ckpt writer's chunks -> manifest -> HEAD protocol. State lives in a user
+# xattr (plus the object data for plain-read visibility), NOT omap, so the
+# same guard works on EC pools where omap is EOPNOTSUPP. Runs inside the
+# primary, so two racing savers serialize on the object: the loser's stale
+# `expect` fails with ECANCELED and its chunks stay orphaned (gc's job).
+
+def _ckpt_cas_head(ctx: MethodContext, inp: dict):
+    cur = ctx.getxattr("ckpt.head")
+    cur_id = None if cur is None else cur.get("save_id")
+    expect = inp.get("expect")
+    if cur_id != expect:
+        raise ClsError(
+            "ECANCELED",
+            f"HEAD is {cur_id!r}, caller expected {expect!r}",
+        )
+    head = dict(inp["head"])
+    ctx.setxattr("ckpt.head", head)
+    # mirror into the object data so `ioctx.read(HEAD)` needs no exec
+    import json as _json
+
+    ctx.write(_json.dumps(head, sort_keys=True).encode())
+    return {"ok": True, "prev": cur_id}
+
+
+def _ckpt_read_head(ctx: MethodContext, inp: dict):
+    head = ctx.getxattr("ckpt.head")
+    if head is None:
+        raise ClsError("ENOENT", "no checkpoint HEAD")
+    return {"head": head}
+
+
 # -- cls_version (src/cls/version/cls_version.cc) -----------------------------
 
 def _version_read(ctx: MethodContext, inp: dict):
@@ -266,4 +300,6 @@ def default_handler() -> ClassHandler:
     h.register("lock", "break_lock", RD | WR, _break_lock)
     h.register("version", "read", RD, _version_read)
     h.register("version", "check", RD, _version_check)
+    h.register("ckpt", "cas_head", RD | WR, _ckpt_cas_head)
+    h.register("ckpt", "read_head", RD, _ckpt_read_head)
     return h

@@ -641,6 +641,9 @@ class OSDService(Dispatcher):
             "used": int(used),
             "available": max(0, int(total) - int(used)),
         }
+        comp = getattr(self.store, "compression_stats", None)
+        if comp is not None:
+            st.update(comp())
         self._statfs_cache = (loop.time(), st)
         return st
 
@@ -661,18 +664,20 @@ class OSDService(Dispatcher):
         while not self._stopped:
             await asyncio.sleep(interval)
             for op_id, dump in self.op_tracker.check_slow():
+                last = (
+                    dump["events"][-1]["event"]
+                    if dump["events"] else "none"
+                )
+                tr = dump.get("trace_id")
+                line = (
+                    f"slow request: op {op_id} "
+                    f"({dump['description']}) blocked for "
+                    f"{dump['age']:.3f}s, last event: {last}"
+                    + (f" trace={tr}" if tr else "")
+                )
                 if (d := self.dlog.dout(0)) is not None:
-                    last = (
-                        dump["events"][-1]["event"]
-                        if dump["events"] else "none"
-                    )
-                    tr = dump.get("trace_id")
-                    d(
-                        f"slow request: op {op_id} "
-                        f"({dump['description']}) blocked for "
-                        f"{dump['age']:.3f}s, last event: {last}"
-                        + (f" trace={tr}" if tr else "")
-                    )
+                    d(line)
+                self._cluster_log("WRN", line)
 
     async def _loop_lag_watchdog(self) -> None:
         """Samples how late a 10ms sleep fires: the single cheapest
@@ -720,6 +725,14 @@ class OSDService(Dispatcher):
                     d(f"osd.{self.id}: store umount failed at stop")
         self.tracer.close()
 
+    def _cluster_log(self, level: str, message: str) -> None:
+        """Best-effort clog to the mon (LogClient role): warning events
+        must never take the data path down with them."""
+        try:
+            self.mon.cluster_log(level, message)
+        except Exception:  # noqa: BLE001 - the dout line already landed
+            pass
+
     # -- fail-stop fencing (the Rebello et al. fsync-error contract) ----------
 
     def _note_store_fatal(self, reason: str) -> None:
@@ -746,6 +759,10 @@ class OSDService(Dispatcher):
         if (d := self.dlog.dout(0)) is not None:
             d(f"osd.{self.id}: store fenced ({reason}); fail-stop: "
               f"reporting ourselves to the mon and shutting down")
+        self._cluster_log(
+            "ERR",
+            f"osd.{self.id}: store fenced ({reason}); fail-stop",
+        )
         try:
             self.mon.report_failure(self.id)
         except Exception:  # noqa: BLE001 - peers will report us anyway
@@ -3937,6 +3954,11 @@ class OSDService(Dispatcher):
             if (d := self.dlog.dout(0)) is not None:
                 d(f"osd.{self.id}: read error on {pg.coll}/{sname} "
                   f"healed from peers (recovery read, ver {ver})")
+            self._cluster_log(
+                "WRN",
+                f"osd.{self.id}: read error on {pg.coll}/{sname} "
+                f"healed from peers",
+            )
             return data, attrs
         finally:
             if sp is not None:
@@ -4327,6 +4349,11 @@ class OSDService(Dispatcher):
                         except StoreError:
                             pass
                 result = {str(k): v for k, v in stats.items()}
+                comp = getattr(self.store, "compression_stats", None)
+                if comp is not None:
+                    # store-wide compressed-length bookkeeping (blob
+                    # attribution to pools stays in the store's keyspace)
+                    result["compression"] = comp()
             elif cmd == "pg ls":
                 # PGLS (the rados `ls` primitive): head objects of this
                 # pool's PGs we lead (clones/snapdirs stay internal)
@@ -4438,13 +4465,25 @@ class OSDService(Dispatcher):
             return "read_error" if rep.get("error") == "EIO" else "missing"
         return rep["_raw"], _attrs_from(rep)
 
-    async def _scrub(self, pool_id: int, deep: bool) -> dict:
+    #: deep-scrub findings the primary repairs in place when
+    #: osd_scrub_auto_repair is set; "inconsistent" (no safe authority)
+    #: and "stale"/"missing" (recovery's job) never auto-repair
+    _AUTO_REPAIRABLE = frozenset(
+        {"digest_mismatch", "read_error", "hinfo_missing"}
+    )
+
+    async def _scrub(
+        self, pool_id: int, deep: bool, auto_repair_ok: bool = True
+    ) -> dict:
         """Primary-driven consistency check over this OSD's primary PGs in
         `pool_id` (PGBackend::be_scan_list shallow; deep re-reads every
         copy/shard: EC shards verify crc32c against the stored HashInfo
         (ECBackend::be_deep_scrub, ECBackend.cc:2461), replicated copies
         compare data digests and flag the minority, like
-        be_select_auth_object's majority rule)."""
+        be_select_auth_object's majority rule). With
+        `osd_scrub_auto_repair` set, a deep scrub that finds repairable
+        damage runs the primary-driven repair in place and reports the
+        count as "auto_repaired"."""
         from ceph_tpu.common.crc import ceph_crc32c
 
         errors: list[dict] = []
@@ -4547,7 +4586,24 @@ class OSDService(Dispatcher):
                 key = tuple(err["pg"])
                 if key in self._scrub_incons:
                     self._scrub_incons[key] += 1
-        return {"errors": errors}
+        result = {"errors": errors}
+        if (
+            auto_repair_ok
+            and deep
+            and self.config.get("osd_scrub_auto_repair")
+            and any(e["error"] in self._AUTO_REPAIRABLE for e in errors)
+        ):
+            n = await self._repair_from_report(pool_id, errors)
+            result["auto_repaired"] = n
+            if (d := self.dlog.dout(0)) is not None:
+                d(f"pool {pool_id}: deep scrub auto-repaired {n} of "
+                  f"{len(errors)} inconsistencies")
+            self._cluster_log(
+                "WRN",
+                f"osd.{self.id}: pool {pool_id} deep scrub "
+                f"auto-repaired {n} of {len(errors)} inconsistencies",
+            )
+        return result
 
     async def _repair(self, pool_id: int) -> dict:
         """Deep-scrub, then overwrite every inconsistent copy with content
@@ -4555,12 +4611,22 @@ class OSDService(Dispatcher):
         shards decode from hinfo-checked survivors, replicated copies pull
         from a digest-majority member — never from the copy being
         repaired."""
+        report = await self._scrub(
+            pool_id, deep=True, auto_repair_ok=False
+        )
+        repaired = await self._repair_from_report(
+            pool_id, report["errors"]
+        )
+        return {"repaired": repaired, "errors": report["errors"]}
+
+    async def _repair_from_report(
+        self, pool_id: int, errors: list[dict]
+    ) -> int:
         from ceph_tpu.common.crc import ceph_crc32c
 
-        report = await self._scrub(pool_id, deep=True)
         ec = self.codec(pool_id)
         repaired = 0
-        for err in report["errors"]:
+        for err in errors:
             if err["error"] == "inconsistent":
                 continue  # no safe authority: surfaced, never auto-fixed
             pid, ps = err["pg"]
@@ -4639,7 +4705,7 @@ class OSDService(Dispatcher):
                 repaired += 1
             except (asyncio.TimeoutError, RuntimeError):
                 continue
-        return {"repaired": repaired, "errors": report["errors"]}
+        return repaired
 
 
 def _attrs_to(attrs: dict | None) -> dict:

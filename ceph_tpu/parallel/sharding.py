@@ -14,6 +14,7 @@ On a real pod the mesh axes ride ICI; in tests they ride the virtual
 from __future__ import annotations
 
 import functools
+import itertools
 
 import numpy as np
 
@@ -118,3 +119,79 @@ def mesh_decode_planar(
         sharded_decode(ec, present, targets, shard_batch(data, mesh), mesh)
     )
     return out.transpose(1, 0, 2).reshape(len(targets), w)
+
+
+# -- reshard-on-load (the ckpt reader's mesh-independence contract) -----------
+#
+# A checkpoint records each array's PartitionSpec, not its devices. Restore
+# resolves the spec against whatever mesh is present NOW and asks jax which
+# index-slab each local device owns; the byte-run translation below turns a
+# slab into the minimal contiguous runs of the array's row-major serialized
+# bytes, which the reader maps onto chunk objects for partial reads.
+
+
+def device_slices(shape, spec, mesh: Mesh):
+    """{device: index-tuple} for `shape` sharded as `spec` on `mesh`.
+
+    Spec axis names absent from the mesh degrade to replication, so a
+    checkpoint saved on a ("stripe", "byte") mesh restores on a mesh with
+    different axis names (or a plain data-parallel one) without edits.
+    """
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    entries = tuple(keep(e) for e in tuple(spec))[: len(shape)]
+    sharding = NamedSharding(mesh, P(*entries))
+    return sharding.addressable_devices_indices_map(tuple(shape))
+
+
+def slice_byte_runs(shape, itemsize: int, idx) -> list[tuple[int, int]]:
+    """Contiguous (offset, length) byte runs of a row-major array covered
+    by index-tuple `idx`, coalesced: a slab contiguous in memory (the
+    common leading-axis shard) collapses to ONE run regardless of rank."""
+    shape = tuple(shape)
+    if not shape:
+        return [(0, itemsize)]
+    starts, stops = [], []
+    for dim, sl in zip(shape, tuple(idx) + (slice(None),) * len(shape)):
+        start, stop, step = sl.indices(dim)
+        if step != 1:
+            raise ValueError("strided shards are not supported")
+        starts.append(start)
+        stops.append(stop)
+    # trailing axes taken whole are part of one contiguous row
+    row = itemsize
+    tail = len(shape)
+    while tail > 0 and starts[tail - 1] == 0 and stops[tail - 1] == shape[tail - 1]:
+        row *= shape[tail - 1]
+        tail -= 1
+    if tail == 0:
+        return [(0, row)] if row else []
+    row_len = (stops[tail - 1] - starts[tail - 1]) * row
+    if row_len <= 0:
+        return []
+    # iterate the remaining (outer) index space, coalescing adjacency
+    runs: list[tuple[int, int]] = []
+    outer = [range(starts[d], stops[d]) for d in range(tail - 1)]
+    stride = [row]
+    for d in range(tail - 1, 0, -1):
+        stride.insert(0, stride[0] * shape[d])
+
+    def emit(off, length):
+        if runs and runs[-1][0] + runs[-1][1] == off:
+            runs[-1] = (runs[-1][0], runs[-1][1] + length)
+        else:
+            runs.append((off, length))
+
+    for combo in itertools.product(*outer) if outer else [()]:
+        off = sum(c * s for c, s in zip(combo, stride[:-1]))
+        off += starts[tail - 1] * row
+        emit(off, row_len)
+    return runs

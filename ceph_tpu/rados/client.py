@@ -73,6 +73,8 @@ class Objecter(Dispatcher):
 
         self.tracer = Tracer(name, config=self.config)
         self.messenger.tracer = self.tracer
+        #: trace_id -> span ids already shipped to the collector OSD
+        self._reported: dict[str, set] = {}
         self.mon.on_map_change(self._rewatch_on_map)
 
     async def start(self) -> None:
@@ -305,13 +307,21 @@ class Objecter(Dispatcher):
             self.traces[trace_id] = [(
                 _time.time(), self.name, f"op_submit {op} {name}"
             )]
-        # Dapper-style root span (sampled): covers submit -> completion
-        # including every retarget/resend; the context rides the wire
-        span = self.tracer.start(
-            "op_submit",
-            tags={"pool": pool_id, "object": name, "op": op},
-            op_type=op,
+        # Dapper-style span (sampled): covers submit -> completion
+        # including every retarget/resend; the context rides the wire.
+        # Child-first: inside an already-traced task (a ckpt_save /
+        # ckpt_restore root) every op joins THAT tree instead of
+        # starting a parallel root, so composite operations dump as a
+        # single trace.
+        span = self.tracer.child(
+            "op_submit", tags={"pool": pool_id, "object": name, "op": op}
         )
+        if span is None:
+            span = self.tracer.start(
+                "op_submit",
+                tags={"pool": pool_id, "object": name, "op": op},
+                op_type=op,
+            )
         wire_ctx = "" if span is None else span.context().encode()
         try:
             return await self._op_submit_inner(
@@ -330,10 +340,23 @@ class Objecter(Dispatcher):
     def _report_trace(self, trace_id: str) -> None:
         """Ship this client's finished spans of one trace to the primary
         it last talked to — the Jaeger agent->collector hop, so a single
-        `dump_tracing` on the OSD returns the COMPLETE tree."""
-        spans = self.tracer.spans_of(trace_id)
+        `dump_tracing` on the OSD returns the COMPLETE tree.
+
+        Shared-trace ops (the ckpt path: many op_submit children under
+        one ckpt_save root) report after EVERY op, so only spans not yet
+        shipped go out — the OSD's adopt() does not dedup."""
+        shipped = self._reported.setdefault(trace_id, set())
+        if len(self._reported) > 64:  # bound stale per-trace bookkeeping
+            for tid in list(self._reported)[:-32]:
+                if tid != trace_id:
+                    del self._reported[tid]
+        spans = [
+            s for s in self.tracer.spans_of(trace_id)
+            if s["span_id"] not in shipped
+        ]
         conn = self._last_conn
         if spans and conn is not None:
+            shipped.update(s["span_id"] for s in spans)
             conn.send_message(
                 Message(
                     type="trace_report",

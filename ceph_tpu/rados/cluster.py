@@ -311,7 +311,17 @@ class MiniCluster:
 
     # -- scrub (PGBackend::be_scan_list / ECBackend::be_deep_scrub) ------------
 
-    def scrub(self, pool_id: int, deep: bool = False) -> list["ScrubError"]:
+    #: inconsistencies repair can fix from surviving copies; "missing"
+    #: and "stale" are recovery's job, "size_mismatch" may lack a safe
+    #: authority — auto-repair only fires on unambiguous damage
+    AUTO_REPAIRABLE = frozenset(
+        {"digest_mismatch", "read_error", "hinfo_missing"}
+    )
+
+    def scrub(
+        self, pool_id: int, deep: bool = False,
+        _allow_auto_repair: bool = True,
+    ) -> list["ScrubError"]:
         """Consistency check over every registered object's shards/replicas.
 
         Shallow: presence + size agreement (PGBackend::be_scan_list,
@@ -319,8 +329,25 @@ class MiniCluster:
         its crc32c against the stored HashInfo (EC: ECBackend::be_deep_scrub,
         ECBackend.cc:2461-2540) or against the replica majority (replicated
         pools' data digest comparison). Faults found are returned, counted,
-        and left in place — `repair` acts on them.
+        and left in place for `repair` — unless `osd_scrub_auto_repair` is
+        set, in which case a deep scrub that finds repairable damage runs
+        the same primary-driven repair in place.
         """
+        errors = self._scrub_pass(pool_id, deep)
+        if (
+            _allow_auto_repair
+            and deep
+            and self.config.get("osd_scrub_auto_repair")
+            and any(e.error in self.AUTO_REPAIRABLE for e in errors)
+        ):
+            if (d := self.dlog.dout(1)) is not None:
+                d(f"pool {pool_id}: deep scrub auto-repairing "
+                  f"{len(errors)} inconsistencies")
+            self._drop_inconsistent(errors)
+            self.recover(pool_id)
+        return errors
+
+    def _scrub_pass(self, pool_id: int, deep: bool) -> list["ScrubError"]:
         ec = self.codec(pool_id)
         errors: list[ScrubError] = []
         for (pid, name), info in list(self.registry.items()):
@@ -467,7 +494,11 @@ class MiniCluster:
     def repair(self, pool_id: int) -> int:
         """Deep-scrub, drop every inconsistent copy, rebuild via recover()
         (the `ceph pg repair` flow)."""
-        errors = self.scrub(pool_id, deep=True)
+        errors = self.scrub(pool_id, deep=True, _allow_auto_repair=False)
+        self._drop_inconsistent(errors)
+        return self.recover(pool_id)
+
+    def _drop_inconsistent(self, errors: list["ScrubError"]) -> None:
         for e in errors:
             if e.error == "missing":
                 continue  # nothing stored to drop
@@ -480,7 +511,6 @@ class MiniCluster:
             store.objects.pop(key, None)
             store.attrs.pop(key, None)
             store.eio_keys.discard(key)
-        return self.recover(pool_id)
 
     # -- failure / recovery (the thrasher loop) --------------------------------
 

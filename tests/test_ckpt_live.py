@@ -1,0 +1,344 @@
+"""Checkpoint store over a live cluster: bit-exact save/restore on
+replicated and EC pools, crash-consistency at the HEAD-CAS commit point
+(a saver dying before commit leaves the previous checkpoint intact and
+its debris collectable), reshard-on-load under a different device count,
+partial-read accounting, the traced ckpt_save/ckpt_restore trees, and
+the mon cluster log the warning path feeds."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ckpt import CkptStore
+from ceph_tpu.ckpt.writer import CkptConflict
+from ceph_tpu.rados.client import ObjectNotFound, Rados
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster, live_config
+from tests.test_trace_live import assert_single_tree, traced_cluster_cfg
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+def _tree(seed=0, rows=96):
+    rng = np.random.default_rng(seed)
+    return {
+        "params": {
+            "w": rng.standard_normal((rows, 64)).astype(np.float32),
+            "b": rng.standard_normal((64,)).astype(np.float32),
+        },
+        "step": np.int64(seed),
+    }
+
+
+def _assert_tree_equal(got, want):
+    assert np.array_equal(np.asarray(got["params"]["w"]),
+                          np.asarray(want["params"]["w"]))
+    assert np.array_equal(np.asarray(got["params"]["b"]),
+                          np.asarray(want["params"]["b"]))
+    assert int(np.asarray(got["step"])) == int(np.asarray(want["step"]))
+
+
+async def _cluster_and_client(cfg=None, name="client.ckpt"):
+    cluster = Cluster(cfg=cfg)
+    await cluster.start()
+    rados = Rados(name, cluster.monmap, config=cluster.cfg)
+    await rados.connect()
+    await cluster.create_pools(rados)
+    return cluster, rados
+
+
+def test_ckpt_save_restore_crash_consistency_and_gc():
+    """The acceptance crash story on BOTH pool kinds: a saver that dies
+    after its chunk/manifest puts but before the HEAD CAS (the kill -9
+    window) leaves restore() returning the previous checkpoint bit-exact;
+    gc reclaims exactly the aborted save's objects; a stale CAS raises
+    CkptConflict instead of clobbering a newer checkpoint."""
+
+    async def main():
+        cfg = live_config()
+        cfg.set("ckpt_chunk_target_bytes", 16384)
+        cluster, rados = await _cluster_and_client(cfg)
+        try:
+            for pool in (REP_POOL, EC_POOL):
+                store = CkptStore(rados.io_ctx(pool), "train")
+                assert await store.head() is None
+                with pytest.raises(ObjectNotFound):
+                    await store.restore()
+
+                v1, v2 = _tree(1), _tree(2)
+                sid1 = await store.save(v1)
+                _assert_tree_equal(await store.restore(), v1)
+                assert (await store.head())["save_id"] == sid1
+
+                # the dying saver: every stage except the commit point
+                w = store.writer(v2)
+                w.prepare()
+                await w.put_chunks()
+                await w.put_manifest()
+                orphaned = len(w.manifest["chunks"]) + 1  # + manifest
+
+                # HEAD still points at the previous COMPLETE checkpoint
+                _assert_tree_equal(await store.restore(), v1)
+                ls = await store.ls()
+                by_id = {e["save_id"]: e for e in ls["saves"]}
+                assert ls["head"] == sid1
+                assert by_id[sid1]["committed"]
+                assert not by_id[w.save_id]["committed"]
+                assert by_id[w.save_id]["manifest"]
+
+                # gc reclaims exactly the aborted save's debris
+                report = await store.gc()
+                assert report["head"] == sid1
+                assert len(report["removed"]) == orphaned
+                assert all(w.save_id in o for o in report["removed"])
+                _assert_tree_equal(await store.restore(), v1)
+                assert (await store.verify())["ok"]
+                ls = await store.ls()
+                assert [e["save_id"] for e in ls["saves"]] == [sid1]
+
+                # a saver holding a stale HEAD observation must NOT win
+                stale = store.writer(_tree(3))
+                stale.prepare()
+                await stale.put_chunks()
+                await stale.put_manifest()
+                sid2 = await store.save(v2)  # concurrent saver commits
+                with pytest.raises(CkptConflict):
+                    await stale.commit(expect=sid1)
+                _assert_tree_equal(await store.restore(), v2)
+
+                # after the new commit, v1 + the loser are both orphans
+                report = await store.gc()
+                assert report["head"] == sid2
+                assert any(sid1 in o for o in report["removed"])
+                assert any(stale.save_id in o for o in report["removed"])
+                _assert_tree_equal(await store.restore(), v2)
+                assert store.perf_dump()["save_commits"] == 2
+                assert store.perf_dump()["gc_removed"] > 0
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_ckpt_reshard_on_load_and_partial_read():
+    """A checkpoint saved under one virtual mesh restores bit-exact under
+    a DIFFERENT device count, and a single-shard read moves measurably
+    fewer bytes than a full restore (restore_read_bytes accounting) — on
+    both replicated and EC pools."""
+
+    async def main():
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        cfg = live_config()
+        cfg.set("ckpt_chunk_target_bytes", 16384)
+        cluster, rados = await _cluster_and_client(cfg)
+        try:
+            devs = np.array(jax.devices())
+            assert len(devs) == 8, "conftest pins an 8-device CPU mesh"
+            mesh8 = Mesh(devs, ("stripe",))
+            w_np = np.random.default_rng(5).standard_normal(
+                (128, 64)
+            ).astype(np.float32)
+            tree = {
+                "w": jax.device_put(
+                    w_np, NamedSharding(mesh8, P("stripe", None))
+                ),
+                "step": np.int64(9),
+            }
+            for pool in (REP_POOL, EC_POOL):
+                store = CkptStore(rados.io_ctx(pool), "shard")
+                await store.save(tree)
+                manifest = await store.reader().read_manifest()
+                w_entry = next(
+                    a for a in manifest["arrays"]
+                    if a["path"] == [["k", "w"]]
+                )
+                assert w_entry["spec"] == ["stripe", None]
+
+                # reshard-on-load: 8-way save -> 4-way and 2x2 restores
+                for mesh in (
+                    Mesh(devs[:4], ("stripe",)),
+                    Mesh(devs.reshape(2, 4), ("stripe", "model")),
+                ):
+                    out = await store.restore(mesh=mesh)
+                    got = out["w"]
+                    assert got.sharding.mesh.devices.size == mesh.devices.size
+                    assert np.array_equal(np.asarray(got), w_np)
+                    assert int(np.asarray(out["step"])) == 9
+
+                # full restore vs one shard slab: byte accounting
+                full = CkptStore(rados.io_ctx(pool), "shard")
+                await full.restore()
+                rb_full = full.perf_dump()["restore_read_bytes"]
+                assert rb_full >= w_np.nbytes
+
+                part = CkptStore(rados.io_ctx(pool), "shard")
+                shard = await part.reader().read_shard(
+                    "w", (slice(0, 16), slice(0, 64))
+                )
+                assert np.array_equal(shard, w_np[0:16])
+                rb_part = part.perf_dump()["restore_read_bytes"]
+                assert 0 < rb_part <= w_np.nbytes // 8 + 1
+                assert rb_part * 4 < rb_full, (rb_part, rb_full)
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_ckpt_traced_trees_and_cluster_log():
+    """One sampled save and one sampled restore each show up as a SINGLE
+    traced tree (ckpt_save/ckpt_restore root -> chunk spans -> op_submit
+    -> per-OSD execution spans) when the per-daemon dump_tracing rings
+    are stitched; daemon warnings land in the mon cluster log and `log
+    last <n>` serves the bounded tail."""
+
+    async def main():
+        cfg = traced_cluster_cfg(mon_cluster_log_entries=6)
+        cfg.set("ckpt_chunk_target_bytes", 16384)
+        cluster, rados = await _cluster_and_client(cfg, name="client.ct")
+        try:
+            store = CkptStore(rados.io_ctx(EC_POOL), "traced")
+            tree = _tree(4)
+            await store.save(tree)
+            _assert_tree_equal(await store.restore(), tree)
+            await asyncio.sleep(0.3)  # let trace_report land
+
+            # stitch the collection surface: every daemon's ring
+            by_trace: dict[str, dict] = {}
+            for osd_id in cluster.osds:
+                dump = await rados.objecter.osd_admin(
+                    osd_id, "dump_tracing"
+                )
+                for t in dump["traces"]:
+                    spans = by_trace.setdefault(t["trace_id"], {})
+                    for s in t["spans"]:
+                        spans[s["span_id"]] = s
+
+            for root_name, op in (
+                ("ckpt_save", "chunk_put"), ("ckpt_restore", "chunk_get")
+            ):
+                trees = [
+                    list(spans.values()) for spans in by_trace.values()
+                    if any(s["name"] == root_name for s in spans.values())
+                ]
+                assert len(trees) == 1, root_name
+                spans = trees[0]
+                root = assert_single_tree(spans)
+                assert root["name"] == root_name
+                names = {s["name"] for s in spans}
+                assert op in names
+                assert "op_submit" in names     # client op layer
+                assert "osd_op" in names        # OSD execution layer
+                chunk_spans = [s for s in spans if s["name"] == op]
+                assert len(chunk_spans) == len(
+                    (await store.reader().read_manifest())["chunks"]
+                )
+                assert all(
+                    s["parent_id"] == root["span_id"] for s in chunk_spans
+                )
+
+            # -- mon cluster log (fence/heal/slow warnings route here) --
+            for i in range(9):
+                cluster.osds[0].mon.cluster_log(
+                    "WRN" if i % 2 else "ERR", f"ckpt-test event {i}"
+                )
+            lines = None
+            for _ in range(100):
+                out = await rados.mon_command("log last", {"n": 50})
+                lines = out["lines"]
+                if any("ckpt-test event 8" in l["message"] for l in lines):
+                    break
+                await asyncio.sleep(0.05)
+            assert lines and len(lines) <= 6  # mon_cluster_log_entries
+            last = lines[-1]
+            assert last["message"] == "ckpt-test event 8"
+            assert last["level"] == "ERR"
+            assert "osd.0" in last["who"]
+            assert last["stamp"] > 0
+            # explicit n trims further
+            out = await rados.mon_command("log last", {"n": 2})
+            assert len(out["lines"]) == 2
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_ckpt_survives_osd_failure_and_cli(tmp_path):
+    """Multi-daemon resilience + the operator CLI: saves keep working
+    across an OSD failure (ops re-target on the new map), and
+    ckpt_tool's save/ls/verify/restore drive a live cluster over real
+    TCP from a separate process."""
+
+    async def main():
+        import sys
+
+        cfg = live_config()
+        cfg.set("ckpt_chunk_target_bytes", 16384)
+        cluster, rados = await _cluster_and_client(cfg)
+        try:
+            store = CkptStore(rados.io_ctx(EC_POOL), "ha")
+            v1 = _tree(11, rows=192)
+            await store.save(v1)
+
+            await cluster.kill_osd(0)
+            # wait for the failure to reach the map, then save again
+            epoch = rados.objecter.osdmap.epoch
+            for _ in range(200):
+                if rados.objecter.osdmap.is_down(0):
+                    break
+                await asyncio.sleep(0.05)
+            assert rados.objecter.osdmap.is_down(0)
+            assert rados.objecter.osdmap.epoch > epoch - 1
+
+            v2 = _tree(12, rows=192)
+            await store.save(v2)
+            _assert_tree_equal(await store.restore(), v2)
+            assert (await store.verify())["ok"]
+
+            # -- ckpt_tool over real TCP ---------------------------------
+            mon_host = ",".join(
+                f"{h}:{p}" for h, p in cluster.monmap.addrs
+            )
+            npz = tmp_path / "in.npz"
+            out_npz = tmp_path / "out.npz"
+            arr = np.arange(4096, dtype=np.uint16).reshape(64, 64)
+            np.savez(npz, w=arr)
+
+            async def tool(*argv):
+                proc = await asyncio.create_subprocess_exec(
+                    sys.executable, "tools/ckpt_tool.py",
+                    "--mon-host", mon_host, "--pool", str(EC_POOL),
+                    *argv,
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.PIPE,
+                )
+                out, err = await proc.communicate()
+                assert proc.returncode == 0, err.decode()
+                return json.loads(out.decode())
+
+            saved = await tool("save", "cli", "--npz", str(npz))
+            assert saved["perf"]["save_commits"] == 1
+            listed = await tool("ls", "cli")
+            assert listed["head"] == saved["save_id"]
+            assert (await tool("verify", "cli"))["ok"]
+            restored = await tool(
+                "restore", "cli", "--npz", str(out_npz)
+            )
+            assert restored["restored"] == ["w"]
+            with np.load(out_npz) as back:
+                assert np.array_equal(back["w"], arr)
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())

@@ -143,6 +143,46 @@ def test_deep_scrub_flags_eio_and_missing():
     assert c.scrub(1, deep=True) == []
 
 
+def test_deep_scrub_auto_repair_knob():
+    """osd_scrub_auto_repair (default off): a deep scrub that finds
+    repairable damage runs the primary-driven repair in place instead of
+    waiting for an operator `pg repair`."""
+    c = _cluster()
+    data = payload(6000)
+    c.put(1, "obj", data)
+    pg, acting = c.acting(1, "obj")
+    key = (1, pg, "obj", 1)
+    store = c.stores[acting[1]]
+    blob = bytearray(store.objects[key])
+    blob[5] ^= 1
+    store.objects[key] = bytes(blob)
+
+    # knob off (the default): scrub reports and leaves the damage behind
+    assert not c.config.get("osd_scrub_auto_repair")
+    assert [e.error for e in c.scrub(1, deep=True)] == ["digest_mismatch"]
+    assert c.scrub(1, deep=True) != []
+
+    # knob on: the SAME deep scrub still reports, then heals in place
+    c.config.set("osd_scrub_auto_repair", True)
+    assert [e.error for e in c.scrub(1, deep=True)] == ["digest_mismatch"]
+    assert c.scrub(1, deep=True) == []
+    assert c.get(1, "obj") == data
+
+    # EIO poison is auto-repairable too
+    c.stores[acting[0]].eio_keys.add((1, pg, "obj", 0))
+    assert [e.error for e in c.scrub(1, deep=True)] == ["read_error"]
+    assert c.scrub(1, deep=True) == []
+    assert c.get(1, "obj") == data
+
+    # a plainly MISSING shard is normal recovery's job, not auto-repair's
+    del store.objects[key]
+    del store.attrs[key]
+    assert [e.error for e in c.scrub(1, deep=True)] == ["missing"]
+    assert [e.error for e in c.scrub(1, deep=True)] == ["missing"]
+    assert c.recover(1) >= 1
+    assert c.scrub(1, deep=True) == []
+
+
 def test_recover_rejects_corrupt_stray_copy():
     """A silently-corrupted stray must not re-infect the acting home: the
     pull is CRC-verified against its own hinfo and recovery falls back to

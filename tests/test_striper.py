@@ -57,6 +57,64 @@ def test_object_name_format():
     assert object_name("vol", 26) == "vol.000000000000001a"
 
 
+def test_striper_naming_and_tail_extents_property():
+    """Property test over random layouts: every extent byte lands where
+    an independent per-byte oracle says it must (including the irregular
+    final stripe, whose tail blocks are shorter than stripe_unit), and
+    object numbering matches the `<soid>.%016x` naming contract the
+    checkpoint store's chunk objects reuse (ckpt/layout.py)."""
+    rng = np.random.default_rng(42)
+
+    def oracle(layout, b):
+        # independent re-derivation of Striper.cc's block walk
+        su, sc, os_ = (
+            layout.stripe_unit, layout.stripe_count, layout.object_size
+        )
+        if sc == 1:
+            su = os_
+        spo = os_ // su  # stripes per object
+        blockno, block_off = divmod(b, su)
+        stripeno, stripepos = divmod(blockno, sc)
+        objectsetno, stripe_in_obj = divmod(stripeno, spo)
+        objectno = objectsetno * sc + stripepos
+        return objectno, stripe_in_obj * su + block_off
+
+    for _ in range(40):
+        su = int(rng.choice([4, 8, 16, 64]))
+        sc = int(rng.integers(1, 5))
+        os_ = su * int(rng.integers(1, 5))
+        layout = StripeLayout(
+            stripe_unit=su, stripe_count=sc, object_size=os_
+        )
+        offset = int(rng.integers(0, 3 * os_ * sc))
+        # lengths deliberately NOT block-aligned: the final stripe is
+        # irregular and its tail extent must stop mid-block
+        length = int(rng.integers(1, 4 * os_ * sc)) + 1
+        extents = file_to_extents(layout, offset, length)
+
+        placed = {}
+        for objectno, runs in extents.items():
+            suffix = object_name("soid", objectno).rsplit(".", 1)[1]
+            assert suffix == f"{objectno:016x}" and len(suffix) == 16
+            for obj_off, n, file_off in runs:
+                assert n > 0 and obj_off + n <= os_
+                for i in range(n):
+                    placed[file_off + i] = (objectno, obj_off + i)
+        # exact coverage, each byte exactly once, all per the oracle
+        assert sorted(placed) == list(range(offset, offset + length))
+        for b, got in placed.items():
+            assert got == oracle(layout, b), (su, sc, os_, b)
+        # the tail extent of the irregular final stripe is short
+        end = offset + length
+        eff_su = os_ if sc == 1 else su
+        if end % eff_su:
+            tail_obj, tail_off = oracle(layout, end - 1)
+            tail_run = max(
+                r for r in extents[tail_obj] if r[0] <= tail_off
+            )
+            assert tail_run[0] + tail_run[1] == tail_off + 1
+
+
 def test_layout_validation():
     with pytest.raises(ValueError):
         StripeLayout(stripe_unit=0)

@@ -122,3 +122,54 @@ def test_wire_compression_round_trips():
         await cluster.stop()
 
     run(main())
+
+
+def test_df_reports_at_rest_compression():
+    """`ceph df` surfaces the blockstore's per-blob compressed-length
+    bookkeeping: data_compressed / data_compressed_original ride each
+    OSD's statfs report and the mon derives compress_ratio."""
+
+    async def main():
+        cfg = live_config()
+        cfg.set("osd_objectstore", "blockstore")
+        cfg.set("blockstore_compression_mode", "aggressive")
+        cluster = Cluster(cfg=cfg)
+        await cluster.start()
+        rados = Rados("client.dfc", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        io = rados.io_ctx(REP_POOL)
+        for i in range(4):
+            await io.write_full(f"dfc-{i}", bytes([i]) * 65536)
+
+        # store-wide bookkeeping on the daemon admin surface
+        stats = [
+            await rados.objecter.osd_admin(o, "pool_stats", {})
+            for o in cluster.osds
+        ]
+        comp = [s["compression"] for s in stats if "compression" in s]
+        assert comp and any(c["compressed_blobs"] > 0 for c in comp)
+        assert all(
+            c["data_compressed"] <= c["data_compressed_original"]
+            for c in comp
+        )
+
+        # ...aggregated by the mon once statfs reports land
+        async def df_compressed():
+            df = await rados.mon_command("df")
+            return df if "compress_ratio" in df else None
+
+        loop = asyncio.get_event_loop()
+        end = loop.time() + 60
+        df = await df_compressed()
+        while df is None:
+            assert loop.time() < end, await rados.mon_command("df")
+            await asyncio.sleep(0.3)
+            df = await df_compressed()
+        assert 0 < df["compress_ratio"] < 1
+        assert df["data_compressed"] < df["data_compressed_original"]
+        assert df["data_compressed_original"] >= 3 * 65536  # size 3 pool
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())

@@ -6,7 +6,11 @@ quorum and daemons from the shell.
 Commands mirror the reference surface:
 
     status | -s                      cluster status (quorum, epoch, osds)
-    df                               cluster + per-osd utilization
+    df                               cluster + per-osd utilization (incl.
+                                     data_compressed / compress_ratio when
+                                     blockstore compression is active)
+    log last [n]                     tail of the mon cluster log (fence,
+                                     read-EIO-repair, slow-request events)
     health                           health checks (OSD_DOWN, PG_DEGRADED,
                                      PG_DAMAGED, ...) with severities
     osd tree                         crush hierarchy with up/down + weights
@@ -129,6 +133,13 @@ async def _dispatch(rados, args) -> dict:
         if sub == "dump":
             return await rados.mon_command("config dump", {})
         raise SystemExit(f"unknown config subcommand {sub!r}")
+    if cmd == "log":
+        sub = args.rest[0] if args.rest else "last"
+        if sub == "last":
+            n = int(args.rest[1]) if len(args.rest) > 1 else 20
+            return await rados.mon_command("log last", {"n": n})
+        raise SystemExit(f"unknown log subcommand {sub!r}")
+
     if cmd == "pg" and args.rest[0] == "dump":
         return _pg_dump(rados.objecter.osdmap, args.pool)
 
