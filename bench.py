@@ -252,15 +252,19 @@ def _trace_overhead_line() -> None:
 def _ckpt_line() -> None:
     """Optional JSON line: checkpoint save/restore GB/s through the full
     stack (CkptStore -> RADOS client -> OSD daemons -> EC encode), via
-    tools/ckpt_tool.py's in-process bench. Guarded (--ckpt /
-    CEPH_TPU_BENCH_CKPT=1) and non-fatal."""
+    tools/ckpt_tool.py's in-process bench — now including the async
+    fast path: blocking time (train-visible stall of save_async) vs the
+    persist wall time, and the incremental-dedup ratio of an unchanged-
+    majority second save. Guarded (--ckpt / CEPH_TPU_BENCH_CKPT=1) and
+    non-fatal."""
     try:
         import subprocess
 
         out = subprocess.run(
             [sys.executable, "tools/ckpt_tool.py", "bench",
              "--mb", os.environ.get("CEPH_TPU_BENCH_CKPT_MB", "16"),
-             "--pool-kind", "ec"],
+             "--arrays", "8", "--pool-kind", "ec",
+             "--async", "--incremental"],
             capture_output=True, timeout=600, check=True,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
@@ -273,6 +277,14 @@ def _ckpt_line() -> None:
             "bytes": r["bytes"],
             "chunks": r["chunks"],
             "pool": r["pool"],
+            # async fast path: train-visible stall vs persist wall time
+            "block_s": r["block_s"],
+            "wall_s": r["wall_s"],
+            "sync_save_s": r["second_save_s"],
+            "blocking_speedup": r["blocking_speedup"],
+            # incremental dedup on the unchanged-majority second save
+            "dedup_ratio": r["dedup_ratio"],
+            "chunks_reused": r["chunks_reused"],
         }))
     except Exception:  # noqa: BLE001 - strictly best-effort
         pass

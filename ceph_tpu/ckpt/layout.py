@@ -19,11 +19,84 @@ import json
 
 import numpy as np
 
+from ceph_tpu.common.crc import ceph_crc32c
 from ceph_tpu.rados.striper import object_name
 
 FORMAT = 1
 #: replicated pools have no stripe constraint; align to the allocator page
 MIN_ALIGN = 4096
+
+try:  # the container ships xxhash; blake2b keeps the layout importable
+    import xxhash as _xxhash
+
+    def _xxh64(payload: bytes) -> int:
+        return _xxhash.xxh64(payload).intdigest()
+except ImportError:  # pragma: no cover - environment-dependent fallback
+    import hashlib as _hashlib
+
+    def _xxh64(payload: bytes) -> int:
+        return int.from_bytes(
+            _hashlib.blake2b(payload, digest_size=8).digest(), "big"
+        )
+
+
+def chunk_fingerprint(payload: bytes) -> str:
+    """Content fingerprint of one UNCOMPRESSED chunk payload: xxhash64
+    composed with crc32c (24 hex chars). Two independent hash families
+    make an accidental collision — which would silently alias two
+    different chunks across saves — vanishingly unlikely, and the crc
+    half reuses the checksum the chunk put computes anyway."""
+    return (
+        f"{_xxh64(payload):016x}"
+        f"{ceph_crc32c(0xFFFFFFFF, payload):08x}"
+    )
+
+
+def diff_chunks(manifest: dict, prev: dict | None) -> int:
+    """Incremental-save diff: mark every chunk of `manifest` whose
+    (fingerprint, length) matches a chunk of the previous committed
+    manifest as REUSED — its entry flips to the prior save's object
+    name (transitively the ultimate owner: a reused entry in `prev`
+    already points at the save that really stored the bytes) and its
+    crc/stored/compressed travel along so restore needs no special
+    case. Chunks must already carry their `hash` (the writer
+    fingerprints the payloads first). Returns the number reused."""
+    if not prev:
+        return 0
+    by_print = {
+        (c.get("hash"), c["length"]): c
+        for c in prev.get("chunks", ())
+        if c.get("hash") and c.get("crc") is not None
+    }
+    reused = 0
+    for chunk in manifest["chunks"]:
+        old = by_print.get((chunk.get("hash"), chunk["length"]))
+        if old is None:
+            continue
+        chunk["object"] = old["object"]
+        chunk["crc"] = old["crc"]
+        chunk["stored"] = old["stored"]
+        chunk["compressed"] = old["compressed"]
+        chunk["reused"] = True
+        reused += 1
+    return reused
+
+
+def manifest_dedup(manifest: dict) -> dict:
+    """Per-save dedup accounting: owned vs referenced chunk counts and
+    the byte ratio ckpt_tool's `ls` and the bench line report."""
+    chunks = manifest.get("chunks", ())
+    reused = [c for c in chunks if c.get("reused")]
+    total = sum(c["length"] for c in chunks)
+    reused_bytes = sum(c["length"] for c in reused)
+    return {
+        "chunks": len(chunks),
+        "chunks_owned": len(chunks) - len(reused),
+        "chunks_referenced": len(reused),
+        "bytes": total,
+        "bytes_referenced": reused_bytes,
+        "dedup_ratio": round(reused_bytes / total, 4) if total else 0.0,
+    }
 
 
 def head_object(name: str) -> str:
@@ -174,6 +247,7 @@ def build_manifest(
     *,
     chunk_size: int,
     compress: str = "",
+    parent: str | None = None,
 ) -> dict:
     """The array table + chunk table (crc/stored fields filled by the
     writer as chunks go out)."""
@@ -201,11 +275,14 @@ def build_manifest(
             "crc": None,        # crc32c of the uncompressed payload
             "stored": None,     # bytes on the wire (== length uncompressed)
             "compressed": False,
+            "hash": None,       # chunk_fingerprint of the payload
+            "reused": False,    # True: `object` lives in a prior save
         })
     return {
         "format": FORMAT,
         "name": name,
         "save_id": save_id,
+        "parent": parent,       # committed HEAD this save diffed against
         "chunk_bytes": chunk_size,
         "compress": compress,
         "stream_bytes": stream,

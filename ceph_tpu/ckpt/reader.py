@@ -1,14 +1,21 @@
-"""Checkpoint reader: crc-verified full restore + sharding-aware
-partial restore (reshard-on-load).
+"""Checkpoint reader: pipelined crc-verified full restore + sharding-
+aware partial restore (reshard-on-load).
 
-Full restore fetches every chunk (bounded window), verifies each against
-its manifest crc32c, and rebuilds the pytree. Sharded restore resolves
-each array's saved PartitionSpec against the mesh present NOW
-(parallel/sharding.device_slices) and fetches ONLY the byte runs the
-addressable shards need — partial chunk reads, accounted in the
-`restore_read_bytes` counter so tests can assert a single-shard restore
-really moved fewer bytes. A mesh with a different device count than the
-save mesh just yields different slabs: reshard-on-load needs no resave.
+Full restore is a bounded-window PIPELINE, mirroring the writer: up to
+`ckpt_restore_readahead` ranged chunk reads are in flight at once (0
+inherits `ckpt_max_inflight`), and each chunk's decompress/crc/placement
+runs AFTER its read releases the window slot — so the next read is
+already on the wire while this chunk verifies and lands in the
+preallocated stream buffer. Restore is no longer read-then-place serial.
+
+Sharded restore resolves each array's saved PartitionSpec against the
+mesh present NOW (parallel/sharding.device_slices) and fetches ONLY the
+byte runs the addressable shards need — partial chunk reads, accounted
+in the `restore_read_bytes` counter so tests can assert a single-shard
+restore really moved fewer bytes. A mesh with a different device count
+than the save mesh just yields different slabs: reshard-on-load needs
+no resave. Dedup'd manifests need no special casing anywhere here: a
+reused chunk's `object` already names the save that stored the bytes.
 """
 
 from __future__ import annotations
@@ -54,12 +61,15 @@ class CkptReader:
     # -- chunk fetch -----------------------------------------------------------
 
     def _window(self) -> asyncio.Semaphore:
-        return asyncio.Semaphore(
-            max(1, self.config.get("ckpt_max_inflight"))
-        )
+        """The readahead window: how many chunk reads may be on the
+        wire at once while completed chunks decode and place."""
+        depth = self.config.get("ckpt_restore_readahead") or \
+            self.config.get("ckpt_max_inflight")
+        return asyncio.Semaphore(max(1, depth))
 
-    async def _fetch_chunk(self, chunk: dict, *, verify: bool = True) -> bytes:
-        """One whole chunk, decompressed, crc-checked."""
+    async def _read_chunk(self, chunk: dict) -> bytes:
+        """The IO half of a chunk fetch: raw (possibly compressed)
+        payload off the wire, traced, byte-accounted."""
         span = self.tracer.child(
             "chunk_get", tags={"object": chunk["object"]}
         )
@@ -72,6 +82,13 @@ class CkptReader:
                 span.finish()
         if self.perf is not None:
             self.perf.inc("restore_read_bytes", len(payload))
+        return payload
+
+    def _decode_chunk(
+        self, chunk: dict, payload: bytes, *, verify: bool = True
+    ) -> bytes:
+        """The pure half: decompress + length/crc checks. Runs OUTSIDE
+        the readahead window so it overlaps the reads still in flight."""
         if chunk["stored"] is not None and len(payload) != chunk["stored"]:
             raise CkptCorrupt(
                 f"{chunk['object']}: stored {len(payload)} bytes, "
@@ -93,6 +110,12 @@ class CkptReader:
                     f"manifest {chunk['crc']:#x}"
                 )
         return payload
+
+    async def _fetch_chunk(self, chunk: dict, *, verify: bool = True) -> bytes:
+        """One whole chunk, decompressed, crc-checked."""
+        return self._decode_chunk(
+            chunk, await self._read_chunk(chunk), verify=verify
+        )
 
     _manifest_compress = ""
 
@@ -128,14 +151,28 @@ class CkptReader:
     async def _restore_full(self, manifest: dict):
         window = self._window()
         chunks = manifest["chunks"]
-        parts: list[bytes | None] = [None] * len(chunks)
+        # placement target: one preallocated buffer, filled per chunk
+        # as its read lands (no read-then-place barrier, no join copy)
+        buf = bytearray(manifest["stream_bytes"])
+        inflight = 0
 
-        async def get(i, chunk):
+        async def get(chunk):
+            nonlocal inflight
             async with window:
-                parts[i] = await self._fetch_chunk(chunk)
+                inflight += 1
+                if self.perf is not None:
+                    self.perf.set_max("restore_readahead_peak", inflight)
+                try:
+                    payload = await self._read_chunk(chunk)
+                finally:
+                    inflight -= 1
+            # decode + place with the window slot RELEASED: the next
+            # chunk's read is already in flight while this one verifies
+            payload = self._decode_chunk(chunk, payload)
+            buf[chunk["offset"]:chunk["offset"] + chunk["length"]] = payload
 
-        await asyncio.gather(*(get(i, c) for i, c in enumerate(chunks)))
-        stream = b"".join(parts)
+        await asyncio.gather(*(get(c) for c in chunks))
+        stream = buf  # np.frombuffer reads the bytearray zero-copy
         records = []
         for a in manifest["arrays"]:
             arr = np.frombuffer(

@@ -3,27 +3,33 @@
 The save is staged so crash-consistency is testable at every boundary:
 
   prepare()       pytree -> manifest + serialized stream (no IO)
-  put_chunks()    bounded-window parallel `write_full` per chunk, each
-                  crc32c'd (and optionally compressed) before send
+  put_chunks()    fingerprint every chunk, diff against the previous
+                  committed manifest (incremental dedup: unchanged
+                  chunks are REFERENCED from the prior save, not
+                  re-uploaded), then bounded-window parallel
+                  `write_full` per remaining chunk, each crc32c'd (and
+                  optionally compressed) before send
   put_manifest()  the manifest object
   commit()        compare-and-swap of the HEAD pointer (cls ckpt.cas_head
                   inside the primary) — THE commit point
 
 `save()` runs all four under one traced root. Dying before commit()
 (the kill -9 window) leaves HEAD on the previous complete checkpoint;
-the new save's chunks are orphans for gc.py.
+the new save's chunks are orphans for gc.py. Dedup composes with that
+story because gc is manifest-reachability based: a referenced chunk of
+an old save stays live while any retained manifest points at it.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import uuid
 
 import numpy as np
 
 from ceph_tpu.ckpt import layout
 from ceph_tpu.common.compressor import factory as compressor_factory
-from ceph_tpu.common.crc import ceph_crc32c
 from ceph_tpu.rados.client import ObjectNotFound, RadosError
 
 
@@ -71,10 +77,44 @@ class CkptWriter:
         assert len(self._stream) == self.manifest["stream_bytes"]
         return self.manifest
 
-    # -- stage 2: chunk puts --------------------------------------------------
+    # -- stage 2: incremental diff + chunk puts -------------------------------
+
+    async def _load_parent(self) -> dict | None:
+        """The committed HEAD's manifest — the dedup baseline. None when
+        incremental saving is off, there is no HEAD yet, or the parent
+        manifest is unreadable (then every chunk uploads; correctness
+        never depends on the diff)."""
+        if not self.config.get("ckpt_incremental"):
+            return None
+        try:
+            raw = await self.ioctx.read(layout.head_object(self.name))
+            sid = json.loads(raw.decode()).get("save_id")
+            if not sid:
+                return None
+            raw = await self.ioctx.read(
+                layout.manifest_object(self.name, sid)
+            )
+            return layout.decode_manifest(raw)
+        except (ObjectNotFound, ValueError):
+            return None
 
     async def put_chunks(self) -> None:
         assert self.manifest is not None, "call prepare() first"
+        chunks = self.manifest["chunks"]
+        # fingerprint first (pure CPU): the crc every put needs anyway,
+        # composed into the content hash the dedup diff keys on
+        for chunk in chunks:
+            chunk["hash"] = layout.chunk_fingerprint(self._payload(chunk))
+            chunk["crc"] = int(chunk["hash"][16:], 16)
+        parent = await self._load_parent()
+        reused = layout.diff_chunks(self.manifest, parent)
+        if parent is not None:
+            self.manifest["parent"] = parent["save_id"]
+        if self.perf is not None and reused:
+            self.perf.inc("save_chunks_reused", reused)
+            self.perf.inc("save_bytes_reused", sum(
+                c["length"] for c in chunks if c.get("reused")
+            ))
         window = asyncio.Semaphore(
             max(1, self.config.get("ckpt_max_inflight"))
         )
@@ -92,14 +132,16 @@ class CkptWriter:
                     inflight -= 1
 
         await asyncio.gather(
-            *(put(c) for c in self.manifest["chunks"])
+            *(put(c) for c in chunks if not c.get("reused"))
         )
 
-    async def _put_one(self, chunk: dict) -> None:
-        payload = self._stream[
+    def _payload(self, chunk: dict) -> bytes:
+        return self._stream[
             chunk["offset"]:chunk["offset"] + chunk["length"]
         ]
-        chunk["crc"] = ceph_crc32c(0xFFFFFFFF, payload)
+
+    async def _put_one(self, chunk: dict) -> None:
+        payload = self._payload(chunk)
         if self._compressor is not None:
             compressed, payload = self._compressor.maybe_compress(payload)
             chunk["compressed"] = bool(compressed)
@@ -132,8 +174,6 @@ class CkptWriter:
 
     async def read_head(self):
         """Current HEAD save_id, or None before the first commit."""
-        import json
-
         try:
             raw = await self.ioctx.read(layout.head_object(self.name))
         except ObjectNotFound:

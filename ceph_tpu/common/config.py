@@ -317,6 +317,28 @@ SCHEMA: dict[str, Option] = {
         _opt("ckpt_compression_algorithm", TYPE_STR, LEVEL_ADVANCED, "",
              "compress checkpoint chunks with this algorithm "
              "(zlib|lzma|zstd); empty disables compression"),
+        _opt("ckpt_incremental", TYPE_BOOL, LEVEL_ADVANCED, True,
+             "diff each save against the previous committed manifest "
+             "by chunk content fingerprint and reference unchanged "
+             "chunks from the prior save instead of re-uploading them "
+             "(CheckFreq-style incremental checkpointing)"),
+        _opt("ckpt_async_max_pending", TYPE_UINT, LEVEL_ADVANCED, 2,
+             "save_async() backpressure: at most this many snapshots "
+             "may be persisting in the background; a further submit "
+             "blocks until the oldest completes, so a slow cluster "
+             "throttles the training loop instead of accumulating "
+             "host-memory snapshots", min=1),
+        _opt("ckpt_restore_readahead", TYPE_UINT, LEVEL_ADVANCED, 0,
+             "bounded readahead window of in-flight chunk reads during "
+             "restore (decompress/crc/placement overlap with the reads "
+             "still in flight); 0 inherits ckpt_max_inflight"),
+        _opt("ckpt_gc_keep_last", TYPE_UINT, LEVEL_ADVANCED, 1,
+             "gc retention: keep the newest N committed saves (HEAD is "
+             "always kept); chunks stay live while ANY retained "
+             "manifest references them", min=1),
+        _opt("ckpt_gc_keep_every_nth", TYPE_UINT, LEVEL_ADVANCED, 0,
+             "gc retention: additionally keep every Nth committed save "
+             "from the name's commit history (0 disables)"),
         # bench / profiling
         _opt("bench_profile_trace_dir", TYPE_STR, LEVEL_DEV, "",
              "write jax.profiler traces here when set",

@@ -242,6 +242,22 @@ def _break_lock(ctx: MethodContext, inp: dict):
 # primary, so two racing savers serialize on the object: the loser's stale
 # `expect` fails with ECANCELED and its chunks stay orphaned (gc's job).
 
+#: committed-save history entries the HEAD object retains (gc retention
+#: windows are far smaller; entries whose saves were reclaimed are pruned
+#: by ckpt.prune_history on the next gc pass)
+CKPT_HISTORY_MAX = 512
+
+
+def _ckpt_mirror(ctx: MethodContext, head: dict, history: list) -> None:
+    """Mirror HEAD + commit history into the object data so a plain
+    `ioctx.read(HEAD)` needs no exec."""
+    import json as _json
+
+    ctx.write(_json.dumps(
+        dict(head, history=history), sort_keys=True
+    ).encode())
+
+
 def _ckpt_cas_head(ctx: MethodContext, inp: dict):
     cur = ctx.getxattr("ckpt.head")
     cur_id = None if cur is None else cur.get("save_id")
@@ -252,12 +268,32 @@ def _ckpt_cas_head(ctx: MethodContext, inp: dict):
             f"HEAD is {cur_id!r}, caller expected {expect!r}",
         )
     head = dict(inp["head"])
+    # commit order for gc retention (keep-last-N / keep-every-Nth):
+    # appended atomically with the swap, inside the primary
+    history = list(ctx.getxattr("ckpt.history") or ())
+    history.append(head["save_id"])
+    history = history[-CKPT_HISTORY_MAX:]
     ctx.setxattr("ckpt.head", head)
-    # mirror into the object data so `ioctx.read(HEAD)` needs no exec
-    import json as _json
-
-    ctx.write(_json.dumps(head, sort_keys=True).encode())
+    ctx.setxattr("ckpt.history", history)
+    _ckpt_mirror(ctx, head, history)
     return {"ok": True, "prev": cur_id}
+
+
+def _ckpt_prune_history(ctx: MethodContext, inp: dict):
+    """Drop reclaimed save_ids from the commit history (gc's epilogue;
+    idempotent — pruning an absent id is a no-op). HEAD itself is never
+    prunable."""
+    head = ctx.getxattr("ckpt.head")
+    if head is None:
+        raise ClsError("ENOENT", "no checkpoint HEAD")
+    drop = set(inp.get("remove", ())) - {head.get("save_id")}
+    history = [
+        sid for sid in (ctx.getxattr("ckpt.history") or [])
+        if sid not in drop
+    ]
+    ctx.setxattr("ckpt.history", history)
+    _ckpt_mirror(ctx, head, history)
+    return {"ok": True, "history": history}
 
 
 def _ckpt_read_head(ctx: MethodContext, inp: dict):
@@ -302,4 +338,5 @@ def default_handler() -> ClassHandler:
     h.register("version", "check", RD, _version_check)
     h.register("ckpt", "cas_head", RD | WR, _ckpt_cas_head)
     h.register("ckpt", "read_head", RD, _ckpt_read_head)
+    h.register("ckpt", "prune_history", RD | WR, _ckpt_prune_history)
     return h

@@ -1,12 +1,16 @@
 """Checkpoint store unit tier: deterministic layout (manifest, chunk
 table, EC-stripe alignment, striper naming), pytree path round-trips,
-and the sharding byte-run math restore's partial reads are built on.
-Everything here is pure — no cluster, no IO, no sleeps."""
+the sharding byte-run math restore's partial reads are built on, the
+chunk content fingerprints + incremental diff the dedup fast path keys
+on, and the gc retention selector. Everything here is pure — no
+cluster, no IO, no sleeps."""
 
 import numpy as np
 import pytest
 
 from ceph_tpu.ckpt import layout
+from ceph_tpu.ckpt.gc import select_retained
+from ceph_tpu.common.crc import ceph_crc32c
 from ceph_tpu.parallel.sharding import device_slices, slice_byte_runs
 from ceph_tpu.rados.striper import object_name
 
@@ -127,6 +131,106 @@ def test_flatten_unflatten_round_trip():
     assert np.array_equal(
         layout.unflatten([(solo[0]["path"], solo[0]["leaf"])]), np.arange(5)
     )
+
+
+# -- chunk fingerprints + incremental diff ------------------------------------
+
+
+def test_chunk_fingerprint_composition_and_determinism():
+    payload = b"the same bytes" * 100
+    fp = layout.chunk_fingerprint(payload)
+    assert fp == layout.chunk_fingerprint(bytes(payload))
+    assert len(fp) == 24 and int(fp, 16) >= 0
+    # the tail 8 hex chars ARE the put's crc32c (computed once, reused)
+    assert int(fp[16:], 16) == ceph_crc32c(0xFFFFFFFF, payload)
+    # a single flipped byte moves both hash families
+    other = layout.chunk_fingerprint(payload[:-1] + b"X")
+    assert other[:16] != fp[:16] and other[16:] != fp[16:]
+
+
+def _manifests_for_diff(chunk=256):
+    rng = np.random.default_rng(11)
+    base = rng.integers(0, 256, 4 * chunk, np.uint8)
+    changed = base.copy()
+    changed[2 * chunk:3 * chunk] ^= 1  # exactly chunk index 2 differs
+    prev = layout.build_manifest(
+        "ck", "old", layout.flatten_tree({"w": base}), chunk_size=chunk
+    )
+    cur = layout.build_manifest(
+        "ck", "new", layout.flatten_tree({"w": changed}), chunk_size=chunk
+    )
+    for m, arr in ((prev, base), (cur, changed)):
+        raw = arr.tobytes()
+        for c in m["chunks"]:
+            payload = raw[c["offset"]:c["offset"] + c["length"]]
+            c["hash"] = layout.chunk_fingerprint(payload)
+            c["crc"] = int(c["hash"][16:], 16)
+            c["stored"] = c["length"]
+    return prev, cur
+
+
+def test_diff_chunks_marks_only_unchanged_and_retargets_objects():
+    prev, cur = _manifests_for_diff()
+    assert layout.diff_chunks(cur, prev) == 3
+    for i, c in enumerate(cur["chunks"]):
+        if i == 2:
+            assert not c.get("reused")
+            assert "new" in c["object"]
+        else:
+            # reused entries point INTO the previous save, fields ride
+            assert c["reused"]
+            assert c["object"] == prev["chunks"][i]["object"]
+            assert c["crc"] == prev["chunks"][i]["crc"]
+    stats = layout.manifest_dedup(cur)
+    assert stats["chunks"] == 4
+    assert stats["chunks_owned"] == 1
+    assert stats["chunks_referenced"] == 3
+    assert stats["dedup_ratio"] == 0.75
+    # no parent -> nothing reused; hashless parent chunks never match
+    _, fresh = _manifests_for_diff()
+    assert layout.diff_chunks(fresh, None) == 0
+    stale = {"chunks": [dict(c, hash=None) for c in prev["chunks"]]}
+    assert layout.diff_chunks(fresh, stale) == 0
+
+
+def test_diff_chunks_is_transitive_through_reused_entries():
+    """A reused entry in the parent already names the ORIGINAL owner,
+    so a grandchild referencing it lands on the oldest save's object —
+    gc reachability then only has one level to chase."""
+    prev, cur = _manifests_for_diff()
+    layout.diff_chunks(cur, prev)
+    grand = {
+        "chunks": [dict(c, reused=False) for c in cur["chunks"]],
+    }
+    # rebuild a third manifest with identical content to `cur`
+    third = {"chunks": [
+        dict(c, object=c["object"].replace("new", "v3"), reused=False)
+        for c in grand["chunks"]
+    ]}
+    assert layout.diff_chunks(third, cur) == 4  # all content matches
+    for i, c in enumerate(third["chunks"]):
+        if i == 2:
+            assert c["object"] == cur["chunks"][2]["object"]  # owner: new
+        else:
+            assert c["object"] == prev["chunks"][i]["object"]  # owner: old
+
+
+# -- gc retention selection ---------------------------------------------------
+
+
+def test_select_retained_keep_last_and_every_nth():
+    hist = [f"s{i}" for i in range(10)]
+    assert select_retained(hist, keep_last=1) == ["s9"]
+    assert select_retained(hist, keep_last=3) == ["s7", "s8", "s9"]
+    # every 3rd from the first commit, plus the newest window
+    assert select_retained(hist, keep_last=2, keep_every_nth=3) == [
+        "s0", "s3", "s6", "s8", "s9"
+    ]
+    # HEAD is always retained, whatever the knobs say
+    assert select_retained(hist, keep_last=0) == ["s9"]
+    assert select_retained([], keep_last=5) == []
+    # order is commit order (oldest first), stable under both policies
+    assert select_retained(hist, keep_last=10, keep_every_nth=2) == hist
 
 
 # -- shard byte-run math ------------------------------------------------------

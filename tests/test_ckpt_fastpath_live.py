@@ -1,0 +1,356 @@
+"""Checkpoint fast path over a live cluster: incremental dedup (only
+changed chunks travel, counter-checked against a local diff oracle),
+manifest-reachability gc (a dedup'd chunk outlives its owning save
+while any retained manifest references it), retention policies
+(keep-last-N / keep-every-Nth with mon cluster-log lines and history
+pruning), async saves (blocking time vs wall time — the acceptance
+≥5x bound — commit ordering, backpressure), and the async kill -9
+story (a save aborted mid-persist leaves the previous HEAD bit-exact
+restorable)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ckpt import CkptStore, layout
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster, live_config
+
+CHUNK = 16384
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+async def _cluster_and_client(cfg=None, name="client.ckfp"):
+    cluster = Cluster(cfg=cfg)
+    await cluster.start()
+    rados = Rados(name, cluster.monmap, config=cluster.cfg)
+    await rados.connect()
+    await cluster.create_pools(rados)
+    return cluster, rados
+
+
+def _fast_cfg(**overrides):
+    cfg = live_config()
+    cfg.set("ckpt_chunk_target_bytes", CHUNK)
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return cfg
+
+
+def _tree(rng, arrays=6, rows=288):
+    # uint8 arrays spanning several chunks EVEN at the EC pool's full-
+    # stripe chunk size (k2m2 rounds the 16K target up to 128K), so a
+    # single-array mutation dirties a bounded chunk range
+    return {
+        f"w{i}": rng.integers(0, 256, (rows, 997), dtype=np.uint8)
+        for i in range(arrays)
+    }
+
+
+def _local_chunk_prints(tree, chunk_size):
+    """Oracle: fingerprints of the save's chunk payloads, computed
+    locally the same way the writer does."""
+    stream = b"".join(
+        np.asarray(v).tobytes() for _, v in sorted(tree.items())
+    )
+    return [
+        layout.chunk_fingerprint(stream[off:off + chunk_size])
+        for off in range(0, len(stream), chunk_size)
+    ]
+
+
+def _assert_trees_equal(got, want):
+    assert sorted(got) == sorted(want)
+    for k in want:
+        assert np.array_equal(np.asarray(got[k]), np.asarray(want[k])), k
+
+
+def test_incremental_dedup_property_and_gc_reachability():
+    """The satellite property test: mutate a random subset of arrays
+    between saves; assert (a) only changed chunks re-upload
+    (counter-checked against a local fingerprint oracle), (b) restore
+    of BOTH save_ids stays bit-exact, (c) gc with the newer save
+    deleted never reclaims chunks the older manifest references — and
+    the mirror case: expiring the OLDER save keeps every chunk the
+    newer manifest still references."""
+
+    async def main():
+        cluster, rados = await _cluster_and_client(_fast_cfg())
+        try:
+            rng = np.random.default_rng(42)
+            for pool in (REP_POOL, EC_POOL):
+                store = CkptStore(rados.io_ctx(pool), "prop")
+                tree1 = _tree(rng)
+                sid1 = await store.save(tree1)
+                chunk_size = (
+                    await store.reader().read_manifest(sid1)
+                )["chunk_bytes"]
+                prints1 = _local_chunk_prints(tree1, chunk_size)
+
+                # mutate a random nonempty subset of arrays
+                tree2 = dict(tree1)
+                victims = rng.choice(
+                    sorted(tree2), size=rng.integers(1, 4), replace=False
+                )
+                for k in victims:
+                    arr = tree2[k].copy()
+                    arr[rng.integers(0, arr.shape[0])] ^= 0xFF
+                    tree2[k] = arr
+                prints2 = _local_chunk_prints(tree2, chunk_size)
+                expect_reused = sum(
+                    p in set(prints1) for p in prints2
+                )
+                assert 0 < expect_reused < len(prints2)
+
+                before = dict(store.perf_dump())
+                sid2 = await store.save(tree2)
+                after = store.perf_dump()
+                uploaded = after["save_chunks"] - before["save_chunks"]
+                reused = (after["save_chunks_reused"]
+                          - before["save_chunks_reused"])
+                # (a) only the changed chunks were re-uploaded
+                assert reused == expect_reused
+                assert uploaded == len(prints2) - expect_reused
+
+                m2 = await store.reader().read_manifest(sid2)
+                assert m2["parent"] == sid1
+                referenced = [
+                    c["object"] for c in m2["chunks"] if c["reused"]
+                ]
+                assert len(referenced) == reused
+                assert all(sid1 in obj for obj in referenced)
+
+                # (b) both saves restore bit-exact
+                _assert_trees_equal(
+                    await store.restore(save_id=sid1), tree1
+                )
+                _assert_trees_equal(
+                    await store.restore(save_id=sid2), tree2
+                )
+
+                # (c) expire the OLDER save: reachability must keep the
+                # sid1-owned chunks sid2 references
+                report = await store.gc(keep_last=1)
+                assert report["head"] == sid2
+                assert sid1 in report["reclaimed_saves"]
+                assert set(referenced) & set(report["removed"]) == set()
+                assert layout.manifest_object("prop", sid1) in \
+                    report["removed"]
+                _assert_trees_equal(await store.restore(), tree2)
+                assert (await store.verify())["ok"]
+
+                # the mirror case: roll BACK to tree1's content (sid3
+                # dedups transitively onto sid1/sid2 objects), expire
+                # everything but HEAD, and the old bytes survive
+                sid3 = await store.save(tree1)
+                report = await store.gc(keep_last=1)
+                assert report["head"] == sid3
+                assert sid2 in report["reclaimed_saves"]
+                _assert_trees_equal(await store.restore(), tree1)
+                assert (await store.verify())["ok"]
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_async_save_blocking_time_crash_consistency_and_backpressure():
+    """The acceptance bound, live: save_async blocking time is >=5x
+    below a synchronous unchanged-majority save's wall time; commits
+    land in submission order; cancelling mid-persist (the in-process
+    kill -9) leaves the previous HEAD bit-exact restorable and its
+    debris collectable; ckpt_async_max_pending throttles submits."""
+
+    async def main():
+        cluster, rados = await _cluster_and_client(
+            _fast_cfg(ckpt_async_max_pending=2)
+        )
+        try:
+            rng = np.random.default_rng(7)
+            store = CkptStore(rados.io_ctx(EC_POOL), "async")
+            tree1 = _tree(rng, arrays=8, rows=1024)  # ~8 MB stream
+            await store.save(tree1)
+
+            # unchanged-majority second save, synchronous: the wall-
+            # time baseline the acceptance compares against
+            tree2 = dict(tree1, w0=tree1["w0"] ^ 1)
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            await store.save(tree2)
+            sync_wall = loop.time() - t0
+
+            # third save, async: blocking time is the submit() stall
+            tree3 = dict(tree2, w1=tree2["w1"] ^ 1)
+            t0 = loop.time()
+            ps = await store.save_async(tree3)
+            blocking = loop.time() - t0
+            assert not ps.done or ps.error is None
+            sid3 = await ps.wait()
+            assert ps.wall_s is not None and ps.wall_s >= 0
+            assert (await store.head())["save_id"] == sid3
+            _assert_trees_equal(await store.restore(), tree3)
+            assert sync_wall >= 5 * blocking, (sync_wall, blocking)
+            perf = store.perf_dump()
+            assert perf["save_async_submits"] == 1
+            assert perf["save_chunks_reused"] > 0
+
+            # commit ordering: two overlapped async saves land with the
+            # LATER submission as HEAD
+            t4 = dict(tree3, w2=tree3["w2"] ^ 1)
+            t5 = dict(t4, w3=t4["w3"] ^ 1)
+            p4 = await store.save_async(t4)
+            p5 = await store.save_async(t5)
+            assert await p4.wait() and await p5.wait()
+            assert (await store.head())["save_id"] == p5.save_id
+            history = (await store.head())["history"]
+            assert history.index(p4.save_id) < history.index(p5.save_id)
+            _assert_trees_equal(await store.restore(), t5)
+
+            # backpressure: with max_pending=2, a third submit joins
+            # the oldest first — afterwards at most one is unfinished
+            p6 = await store.save_async(dict(t5, w4=t5["w4"] ^ 1))
+            p7 = await store.save_async(dict(t5, w5=t5["w5"] ^ 1))
+            p8 = await store.save_async(dict(t5, w0=t5["w0"] ^ 2))
+            assert p6.done  # the submit of p8 had to reap it
+            assert len(store.pending_saves) <= 2
+            await store.drain()
+            assert p8.done and p8.error is None
+            assert (await store.head())["save_id"] == p8.save_id
+            assert store.perf_dump()["save_async_pending_peak"] == 2
+
+            # the async kill -9: die mid-persist, HEAD stays put
+            head_before = (await store.head())["save_id"]
+            tree_before = await store.restore()
+            big = {  # enough chunks that cancel lands mid-flight
+                f"b{i}": rng.integers(0, 256, (256, 997), np.uint8)
+                for i in range(8)
+            }
+            pk = await store.save_async(big)
+            await asyncio.sleep(0.01)  # let some chunk puts take wing
+            pk.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await pk.wait()
+            assert (await store.head())["save_id"] == head_before
+            _assert_trees_equal(await store.restore(), tree_before)
+            # debris of the dead save is orphaned, reclaimable, and
+            # reclaiming it never touches the live checkpoint
+            report = await store.gc()
+            assert all(
+                pk.save_id in obj or head_before not in obj
+                for obj in report["removed"]
+            )
+            _assert_trees_equal(await store.restore(), tree_before)
+            assert (await store.verify())["ok"]
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_gc_retention_policies_history_and_cluster_log():
+    """keep-last-N / keep-every-Nth applied from the commit history the
+    HEAD CAS maintains: expired saves' manifests go away, retained ones
+    stay restorable, each reclaimed save_id lands one mon cluster-log
+    line, and the history prunes to the retained set."""
+
+    async def main():
+        cfg = _fast_cfg(mon_cluster_log_entries=50)
+        cluster, rados = await _cluster_and_client(cfg)
+        try:
+            rng = np.random.default_rng(3)
+            store = CkptStore(rados.io_ctx(REP_POOL), "ret")
+            trees, sids = [], []
+            base = _tree(rng, arrays=3, rows=8)
+            for i in range(6):
+                t = dict(base, w0=base["w0"] ^ (i + 1))
+                trees.append(t)
+                sids.append(await store.save(t))
+            head = await store.head()
+            assert head["history"] == sids
+
+            # keep newest 2 + every 3rd (s0, s3) -> reclaim s1, s2
+            report = await store.gc(keep_last=2, keep_every_nth=3)
+            assert report["retained"] == sorted(
+                [sids[0], sids[3], sids[4], sids[5]]
+            )
+            assert sorted(report["reclaimed_saves"]) == sorted(
+                [sids[1], sids[2]]
+            )
+            for idx in (0, 3, 4, 5):
+                _assert_trees_equal(
+                    await store.restore(save_id=sids[idx]), trees[idx]
+                )
+            # expired manifests are gone; history pruned to retained
+            ls = await store.ls()
+            assert ls["history"] == [sids[0], sids[3], sids[4], sids[5]]
+            present = {e["save_id"] for e in ls["saves"]
+                       if e["manifest"]}
+            assert sids[1] not in present and sids[2] not in present
+            # dedup accounting surfaces per save in ls
+            head_entry = next(
+                e for e in ls["saves"] if e["save_id"] == sids[5]
+            )
+            assert head_entry["dedup"]["chunks_referenced"] > 0
+            assert 0 < head_entry["dedup"]["dedup_ratio"] <= 1
+
+            # one cluster-log line per reclaimed save_id
+            lines = None
+            for _ in range(100):
+                out = await rados.mon_command("log last", {"n": 50})
+                lines = [l["message"] for l in out["lines"]]
+                if sum("gc reclaimed save" in m for m in lines) >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            for sid in (sids[1], sids[2]):
+                assert any(
+                    f"gc reclaimed save {sid}" in m for m in lines
+                ), (sid, lines)
+
+            # a second, stricter pass composes with the pruned history
+            report = await store.gc(keep_last=1)
+            assert report["head"] == sids[5]
+            assert sids[5] in report["retained"]
+            _assert_trees_equal(await store.restore(), trees[5])
+            assert (await store.verify())["ok"]
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_pipelined_restore_readahead_knob():
+    """The restore readahead window: depth 1 serializes reads (peak 1),
+    a deeper window overlaps them (peak > 1), and both restore the same
+    bits; ckpt_restore_readahead=0 inherits ckpt_max_inflight."""
+
+    async def main():
+        cluster, rados = await _cluster_and_client(_fast_cfg())
+        try:
+            rng = np.random.default_rng(9)
+            tree = _tree(rng, arrays=4, rows=512)
+            seed_store = CkptStore(rados.io_ctx(EC_POOL), "ra")
+            await seed_store.save(tree)
+
+            cfg1 = _fast_cfg(ckpt_restore_readahead=1)
+            narrow = CkptStore(
+                rados.io_ctx(EC_POOL), "ra", config=cfg1
+            )
+            _assert_trees_equal(await narrow.restore(), tree)
+            assert narrow.perf_dump()["restore_readahead_peak"] == 1
+
+            wide = CkptStore(rados.io_ctx(EC_POOL), "ra")
+            _assert_trees_equal(await wide.restore(), tree)
+            peak = wide.perf_dump()["restore_readahead_peak"]
+            assert 1 < peak <= wide.config.get("ckpt_max_inflight")
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())

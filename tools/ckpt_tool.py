@@ -9,11 +9,19 @@ Commands:
     restore <name> [--npz out.npz]    restore HEAD (or --save-id) and
                                       optionally write it back to .npz
     ls <name>                         committed HEAD + every save present
-                                      (aborted saves show committed=false)
+                                      (aborted saves show committed=false;
+                                      per-save dedup ratio and owned-vs-
+                                      referenced chunk counts ride along)
     verify <name> [--save-id ID]      fetch + crc-check every chunk
-    gc <name>                         reclaim orphans of aborted saves
+    gc <name> [--keep-last N]         retention + reachability collection
+              [--keep-every-nth N]    (chunks any retained manifest
+                                      references stay live)
     bench [--mb N] [--arrays K]       save/restore throughput, one JSON
-                                      line (GB/s both directions)
+          [--async] [--incremental]   line (GB/s both directions); --async
+                                      adds blocking-vs-wall for a
+                                      backgrounded second save,
+                                      --incremental adds the second-save
+                                      dedup ratio
 
 Output is JSON per command, like tools/ceph.py."""
 
@@ -93,7 +101,10 @@ async def _amain(args) -> int:
         elif args.command == "verify":
             result = await store.verify(args.save_id)
         elif args.command == "gc":
-            result = await store.gc()
+            result = await store.gc(
+                keep_last=args.keep_last,
+                keep_every_nth=args.keep_every_nth,
+            )
         else:
             raise SystemExit(f"unknown command {args.command!r}")
         print(json.dumps(result, indent=2, sort_keys=True))
@@ -135,7 +146,7 @@ async def _bench(args) -> dict:
         assert all(
             np.array_equal(back[k], tree[k]) for k in tree
         ), "restore mismatch"
-        return {
+        result = {
             "bench": "ckpt",
             "pool": args.pool_kind,
             "bytes": total,
@@ -145,6 +156,55 @@ async def _bench(args) -> dict:
             "restore_gbps": round(total / t_restore / 1e9, 4),
             "chunks": store.perf.dump()["save_chunks"],
         }
+
+        def mutate():
+            """Touch ONE of the K arrays: the unchanged-majority
+            second save the async/incremental numbers are defined on."""
+            tree["w0"] = rng.integers(0, 256, per, np.uint8)
+
+        if args.bench_incremental or args.bench_async:
+            # second save, synchronous: the blocking-time baseline AND
+            # the dedup measurement (only changed chunks upload)
+            before = dict(store.perf.dump())
+            mutate()
+            t0 = time.perf_counter()
+            sid = await store.save(tree)
+            t_second = time.perf_counter() - t0
+            after = store.perf.dump()
+            reused = after["save_chunks_reused"] - before["save_chunks_reused"]
+            uploaded = after["save_chunks"] - before["save_chunks"]
+            result.update({
+                "second_save_s": round(t_second, 6),
+                "chunks_reused": reused,
+                "chunks_uploaded": uploaded,
+                "dedup_ratio": round(
+                    reused / max(reused + uploaded, 1), 4
+                ),
+            })
+            back = await store.restore(save_id=sid)
+            assert all(
+                np.array_equal(back[k], tree[k]) for k in tree
+            ), "incremental restore mismatch"
+        if args.bench_async:
+            # third save, backgrounded: blocking time (the train-
+            # visible stall) vs the persist wall time
+            mutate()
+            t0 = time.perf_counter()
+            ps = await store.save_async(tree)
+            block_s = time.perf_counter() - t0
+            await ps.wait()
+            result.update({
+                "block_s": round(block_s, 6),
+                "wall_s": round(ps.wall_s, 6),
+                "blocking_speedup": round(
+                    result.get("second_save_s", t_save) / max(block_s, 1e-9), 2
+                ),
+            })
+            back = await store.restore()
+            assert all(
+                np.array_equal(back[k], tree[k]) for k in tree
+            ), "async restore mismatch"
+        return result
     finally:
         await rados.shutdown()
         await cluster.stop()
@@ -160,6 +220,15 @@ def main(argv=None) -> int:
     ap.add_argument("--mb", type=int, default=16)
     ap.add_argument("--arrays", type=int, default=4)
     ap.add_argument("--pool-kind", choices=("rep", "ec"), default="ec")
+    ap.add_argument("--keep-last", type=int, default=None)
+    ap.add_argument("--keep-every-nth", type=int, default=None)
+    ap.add_argument("--async", dest="bench_async", action="store_true",
+                    help="bench: blocking-vs-wall of a save_async "
+                    "second save")
+    ap.add_argument("--incremental", dest="bench_incremental",
+                    action="store_true",
+                    help="bench: dedup ratio of an unchanged-majority "
+                    "second save")
     ap.add_argument("command",
                     choices=("save", "restore", "ls", "verify", "gc",
                              "bench"))
