@@ -290,6 +290,46 @@ def _ckpt_line() -> None:
         pass
 
 
+def _data_line() -> None:
+    """Optional JSON line: dataset ingest + sustained shuffled-read
+    throughput through the full stack (DataStore -> prefetching
+    iterator -> ranged striper reads -> OSD EC decode), via
+    tools/data_tool.py's in-process bench. The line carries both read
+    modes — block-granular readahead pipeline vs the
+    data_prefetch_batches=0 fetch-on-demand baseline — so the prefetch
+    speedup is self-contained. Guarded (--data / CEPH_TPU_BENCH_DATA=1)
+    and non-fatal."""
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            [sys.executable, "tools/data_tool.py", "bench",
+             "--mb", os.environ.get("CEPH_TPU_BENCH_DATA_MB", "16"),
+             "--record-kb", "64", "--shards", "8",
+             "--pool-kind", "ec"],
+            capture_output=True, timeout=600, check=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        r = json.loads(out.stdout.strip().splitlines()[-1])
+        print(json.dumps({
+            "metric": "data_read_throughput",
+            "value": r["read_gbps"],
+            "unit": "GB/s",
+            "ingest_gbps": r["ingest_gbps"],
+            "records_per_s": r["records_per_s"],
+            "bytes": r["bytes"],
+            "records": r["records"],
+            "shards": r["shards"],
+            "pool": r["pool"],
+            # prefetch pipeline vs fetch-on-demand baseline
+            "noprefetch_gbps": r["read_noprefetch_gbps"],
+            "prefetch_speedup": r["prefetch_speedup"],
+            "prefetch_hit_rate": r["prefetch_hit_rate"],
+        }))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def main() -> None:
     import jax
 
@@ -340,6 +380,8 @@ def main() -> None:
         _fault_overhead_line()
     if "--ckpt" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_CKPT"):
         _ckpt_line()
+    if "--data" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_DATA"):
+        _data_line()
 
 
 if __name__ == "__main__":

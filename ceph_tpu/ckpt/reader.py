@@ -29,6 +29,7 @@ from ceph_tpu.ckpt import layout
 from ceph_tpu.common.compressor import factory as compressor_factory
 from ceph_tpu.common.crc import ceph_crc32c
 from ceph_tpu.rados.client import ObjectNotFound
+from ceph_tpu.rados.striper import read_runs
 
 
 class CkptCorrupt(Exception):
@@ -209,10 +210,14 @@ class CkptReader:
                             cache[ci] = await self._fetch_chunk(chunk)
                 out.append(cache[ci][off_in:off_in + take])
             else:
-                async with window:
-                    part = await self.ioctx.read(
-                        chunk["object"], off=off_in, length=take
-                    )
+                # ranged sub-object read via the shared striper helper
+                # (offset/length pushdown; the same path the dataset
+                # iterator's coalesced record runs ride)
+                [part] = await read_runs(
+                    self.ioctx,
+                    [(chunk["object"], off_in, take)],
+                    window,
+                )
                 if self.perf is not None:
                     self.perf.inc("restore_read_bytes", len(part))
                 out.append(part)

@@ -286,7 +286,7 @@ SCHEMA: dict[str, Option] = {
                  "tracer_sample_rate", min=-1.0, max=1.0,
                  see_also=("tracer_sample_rate",))
             for t in ("read", "write", "ops", "delete", "call", "stat",
-                      "recovery")
+                      "recovery", "command", "balancer")
         ],
         _opt("tracer_export_path", TYPE_STR, LEVEL_ADVANCED, "",
              "append finished spans as Jaeger-compatible JSONL here "
@@ -339,6 +339,35 @@ SCHEMA: dict[str, Option] = {
         _opt("ckpt_gc_keep_every_nth", TYPE_UINT, LEVEL_ADVANCED, 0,
              "gc retention: additionally keep every Nth committed save "
              "from the name's commit history (0 disables)"),
+        # dataset store (ceph_tpu.data: record-sharded training-data
+        # ingestion + prefetching iterator over RADOS)
+        _opt("data_shard_bytes", TYPE_UINT, LEVEL_ADVANCED, 4 << 20,
+             "target shard-object size for dataset ingests; each shard's "
+             "striper sub-objects are rounded up to a full EC stripe so "
+             "shard puts never read-modify-write", min=4096),
+        _opt("data_compression_algorithm", TYPE_STR, LEVEL_ADVANCED, "",
+             "compress dataset records with this algorithm "
+             "(zlib|lzma|zstd); empty disables compression"),
+        _opt("data_max_inflight", TYPE_UINT, LEVEL_ADVANCED, 8,
+             "bounded window of concurrent shard/index puts per dataset "
+             "ingest", min=1),
+        _opt("data_prefetch_batches", TYPE_UINT, LEVEL_ADVANCED, 2,
+             "background batch-prefetch depth of the dataset iterator: "
+             "this many upcoming batches may have their ranged shard "
+             "reads in flight while the training step consumes the "
+             "current one; 0 disables prefetch (serial fetch-on-demand)"),
+        _opt("data_cache_bytes", TYPE_UINT, LEVEL_ADVANCED, 64 << 20,
+             "client-side block cache of the prefetching dataset "
+             "iterator: readahead fetches whole striper sub-objects "
+             "(one EC decode per block at the OSD, amortized over every "
+             "record inside) and keeps up to this many bytes LRU-"
+             "resident; 0 falls back to exact per-record ranged reads"),
+        _opt("osd_mclock_data_weight", TYPE_FLOAT, LEVEL_ADVANCED, 0.25,
+             "mclock weight of the background data-prefetch client "
+             "class (op_queue.QOS_DATA_PREFETCH): bulk dataset reads get "
+             "this proportional share against weight-1 foreground "
+             "clients, so prefetch cannot starve ckpt/RBD traffic",
+             min=0.01),
         # bench / profiling
         _opt("bench_profile_trace_dir", TYPE_STR, LEVEL_DEV, "",
              "write jax.profiler traces here when set",

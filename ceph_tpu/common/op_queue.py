@@ -129,6 +129,23 @@ class ClientInfo:
     limit: float = 0.0  # 0 = unlimited
 
 
+#: mclock class for bulk dataset-prefetch reads (ceph_tpu.data): ops
+#: tagged with this class ride a background profile instead of the
+#: per-client default, so a saturating prefetch pipeline cannot starve
+#: foreground ckpt/RBD traffic (the reference's background_best_effort
+#: mclock class for scrub/pg-delete plays the same role)
+QOS_DATA_PREFETCH = "data_prefetch"
+
+
+def data_prefetch_profile(weight: float = 0.25) -> ClientInfo:
+    """Background profile for QOS_DATA_PREFETCH: a fractional weight
+    against the weight-1 foreground default — under contention the
+    foreground classes keep ~1/(1+w) of service each relative to
+    prefetch's w, while an idle cluster still serves prefetch at full
+    rate (no limit: weight shapes contention only)."""
+    return ClientInfo(reservation=0.0, weight=max(0.01, weight), limit=0.0)
+
+
 class MClockQueue:
     """dmclock tag scheduling on a caller-driven virtual clock."""
 

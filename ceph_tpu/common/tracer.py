@@ -76,6 +76,7 @@ def current_trace_id() -> str | None:
 #: cached-rate table in lockstep with common/config.py
 _OP_RATE_TYPES = (
     "read", "write", "ops", "delete", "call", "stat", "recovery",
+    "command", "balancer",
 )
 
 

@@ -1,0 +1,25 @@
+"""ceph_tpu.data — RADOS-native sharded training-data ingestion and a
+deterministic, prefetching, resumable dataset iterator (the DataStore
+subsystem; see COMPONENTS.md "Data ingestion")."""
+
+from ceph_tpu.data.layout import (
+    DataCorrupt,
+    cursor_array,
+    cursor_from_array,
+    epoch_permutation,
+)
+from ceph_tpu.data.reader import DataIterator, DataReader
+from ceph_tpu.data.store import DataStore
+from ceph_tpu.data.writer import DataConflict, DataWriter
+
+__all__ = [
+    "DataConflict",
+    "DataCorrupt",
+    "DataIterator",
+    "DataReader",
+    "DataStore",
+    "DataWriter",
+    "cursor_array",
+    "cursor_from_array",
+    "epoch_permutation",
+]

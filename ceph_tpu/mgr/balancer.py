@@ -30,8 +30,12 @@ from ceph_tpu.osd.osdmap import OSDMap
 
 
 class BalancerModule:
-    def __init__(self, mon_client):
+    def __init__(self, mon_client, tracer=None):
         self.mon = mon_client
+        #: optional common.tracer.Tracer: each run_once becomes a root
+        #: `mgr_balancer_tick` span (sampled by tracer_sample_rate_
+        #: balancer) whose mon command hops nest beneath it
+        self.tracer = tracer
 
     async def run_once(
         self,
@@ -41,6 +45,28 @@ class BalancerModule:
         mode: str = "upmap",
     ) -> dict:
         """One balancer pass; returns {changes, mappings} as committed."""
+        span = token = None
+        if self.tracer is not None:
+            span = self.tracer.start(
+                "mgr_balancer_tick", tags={"mode": mode},
+                op_type="balancer",
+            )
+            token = self.tracer.use(span) if span is not None else None
+        try:
+            result = await self._run_once_inner(
+                pools, max_deviation, max_changes, mode
+            )
+            if span is not None:
+                span.set_tag("changes", result.get("changes", 0))
+            return result
+        finally:
+            if span is not None:
+                self.tracer.release(token)
+                span.finish()
+
+    async def _run_once_inner(
+        self, pools, max_deviation, max_changes, mode
+    ) -> dict:
         if mode == "crush-compat":
             return await self.crush_compat(pools=pools)
         osdmap = await self.mon.wait_for_map()

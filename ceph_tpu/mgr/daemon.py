@@ -83,7 +83,10 @@ class MgrService:
         from ceph_tpu.mgr.prometheus import PrometheusExporter
 
         self.modules = {
-            "balancer": BalancerModule(self.objecter.mon),
+            "balancer": BalancerModule(
+                self.objecter.mon,
+                tracer=getattr(self.objecter, "tracer", None),
+            ),
             "pg_autoscaler": PgAutoscaler(self.objecter),
             "prometheus": PrometheusExporter(self.objecter),
             "dashboard": DashboardModule(self.objecter),

@@ -156,9 +156,17 @@ class MonClient(Dispatcher):
             payload["tid"] = tid
             fut = asyncio.get_event_loop().create_future()
             self._waiters[tid] = fut
+            # propagate the active trace context so the mon's command-
+            # dispatch span joins the caller's tree (mgr balancer ticks,
+            # traced client admin ops)
+            from ceph_tpu.common.tracer import current_context
+
+            ctx = current_context()
             self._conn().send_message(
                 Message(type="mon_command", tid=tid,
-                        data=json.dumps(payload).encode())
+                        data=json.dumps(payload).encode(),
+                        trace=ctx.encode()
+                        if ctx is not None and ctx.sampled else "")
             )
             remain = deadline - asyncio.get_event_loop().time()
             if remain <= 0:

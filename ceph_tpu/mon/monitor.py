@@ -42,6 +42,7 @@ from dataclasses import dataclass
 from ceph_tpu.common.config import Config
 from ceph_tpu.common.encoding import Decoder, Encoder
 from ceph_tpu.common.kv import KeyValueDB, KVTransaction, MemDB
+from ceph_tpu.common.tracer import Tracer
 from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy
 from ceph_tpu.osd.osdmap import Incremental, OSDMap
 
@@ -95,6 +96,10 @@ class Monitor(Dispatcher):
             self.name, config=self.config, keyring=keyring
         )
         self.messenger.dispatcher = self
+        #: control-plane spans (mon command dispatch): `dump_tracing`
+        #: trees grow mon hops when a traced client sends a command
+        self.tracer = Tracer(self.name, config=self.config)
+        self.messenger.tracer = self.tracer
 
         # election state
         self.state = "electing"
@@ -245,6 +250,7 @@ class Monitor(Dispatcher):
             except (asyncio.CancelledError, Exception):
                 pass
         await self.messenger.shutdown()
+        self.tracer.close()
 
     @property
     def is_leader(self) -> bool:
@@ -693,6 +699,8 @@ class Monitor(Dispatcher):
 
     async def ms_dispatch(self, conn, msg: Message) -> None:
         p = json.loads(msg.data) if msg.data else {}
+        if msg.trace:
+            p["_trace"] = msg.trace
         handler = getattr(self, f"_h_{msg.type}", None)
         if handler is None:
             return
@@ -951,11 +959,26 @@ class Monitor(Dispatcher):
                 {"tid": p.get("tid"), "redirect": self.leader_rank},
             )
             return
+        # control-plane span: continue the client's trace when one rides
+        # the message, else start a root sampled by
+        # tracer_sample_rate_command
+        span = self.tracer.join(
+            p.get("_trace"), "mon_command", tags={"cmd": p.get("cmd")}
+        ) or self.tracer.start(
+            "mon_command", tags={"cmd": p.get("cmd")}, op_type="command"
+        )
+        token = self.tracer.use(span) if span is not None else None
         try:
             result = await self._run_command(p, conn)
             reply = {"tid": p.get("tid"), "ok": True, "result": result}
         except Exception as e:  # commands reply, never crash the mon
             reply = {"tid": p.get("tid"), "ok": False, "error": str(e)}
+            if span is not None:
+                span.set_tag("error", str(e) or type(e).__name__)
+        finally:
+            if span is not None:
+                self.tracer.release(token)
+                span.finish()
         self._send(conn, "mon_command_reply", reply)
 
     def _forward_to_leader(self, msg_type: str, p: dict, conn) -> bool:
@@ -1519,6 +1542,12 @@ class Monitor(Dispatcher):
             return {}
         if cmd == "health":
             return self._health()
+        if cmd == "dump_tracing":
+            # mon-side completed spans (command dispatch hops), the same
+            # drain surface the OSD admin socket exposes
+            return self.tracer.dump_tracing(
+                drain=bool(args.get("drain", True))
+            )
         if cmd == "mds beacon":
             return await self._cmd_mds_beacon(args)
         if cmd == "mgr beacon":

@@ -504,19 +504,32 @@ class OSDService(Dispatcher):
         # scheduler inside each shard is selected by osd_op_queue
         # (wpq | mclock), the reference's op-queue switch
         from ceph_tpu.common.op_queue import (
+            QOS_DATA_PREFETCH,
             MClockOpQueue,
             WeightedPriorityQueue,
+            data_prefetch_profile,
         )
 
         queue_kind = self.config.get("osd_op_queue")
+        try:
+            data_weight = float(self.config.get("osd_mclock_data_weight"))
+        except Exception:
+            data_weight = 0.25
+
+        def _make_queue():
+            if queue_kind != "mclock":
+                return WeightedPriorityQueue()
+            q = MClockOpQueue()
+            # bulk dataset prefetch rides a background weight profile so
+            # it can't starve foreground (weight-1) client classes
+            q.set_profile(
+                QOS_DATA_PREFETCH, data_prefetch_profile(data_weight)
+            )
+            return q
 
         class _OpShard:
             def __init__(self):
-                self.queue = (
-                    MClockOpQueue()
-                    if queue_kind == "mclock"
-                    else WeightedPriorityQueue()
-                )
+                self.queue = _make_queue()
                 self.kick = asyncio.Event()
                 #: object name -> in-flight PIPELINED op tasks; inline
                 #: ops on the same object drain these first so
@@ -2712,9 +2725,12 @@ class OSDService(Dispatcher):
         ]
         # queue-wait span: enqueue here, finished when the shard worker
         # picks the op — the ShardedOpWQ wait is a first-class trace leg
+        # the queue class: a client-declared QoS class (ioctx.qos_class,
+        # e.g. background data prefetch) wins over the per-client default
+        klass = p.get("qos") or conn.peer_name
         qs = self.tracer.join(
             p.get("_trace"), "op_queue",
-            tags={"klass": conn.peer_name},
+            tags={"klass": klass},
         )
         if qs is not None:
             p["_qspan"] = qs
@@ -2722,7 +2738,7 @@ class OSDService(Dispatcher):
             63,  # osd_client_op_priority
             max(1, len(p["_raw"]) // 4096),
             (conn, p),
-            klass=conn.peer_name,
+            klass=klass,
         )
         shard.kick.set()
 

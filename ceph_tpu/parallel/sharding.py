@@ -152,6 +152,23 @@ def device_slices(shape, spec, mesh: Mesh):
     return sharding.addressable_devices_indices_map(tuple(shape))
 
 
+def host_slice(n: int, num_hosts: int, host: int) -> slice:
+    """Balanced contiguous partition of `n` items across `num_hosts`:
+    host h owns items [start, stop) with the first n % num_hosts hosts
+    taking one extra. Pure and total — every process computes the same
+    partition, which is what makes the dataset iterator's per-host
+    record sequences deterministic without coordination (the same
+    contract device_slices provides for array slabs)."""
+    if num_hosts <= 0:
+        raise ValueError("num_hosts must be positive")
+    if not 0 <= host < num_hosts:
+        raise ValueError(f"host {host} outside [0, {num_hosts})")
+    base, extra = divmod(n, num_hosts)
+    start = host * base + min(host, extra)
+    stop = start + base + (1 if host < extra else 0)
+    return slice(start, stop)
+
+
 def slice_byte_runs(shape, itemsize: int, idx) -> list[tuple[int, int]]:
     """Contiguous (offset, length) byte runs of a row-major array covered
     by index-tuple `idx`, coalesced: a slab contiguous in memory (the

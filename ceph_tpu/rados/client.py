@@ -461,6 +461,16 @@ class IoCtx:
         self.snapc: dict | None = None
         #: snap id applied to reads (rados_ioctx_snap_set_read)
         self.read_snap: int | None = None
+        #: mclock class ops from this handle are queued under at the OSD
+        #: (op_queue.QOS_DATA_PREFETCH and friends); None = per-client
+        #: default class (the peer name)
+        self.qos_class: str | None = None
+
+    def _qos(self, extra: dict | None) -> dict | None:
+        if self.qos_class:
+            extra = dict(extra) if extra else {}
+            extra["qos"] = self.qos_class
+        return extra
 
     # -- selfmanaged snapshots ------------------------------------------------
 
@@ -499,7 +509,7 @@ class IoCtx:
         rep = await self.objecter.op_submit(
             self.pool_id, name, "ops",
             data=b"".join(datas),
-            extra=extra,
+            extra=self._qos(extra),
         )
         results = rep.get("results", [])
         raw, off = rep["_raw"], 0
@@ -514,7 +524,7 @@ class IoCtx:
     async def write_full(self, name: str, data: bytes) -> None:
         extra = {"snapc": self.snapc} if self.snapc is not None else None
         await self.objecter.op_submit(
-            self.pool_id, name, "write", data, extra=extra
+            self.pool_id, name, "write", data, extra=self._qos(extra)
         )
 
     async def write(self, name: str, data: bytes, off: int = 0) -> None:
@@ -541,7 +551,7 @@ class IoCtx:
         if off == 0 and length is None:
             extra = {"snapid": snap} if snap is not None else None
             rep = await self.objecter.op_submit(
-                self.pool_id, name, "read", extra=extra
+                self.pool_id, name, "read", extra=self._qos(extra)
             )
             return rep["_raw"]
         op = {"op": "read", "off": off}

@@ -15,6 +15,7 @@ exercises placement + encode over stripe_count × k devices at once.
 
 from __future__ import annotations
 
+import asyncio
 from dataclasses import dataclass
 
 
@@ -71,6 +72,36 @@ def file_to_extents(
 
 def object_name(soid: str, objectno: int) -> str:
     return f"{soid}.{objectno:016x}"  # Striper.cc:47 object_format
+
+
+async def read_runs(
+    ioctx,
+    runs: list[tuple[str, int, int]],
+    window: asyncio.Semaphore | None = None,
+) -> list[bytes]:
+    """Ranged sub-object reads: [(object, offset, length)] -> payloads.
+
+    The offset/length pair is pushed down to the primary (`ioctx.read`
+    partial-read path) instead of fetching whole objects — the striped
+    read, the dataset iterator's coalesced record runs, and the ckpt
+    partial restore all fund exactly the bytes they consume. Reads run
+    concurrently under `window` when given (the caller's readahead
+    semaphore), else all at once. Short objects zero-pad to `length`,
+    matching the striper's sparse-tail semantics."""
+
+    async def one(obj: str, off: int, length: int) -> bytes:
+        if length <= 0:
+            return b""
+        if window is None:
+            data = await ioctx.read(obj, off=off, length=length)
+        else:
+            async with window:
+                data = await ioctx.read(obj, off=off, length=length)
+        if len(data) < length:
+            data = data + b"\0" * (length - len(data))
+        return data
+
+    return list(await asyncio.gather(*(one(*r) for r in runs)))
 
 
 class Striper:
@@ -139,9 +170,16 @@ class RadosStriper:
     layout recorded at write time, never the handle's default.
     """
 
-    def __init__(self, ioctx, layout: StripeLayout | None = None):
+    def __init__(self, ioctx, layout: StripeLayout | None = None,
+                 header_cache: dict | None = None):
         self.ioctx = ioctx
         self.layout = layout or StripeLayout()
+        #: optional soid -> (size, layout) cache: readers of immutable
+        #: striped objects (committed dataset shards) pay ONE header
+        #: round trip per soid instead of one per ranged read. Callers
+        #: that overwrite striped objects must not share a cache with
+        #: their readers.
+        self._hdr_cache = header_cache
 
     @staticmethod
     def _hdr_name(soid: str) -> str:
@@ -150,11 +188,16 @@ class RadosStriper:
     async def _read_header(self, soid: str) -> tuple[int, StripeLayout]:
         import json
 
+        if self._hdr_cache is not None and soid in self._hdr_cache:
+            return self._hdr_cache[soid]
         h = json.loads(await self.ioctx.read(self._hdr_name(soid)))
-        return h["size"], StripeLayout(
+        got = h["size"], StripeLayout(
             stripe_unit=h["su"], stripe_count=h["sc"],
             object_size=h["os"],
         )
+        if self._hdr_cache is not None:
+            self._hdr_cache[soid] = got
+        return got
 
     async def write(self, soid: str, data: bytes) -> int:
         # shrinking overwrite: trim data objects the new extent set no
@@ -192,13 +235,20 @@ class RadosStriper:
                  "os": self.layout.object_size}
             ).encode(),
         )
+        if self._hdr_cache is not None:
+            self._hdr_cache[soid] = (len(data), self.layout)
         return len(extents)
 
     async def size(self, soid: str) -> int:
         return (await self._read_header(soid))[0]
 
     async def read(self, soid: str, offset: int = 0,
-                   length: int | None = None) -> bytes:
+                   length: int | None = None,
+                   window: asyncio.Semaphore | None = None) -> bytes:
+        """Ranged striped read: every extent is a sub-object PARTIAL
+        read (offset/length pushed down via read_runs), so a small read
+        of a large striped object moves only its own bytes — the
+        dataset iterator's record-run fast path."""
         total, layout = await self._read_header(soid)
         if length is None:
             length = total - offset
@@ -206,19 +256,18 @@ class RadosStriper:
         if length == 0:
             return b""
         out = bytearray(length)
-        cache: dict[int, bytes] = {}
+        flat: list[tuple[str, int, int]] = []
+        placements: list[tuple[int, int]] = []
         for objectno, runs in file_to_extents(
             layout, offset, length
         ).items():
-            if objectno not in cache:
-                cache[objectno] = await self.ioctx.read(
-                    object_name(soid, objectno)
-                )
-            obj = cache[objectno]
+            obj = object_name(soid, objectno)
             for obj_off, n, file_off in runs:
-                piece = obj[obj_off: obj_off + n]
-                piece = piece + b"\0" * (n - len(piece))
-                out[file_off - offset: file_off - offset + n] = piece
+                flat.append((obj, obj_off, n))
+                placements.append((file_off - offset, n))
+        pieces = await read_runs(self.ioctx, flat, window)
+        for (dst, n), piece in zip(placements, pieces):
+            out[dst: dst + n] = piece
         return bytes(out)
 
     async def remove(self, soid: str) -> None:

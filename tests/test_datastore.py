@@ -1,0 +1,229 @@
+"""DataStore pure layer: naming, record/index/manifest codecs, the
+deterministic shuffle/partition math (the property the multi-host
+iterator's correctness rests on), and resumable-cursor round trips."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.data import layout
+from ceph_tpu.parallel.sharding import host_slice
+
+
+# -- naming -------------------------------------------------------------------
+
+
+def test_naming_scheme():
+    assert layout.head_object("ds") == "ds.data-head"
+    assert layout.manifest_object("ds", "abc") == "ds@abc.manifest"
+    assert layout.shard_soid("ds", "abc", 3) == "ds@abc/shard.00000003"
+    assert (layout.shard_index_object("ds", "abc", 3)
+            == "ds@abc/shard.00000003.idx")
+
+
+def test_ingest_id_of_handles_shard_and_suffix_names():
+    # striper sub-objects of a shard, index objects, manifests, headers
+    assert layout.ingest_id_of(
+        "ds@abc/shard.00000001.0000000000000000", "ds") == "abc"
+    assert layout.ingest_id_of("ds@abc/shard.00000001.idx", "ds") == "abc"
+    assert layout.ingest_id_of("ds@abc.manifest", "ds") == "abc"
+    assert layout.ingest_id_of("ds@abc", "ds") == "abc"
+    assert layout.ingest_id_of("other@abc", "ds") is None
+    assert layout.ingest_id_of("ds.data-head", "ds") is None
+
+
+def test_sub_object_bytes_full_stripe_aligned():
+    # EC k2m2 with 64KiB stripe units: full stripe = 128KiB
+    align = 2 * 65536
+    assert layout.sub_object_bytes(align, 4 << 20) % align == 0
+    # small shards still round UP to one full stripe
+    assert layout.sub_object_bytes(align, 1000) == align
+
+
+# -- record codec -------------------------------------------------------------
+
+
+def test_record_round_trip_uncompressed():
+    stored, e = layout.encode_record(b"hello world", 7)
+    assert stored == b"hello world"
+    assert e[0] == 7 and e[1] == e[2] == 11 and e[4] == 0
+    assert layout.decode_record(stored, e) == b"hello world"
+
+
+def test_record_round_trip_compressed():
+    from ceph_tpu.common.compressor import factory
+
+    payload = b"abc" * 5000
+    stored, e = layout.encode_record(payload, 0, factory("zlib"))
+    assert e[4] == 1 and e[1] < e[2]
+    assert layout.decode_record(stored, e, "zlib") == payload
+
+
+def test_record_corruption_detected():
+    stored, e = layout.encode_record(b"x" * 1000, 0)
+    bad = bytearray(stored)
+    bad[500] ^= 0x01
+    with pytest.raises(layout.DataCorrupt, match="crc"):
+        layout.decode_record(bytes(bad), e)
+    with pytest.raises(layout.DataCorrupt, match="stored"):
+        layout.decode_record(stored[:-1], e)
+
+
+def test_record_corruption_detected_compressed():
+    from ceph_tpu.common.compressor import factory
+
+    stored, e = layout.encode_record(b"y" * 9000, 0, factory("zlib"))
+    bad = bytearray(stored)
+    bad[0] ^= 0xFF  # breaks the zlib header itself
+    with pytest.raises(layout.DataCorrupt):
+        layout.decode_record(bytes(bad), e, "zlib")
+
+
+def test_index_round_trip():
+    entries = [[0, 10, 10, 123, 0], [10, 8, 12, 456, 1]]
+    assert layout.decode_index(layout.encode_index(entries)) == entries
+
+
+# -- manifest -----------------------------------------------------------------
+
+
+def _manifest(counts=(10, 5, 7)):
+    return layout.build_manifest(
+        "ds", "abc",
+        [{"index": i, "records": c, "bytes": c * 100, "stored": c * 90}
+         for i, c in enumerate(counts)],
+        shard_bytes=1 << 20, sub_object=1 << 17,
+        schema={"dtype": "float32", "shape": [4]},
+    )
+
+
+def test_manifest_round_trip_and_totals():
+    m = _manifest()
+    assert m["record_count"] == 22
+    assert m["total_bytes"] == 2200
+    assert layout.decode_manifest(layout.encode_manifest(m)) == m
+    with pytest.raises(ValueError, match="format"):
+        layout.decode_manifest(json.dumps({"format": 99}).encode())
+
+
+def test_locate_record_to_shard():
+    m = _manifest((10, 5, 7))
+    starts = layout.shard_starts(m)
+    assert layout.locate(m, starts, 0) == (0, 0)
+    assert layout.locate(m, starts, 9) == (0, 9)
+    assert layout.locate(m, starts, 10) == (1, 0)
+    assert layout.locate(m, starts, 14) == (1, 4)
+    assert layout.locate(m, starts, 15) == (2, 0)
+    assert layout.locate(m, starts, 21) == (2, 6)
+
+
+# -- deterministic shuffle + per-host partition -------------------------------
+
+
+def test_epoch_permutation_deterministic_and_complete():
+    p1 = layout.epoch_permutation(997, seed=42, epoch=3)
+    p2 = layout.epoch_permutation(997, seed=42, epoch=3)
+    assert np.array_equal(p1, p2)
+    assert sorted(p1.tolist()) == list(range(997))
+
+
+def test_epoch_permutation_varies_by_seed_and_epoch():
+    base = layout.epoch_permutation(500, seed=1, epoch=0)
+    assert not np.array_equal(base, layout.epoch_permutation(500, 2, 0))
+    assert not np.array_equal(base, layout.epoch_permutation(500, 1, 1))
+
+
+@pytest.mark.parametrize("seed", [0, 7, 123456789])
+@pytest.mark.parametrize("epoch", [0, 1, 17])
+@pytest.mark.parametrize("num_hosts", [1, 2, 3, 8])
+def test_per_host_sequences_identical_and_partition_exact(
+    seed, epoch, num_hosts
+):
+    """THE multi-host property: every 'process' computing the plan
+    independently derives identical per-host sequences, and the host
+    sequences partition the dataset exactly — no dups, no gaps."""
+    n = 101  # deliberately not divisible by any host count
+
+    def host_seq(h):
+        perm = layout.epoch_permutation(n, seed, epoch)
+        return perm[host_slice(n, num_hosts, h)]
+
+    # "two processes" compute the same plan independently
+    for h in range(num_hosts):
+        assert np.array_equal(host_seq(h), host_seq(h))
+    union = np.concatenate([host_seq(h) for h in range(num_hosts)])
+    assert sorted(union.tolist()) == list(range(n))
+    sizes = [len(host_seq(h)) for h in range(num_hosts)]
+    assert max(sizes) - min(sizes) <= 1  # balanced
+
+
+def test_host_slice_validation():
+    with pytest.raises(ValueError):
+        host_slice(10, 0, 0)
+    with pytest.raises(ValueError):
+        host_slice(10, 4, 4)
+    with pytest.raises(ValueError):
+        host_slice(10, 4, -1)
+
+
+# -- run coalescing -----------------------------------------------------------
+
+
+def test_coalesce_adjacent_entries():
+    entries = [
+        [0, 10, 10, 0, 0], [10, 5, 5, 0, 0],   # adjacent -> one run
+        [20, 5, 5, 0, 0],                      # gap -> new run
+        [25, 5, 5, 0, 0],                      # adjacent again
+    ]
+    runs = layout.coalesce_entries(entries)
+    assert [(r["offset"], r["length"]) for r in runs] == [(0, 15), (20, 10)]
+    assert [len(r["entries"]) for r in runs] == [2, 2]
+
+
+def test_coalesce_sorts_by_offset():
+    entries = [[20, 5, 5, 0, 0], [0, 10, 10, 0, 0], [10, 10, 10, 0, 0]]
+    runs = layout.coalesce_entries(entries)
+    assert [(r["offset"], r["length"]) for r in runs] == [(0, 25)]
+
+
+# -- resumable cursor ---------------------------------------------------------
+
+
+def test_cursor_array_round_trip():
+    state = layout.cursor_state(
+        name="ds", ingest_id="abc", seed=11, epoch=2, position=96,
+        num_hosts=4, host=3, batch_size=32,
+    )
+    arr = layout.cursor_array(state)
+    assert arr.dtype == np.uint8
+    assert layout.cursor_from_array(arr) == state
+    # survives the lossless casts a checkpoint round trip applies
+    assert layout.cursor_from_array(arr.copy()) == state
+
+
+def test_cursor_remaining_records_exact():
+    """A cursor at (epoch, position) resumes with EXACTLY the unyielded
+    suffix of the host's sequence — the no-dups/no-gaps contract the
+    live kill -9 test exercises end to end."""
+    n, seed, epoch = 100, 5, 1
+    perm = layout.epoch_permutation(n, seed, epoch)
+    host_ids = perm[host_slice(n, 2, 0)]
+    consumed = host_ids[:17].tolist()
+    state = layout.cursor_state(
+        name="ds", ingest_id="x", seed=seed, epoch=epoch, position=17,
+        num_hosts=2, host=0, batch_size=17,
+    )
+    # an independent process recomputes the remainder from the cursor
+    perm2 = layout.epoch_permutation(n, state["seed"], state["epoch"])
+    rest = perm2[host_slice(n, state["num_hosts"], state["host"])]
+    rest = rest[state["position"]:].tolist()
+    assert sorted(consumed + rest) == sorted(host_ids.tolist())
+    assert not set(consumed) & set(rest)
+
+
+def test_cursor_format_guard():
+    with pytest.raises(ValueError, match="format"):
+        layout.cursor_from_array(
+            np.frombuffer(json.dumps({"format": 9}).encode(), np.uint8)
+        )

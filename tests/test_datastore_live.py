@@ -1,0 +1,302 @@
+"""DataStore over a live cluster: ingest/iterate round trips on
+replicated and EC pools, the deterministic per-host shuffle computed by
+independent clients, mid-epoch kill -9 + cursor resume with no
+duplicate and no missing records, the cursor riding a CkptStore
+checkpoint, crash-consistency at the HEAD CAS, iteration under
+osd_op_queue=mclock (prefetch ops ride their own QoS class), and the
+mon-side command spans + mgr balancer tick landing in dump_tracing."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.data import DataStore, cursor_array
+from ceph_tpu.data.writer import DataConflict
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster, live_config
+from tests.test_trace_live import traced_cluster_cfg
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+def _records(n=60, rows=8):
+    return [
+        (np.arange(rows * 4, dtype=np.float32) + 1000 * i).reshape(rows, 4)
+        for i in range(n)
+    ]
+
+
+def _ids_of(batch):
+    return [int(b[0, 0]) // 1000 for b in batch]
+
+
+async def _cluster_and_client(cfg=None, name="client.data"):
+    cluster = Cluster(cfg=cfg)
+    await cluster.start()
+    rados = Rados(name, cluster.monmap, config=cluster.cfg)
+    await rados.connect()
+    await cluster.create_pools(rados)
+    return cluster, rados
+
+
+def test_datastore_ingest_iterate_round_trip_both_pools():
+    """Tensor records in, shuffled batches out — bit-exact on the
+    replicated AND the EC pool, verify() green, a bytes dataset (no
+    schema) yields raw payloads, and an uncommitted ingest is invisible
+    (the crash window) while a stale CAS raises DataConflict."""
+
+    async def main():
+        cluster, rados = await _cluster_and_client()
+        cluster.cfg.set("data_shard_bytes", 4096)
+        try:
+            recs = _records(60)
+            for pool in (REP_POOL, EC_POOL):
+                store = DataStore(rados.io_ctx(pool), f"train-{pool}")
+                await store.ingest(recs)
+                v = await store.verify()
+                assert v["record_count"] == 60
+                assert len(v["shards"]) > 1  # actually sharded
+                it = await store.iterator(seed=3, batch_size=16)
+                got = {}
+                async for batch in it:
+                    assert batch.dtype == np.float32
+                    assert batch.shape[1:] == (8, 4)
+                    for row in batch:
+                        got[int(row[0, 0]) // 1000] = row
+                assert sorted(got) == list(range(60))
+                for i, row in got.items():
+                    assert np.array_equal(row, recs[i])
+
+            # bytes records (no schema): payloads come back verbatim
+            blobs = [bytes([i]) * (100 + i) for i in range(20)]
+            bstore = DataStore(rados.io_ctx(EC_POOL), "blobs")
+            await bstore.ingest(blobs)
+            out = []
+            it = await bstore.iterator(seed=1, batch_size=7)
+            async for batch in it:
+                out.extend(batch)
+            assert sorted(out) == sorted(blobs)
+
+            # crash window: shards + manifest up, no commit -> invisible
+            store = DataStore(rados.io_ctx(EC_POOL), "train-2")
+            first = await store.ingest(recs[:10])
+            w = store.writer()
+            w.prepare(recs)
+            await w.put_shards()
+            await w.put_manifest()
+            head = await store.head()
+            assert head["save_id"] == first  # still the committed one
+            ls = await store.ls()
+            by_id = {e["ingest_id"]: e for e in ls["ingests"]}
+            assert by_id[first]["committed"]
+            assert not by_id[w.ingest_id]["committed"]
+            # stale expectation loses the CAS race
+            w2 = store.writer()
+            w2.prepare(recs[:5])
+            await w2.put_shards()
+            await w2.put_manifest()
+            with pytest.raises(DataConflict):
+                await w2.commit(expect="not-the-head")
+            # the real commit publishes and iteration follows HEAD
+            await w.commit()
+            assert (await store.head())["save_id"] == w.ingest_id
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_datastore_per_host_sequences_identical_across_clients():
+    """The multi-host property end to end: independent Rados clients
+    (separate 'processes') derive identical per-host batch sequences
+    from (seed, epoch, num_hosts), and the hosts' records partition the
+    epoch exactly — across both a fresh client and a fresh store."""
+
+    async def main():
+        cluster, rados = await _cluster_and_client()
+        cluster.cfg.set("data_shard_bytes", 4096)
+        try:
+            recs = _records(51)  # not divisible by the host count
+            store = DataStore(rados.io_ctx(EC_POOL), "multihost")
+            await store.ingest(recs)
+
+            async def drain(client, host, num_hosts, seed, epoch):
+                st = DataStore(client.io_ctx(EC_POOL), "multihost")
+                it = await st.iterator(
+                    seed=seed, epoch=epoch, num_hosts=num_hosts,
+                    host=host, batch_size=8,
+                )
+                seq = []
+                async for batch in it:
+                    seq.extend(_ids_of(batch))
+                return seq
+
+            rados2 = Rados("client.data-b", cluster.monmap,
+                           config=cluster.cfg)
+            await rados2.connect()
+            try:
+                for seed, epoch in ((7, 0), (7, 1), (8, 0)):
+                    seqs = [
+                        await drain(rados, h, 3, seed, epoch)
+                        for h in range(3)
+                    ]
+                    seqs2 = [
+                        await drain(rados2, h, 3, seed, epoch)
+                        for h in range(3)
+                    ]
+                    assert seqs == seqs2  # identical across processes
+                    flat = [i for s in seqs for i in s]
+                    assert sorted(flat) == list(range(51))  # exact
+                # different epochs shuffle differently
+                assert (await drain(rados, 0, 1, 7, 0)
+                        != await drain(rados, 0, 1, 7, 1))
+            finally:
+                await rados2.shutdown()
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_datastore_cursor_survives_kill_and_checkpoint_round_trip():
+    """Mid-epoch kill -9: a consumer dies with prefetched batches in
+    flight; a NEW client resuming from the last persisted cursor yields
+    exactly the remaining records — no replays, no gaps. The cursor
+    rides a CkptStore checkpoint as an ordinary array leaf."""
+
+    async def main():
+        from ceph_tpu.ckpt import CkptStore
+
+        cluster, rados = await _cluster_and_client()
+        cluster.cfg.set("data_shard_bytes", 4096)
+        try:
+            recs = _records(64)
+            store = DataStore(rados.io_ctx(EC_POOL), "resume")
+            await store.ingest(recs)
+
+            it = await store.iterator(seed=9, batch_size=10)
+            consumed = []
+            for _ in range(3):
+                consumed.extend(_ids_of(await it.__anext__()))
+            # persist the cursor INSIDE a checkpoint, like a train loop
+            ckpt = CkptStore(rados.io_ctx(REP_POOL), "job-state")
+            await ckpt.save({
+                "step": np.int64(3),
+                "data_cursor": cursor_array(it.state()),
+            })
+            # kill -9: the client vanishes, prefetch tasks and all —
+            # no aclose(), no checkpoint of anything after this point
+            for _ in range(2):
+                await it.__anext__()  # yielded but never checkpointed
+            await rados.shutdown()
+
+            rados2 = Rados("client.data-revive", cluster.monmap,
+                           config=cluster.cfg)
+            await rados2.connect()
+            try:
+                ckpt2 = CkptStore(rados2.io_ctx(REP_POOL), "job-state")
+                state = await ckpt2.restore()
+                assert int(np.asarray(state["step"])) == 3
+                store2 = DataStore(rados2.io_ctx(EC_POOL), "resume")
+                it2 = await store2.resume(state["data_cursor"])
+                rest = []
+                async for batch in it2:
+                    rest.extend(_ids_of(batch))
+                assert len(consumed) + len(rest) == 64
+                assert not set(consumed) & set(rest)  # no replays
+                assert sorted(consumed + rest) == list(range(64))
+            finally:
+                await rados2.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_datastore_iterates_under_mclock_queue():
+    """With osd_op_queue=mclock the iterator's reads are queued under
+    the data_prefetch QoS class (payload-plumbed, pre-registered
+    profile) and the epoch still round-trips completely."""
+
+    async def main():
+        cfg = live_config()
+        cfg.set("osd_op_queue", "mclock")
+        cluster, rados = await _cluster_and_client(cfg=cfg)
+        cluster.cfg.set("data_shard_bytes", 4096)
+        try:
+            recs = _records(30)
+            store = DataStore(rados.io_ctx(EC_POOL), "mclock-ds")
+            await store.ingest(recs)
+            it = await store.iterator(seed=5, batch_size=8)
+            seen = []
+            async for batch in it:
+                seen.extend(_ids_of(batch))
+            assert sorted(seen) == list(range(30))
+            perf = store.perf_dump()
+            assert perf["records_out"] == 30
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_mon_command_and_balancer_spans_in_dump_tracing():
+    """Mon/mgr-side tracing: a dispatched mon command becomes a
+    `mon_command` span in the mon's dump_tracing (sampled via
+    tracer_sample_rate_command), and a balancer tick becomes a root
+    `mgr_balancer_tick` span (tracer_sample_rate_balancer) with its
+    mode and change count tagged."""
+
+    async def main():
+        from ceph_tpu.common.tracer import Tracer
+        from ceph_tpu.mgr.balancer import BalancerModule
+
+        cluster, rados = await _cluster_and_client(
+            cfg=traced_cluster_cfg()
+        )
+        try:
+            await rados.mon_command("health")
+            dump = await rados.mon_command("dump_tracing")
+            names = {
+                s["name"]
+                for t in dump["traces"] for s in t["spans"]
+            }
+            assert "mon_command" in names
+            cmds = {
+                s["tags"].get("cmd")
+                for t in dump["traces"] for s in t["spans"]
+                if s["name"] == "mon_command"
+            }
+            assert "health" in cmds
+
+            # a second dump drained the ring: fresh commands, fresh spans
+            dump2 = await rados.mon_command("dump_tracing")
+            assert any(
+                s["name"] == "mon_command"
+                for t in dump2["traces"] for s in t["spans"]
+            )
+
+            # the mgr balancer tick, traced like the daemon wires it
+            tracer = Tracer("mgr.x", config=cluster.cfg)
+            bal = BalancerModule(rados.objecter.mon, tracer=tracer)
+            await bal.run_once(max_changes=2)
+            ticks = [
+                s
+                for t in tracer.dump_tracing()["traces"]
+                for s in t["spans"] if s["name"] == "mgr_balancer_tick"
+            ]
+            assert ticks, "balancer tick span missing"
+            assert ticks[0]["tags"]["mode"] == "upmap"
+            assert "changes" in ticks[0]["tags"]
+            tracer.close()
+        finally:
+            await rados.shutdown()
+            await cluster.stop()
+
+    run(main())
