@@ -249,6 +249,66 @@ def _trace_overhead_line() -> None:
         pass
 
 
+def _wire_line() -> None:
+    """Optional JSON line: daemon-path throughput with the wire fast
+    path on (binary MESSAGE_SEG envelopes + corked BATCH frames +
+    sub-op coalescing, the shipped defaults) vs the fallback knobs
+    (ms_envelope_format=json, ms_cork_max_frames=1, ms_subop_batch
+    off). The fallback run still carries this PR's knob-independent
+    work (shared watchdog, event-driven map refresh, single-buffer
+    frame checksums, region-op EC fallback, parallel shard fetch), so
+    the knob delta understates the PR; the pre-PR daemon-path figure
+    for the same workload is recorded in README.md's perf table and
+    can ride along via CEPH_TPU_WIRE_BASELINE_GBPS for the full
+    before/after ratio. frames_per_op counts coalesced sub-op frames
+    per EC write — the fan-out claim is frames_per_op < k+m. Guarded
+    (--wire / CEPH_TPU_BENCH_WIRE=1) and non-fatal."""
+    try:
+        import subprocess
+
+        def run_bench(fast: bool) -> dict:
+            argv = [sys.executable, "tools/daemon_bench.py", "--cpu",
+                    "--osds", "6", "--k", "4", "--m", "2",
+                    "--size", "262144", "--objects", "96",
+                    "--concurrency", "24"]
+            if not fast:
+                argv += ["--envelope-format", "json",
+                         "--cork-max", "1", "--subop-batch", "off"]
+            out = subprocess.run(
+                argv, capture_output=True, timeout=600, check=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            return json.loads(out.stdout)
+
+        fast = run_bench(True)
+        slow = run_bench(False)
+        line = {
+            "metric": "wire_fastpath_write_throughput",
+            "value": round(fast["write_gbps"], 4),
+            "unit": "GB/s",
+            "read_gbps": round(fast["read_gbps"], 4),
+            "fallback_write_gbps": round(slow["write_gbps"], 4),
+            "fallback_read_gbps": round(slow["read_gbps"], 4),
+            "knob_write_speedup": round(
+                fast["write_gbps"] / slow["write_gbps"], 3),
+            "knob_read_speedup": round(
+                fast["read_gbps"] / slow["read_gbps"], 3),
+            "frames_per_op": round(fast["frames_per_op"], 2),
+            "fallback_frames_per_op": round(slow["frames_per_op"], 2),
+            "frames_per_op_lt_k_plus_m": bool(
+                fast["frames_per_op"] < 4 + 2),
+            "bytes_coalesced": fast["bytes_coalesced"],
+        }
+        baseline = os.environ.get("CEPH_TPU_WIRE_BASELINE_GBPS")
+        if baseline is not None:
+            line["pre_pr_write_gbps"] = float(baseline)
+            line["vs_pre_pr"] = round(
+                fast["write_gbps"] / float(baseline), 3)
+        print(json.dumps(line))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def _ckpt_line() -> None:
     """Optional JSON line: checkpoint save/restore GB/s through the full
     stack (CkptStore -> RADOS client -> OSD daemons -> EC encode), via
@@ -378,6 +438,8 @@ def main() -> None:
         "CEPH_TPU_BENCH_FAULT"
     ):
         _fault_overhead_line()
+    if "--wire" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_WIRE"):
+        _wire_line()
     if "--ckpt" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_CKPT"):
         _ckpt_line()
     if "--data" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_DATA"):

@@ -106,6 +106,24 @@ SCHEMA: dict[str, Option] = {
              "names from the compressor registry) — msgr2 compression"),
         _opt("ms_compress_min_size", TYPE_UINT, LEVEL_ADVANCED, 4096,
              "frames below this size are never compressed"),
+        # wire fast path (the msgr2 frames_v2 / AsyncConnection
+        # write-coalescing analogues)
+        _opt("ms_envelope_format", TYPE_STR, LEVEL_ADVANCED, "binary",
+             "op envelope encoding on feature-negotiated sessions "
+             "(binary = denc-lite structs + raw as its own frame "
+             "segment; json = the legacy text envelopes). Peers without "
+             "the feature bit always get json regardless"),
+        _opt("ms_cork_max_frames", TYPE_UINT, LEVEL_ADVANCED, 64,
+             "max frames drained from the send queue per write wakeup; "
+             "a corked run goes out as ONE socket write + drain (and one "
+             "signed batch frame when the peer negotiated it). 1 = one "
+             "write+drain per frame, the uncorked legacy behavior",
+             min=1),
+        _opt("ms_subop_batch", TYPE_BOOL, LEVEL_ADVANCED, True,
+             "coalesce same-peer sub-ops issued within one event-loop "
+             "tick into a single multi-op frame with a batched reply "
+             "(the EncodeService kernel-launch coalescing shape, applied "
+             "to the fan-out wire path)"),
         _opt("ms_inject_socket_failures", TYPE_UINT, LEVEL_DEV, 0,
              "inject a transient store failure every Nth op"),
         _opt("ms_inject_delay_probability", TYPE_FLOAT, LEVEL_DEV, 0.0,

@@ -70,13 +70,26 @@ def _load_native():
 _NATIVE = _load_native()
 
 
-def ceph_crc32c(seed: int, data: bytes | np.ndarray) -> int:
-    """crc32c(seed, data) with ceph's conventions (no final xor)."""
+def ceph_crc32c(
+    seed: int, data: bytes | np.ndarray, length: int | None = None
+) -> int:
+    """crc32c(seed, data[:length]) with ceph's conventions (no final xor).
+
+    `length` checksums a prefix without materializing the slice — the wire
+    read path hands the whole `payload+crc` buffer straight here. Chaining
+    is supported the way the reference's bufferlist crc is: the return
+    value is the raw register state, so crc(AB) == crc32c(crc32c(seed, A),
+    B) — Frame.encode_parts exploits that to checksum a segment list
+    without joining it first.
+    """
     if _NATIVE is not None:
-        raw = bytes(data)
-        return int(_NATIVE(seed & 0xFFFFFFFF, raw, len(raw)))
+        raw = data if isinstance(data, bytes) else bytes(data)
+        n = len(raw) if length is None else min(length, len(raw))
+        return int(_NATIVE(seed & 0xFFFFFFFF, raw, n))
     crc = np.uint32(seed & 0xFFFFFFFF)
     buf = np.frombuffer(bytes(data), dtype=np.uint8)
+    if length is not None:
+        buf = buf[:length]
     t = _TABLE
     n8 = len(buf) // 8 * 8
     if n8:

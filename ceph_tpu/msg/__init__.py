@@ -16,7 +16,13 @@ messenger is the host control/data plane the reference's L1 provides —
 placement, sub-ops, maps, heartbeats.
 """
 
-from ceph_tpu.msg.frames import Frame, FrameError, Message, Tag
+from ceph_tpu.msg.frames import (
+    Frame,
+    FrameError,
+    Message,
+    Tag,
+    payload_of,
+)
 from ceph_tpu.msg.messenger import (
     AsyncThrottle,
     Connection,
@@ -35,4 +41,5 @@ __all__ = [
     "Messenger",
     "Policy",
     "Tag",
+    "payload_of",
 ]

@@ -4,7 +4,7 @@ The reference frames every exchange after the banner as tagged,
 crc-protected segments (src/msg/async/frames_v2.h: preamble with tag +
 segment count + per-segment crc32c; ProtocolV2.cc drives the handshake tag
 sequence HELLO -> AUTH_* -> SESSION). The same shape here, simplified to one
-segment per frame:
+crc per frame:
 
     u32 magic | u8 tag | u32 len | payload[len] | u32 crc32c(payload)
     [ + 16-byte truncated HMAC-SHA256 when the session is signing ]
@@ -18,21 +18,54 @@ Messages (Tag.MESSAGE payloads) are denc-lite structs carrying
 (type, tid, seq, map_epoch, data) — the envelope fields every Message
 subclass in src/messages/ shares via its ceph_msg_header (type, seq, tid)
 plus the osd-op epoch the OSD uses to drop ops from stale clients.
+
+The wire fast path adds two feature-negotiated frame shapes (HELLO carries
+a feature-bit word; peers without a bit never see the matching frames):
+
+  * Tag.MESSAGE_SEG — the frames_v2 multi-segment shape: the envelope
+    (WITHOUT the bulk `raw` field) is one segment, `raw` rides verbatim as
+    the rest of the payload. Object bytes never pass through the envelope
+    encoder and arrive as a zero-copy memoryview of the frame buffer.
+  * Tag.BATCH — a corked run of frames wrapped in ONE outer frame:
+    u32 count, then per inner frame `u8 tag | u32 len | payload`. Inner
+    frames carry no crc/signature — the outer crc32c and HMAC cover the
+    whole run, amortizing both over every frame in it (the AsyncConnection
+    write-event coalescing shape, with the checksum amortized too).
 """
 
 from __future__ import annotations
 
 import hashlib
 import hmac as hmac_mod
+import json
+import struct as struct_mod
 from dataclasses import dataclass
 from enum import IntEnum
 
 from ceph_tpu.common.crc import ceph_crc32c
-from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.common.encoding import (
+    Decoder,
+    Encoder,
+    decode_payload,
+    encode_payload,
+)
 
 MAGIC = 0x43455054  # "CEPT"
 BANNER = b"ceph_tpu msgr v2\n"
 SIG_LEN = 16
+
+# -- HELLO feature bits (the msgr2 feature-word role) -------------------------
+#
+# Appended to the HELLO payload as a trailing u64; decoders from before the
+# word existed skip trailing bytes, so negotiation degrades to "no features"
+# against old peers and every fast-path shape falls back per connection.
+
+FEATURE_BIN_ENVELOPE = 1 << 0  # MESSAGE_SEG frames + denc-lite op payloads
+FEATURE_FRAME_BATCH = 1 << 1   # Tag.BATCH corked multi-frame envelopes
+FEATURE_SUBOP_BATCH = 1 << 2   # multi-op sub-op messages (subop_batch)
+LOCAL_FEATURES = (
+    FEATURE_BIN_ENVELOPE | FEATURE_FRAME_BATCH | FEATURE_SUBOP_BATCH
+)
 
 
 class FrameError(Exception):
@@ -55,58 +88,136 @@ class Tag(IntEnum):
     #: cephx ticket presentation (client -> service daemon): the daemon
     #: verifies with its rotating service keys, never the client's key
     AUTH_TICKET = 11
+    #: segmented message: u32 env_len | envelope | raw bytes (feature-
+    #: negotiated; the multi-segment frames_v2 shape)
+    MESSAGE_SEG = 12
+    #: corked multi-frame envelope: u32 count | (u8 tag | u32 len |
+    #: payload)* — one crc + one signature for the whole run
+    BATCH = 13
+
+
+_HEAD = struct_mod.Struct("<IBI")  # magic, tag, payload length
+_U32 = struct_mod.Struct("<I")
 
 
 @dataclass
 class Frame:
     tag: Tag
-    payload: bytes
+    payload: bytes = b""
+    #: when set, the logical payload is the concatenation of these
+    #: buffers — encode_parts streams them to the socket without joining,
+    #: so a bulk `raw` segment is never copied through the frame encoder
+    segments: tuple | None = None
+
+    def encode_parts(self, session_key: bytes | None = None) -> list:
+        """The frame as a list of buffers ready for one coalesced socket
+        write. Segments are joined into one body buffer first: the join
+        is a cost the socket write pays anyway, and handing the checksum
+        (and HMAC) one contiguous bytes object keeps the native crc from
+        copying each memoryview segment on its way in."""
+        segs = self.segments if self.segments is not None else (self.payload,)
+        body = segs[0] if len(segs) == 1 else b"".join(segs)
+        if not isinstance(body, bytes):
+            body = bytes(body)
+        parts: list = [
+            _HEAD.pack(MAGIC, int(self.tag), len(body)),
+            body,
+            _U32.pack(ceph_crc32c(0xFFFFFFFF, body)),
+        ]
+        if session_key is not None:
+            h = hmac_mod.new(session_key, digestmod=hashlib.sha256)
+            for p in parts:
+                h.update(p)
+            parts.append(h.digest()[:SIG_LEN])
+        return parts
 
     def encode(self, session_key: bytes | None = None) -> bytes:
-        e = (
-            Encoder()
-            .u32(MAGIC)
-            .u8(int(self.tag))
-            .blob(self.payload)
-            .u32(ceph_crc32c(0xFFFFFFFF, self.payload))
-        )
-        out = e.bytes()
-        if session_key is not None:
-            out += hmac_mod.new(session_key, out, hashlib.sha256).digest()[:SIG_LEN]
-        return out
+        return b"".join(self.encode_parts(session_key))
 
 
 def frame_header_len() -> int:
-    return 4 + 1 + 4  # magic + tag + blob length prefix
+    return 4 + 1 + 4  # magic + tag + payload length prefix
+
+
+#: tags whose payload stays a zero-copy memoryview after read_frame (the
+#: fast-path shapes slice it themselves); everything else gets bytes so
+#: legacy decoders (json.loads, Decoder.string) keep working unchanged
+_MV_TAGS = frozenset((int(Tag.MESSAGE_SEG), int(Tag.BATCH)))
 
 
 async def read_frame(reader, session_key: bytes | None = None) -> Frame:
     """Read one frame from an asyncio StreamReader, verifying crc (and the
-    signature when the session is signing)."""
+    signature when the session is signing). The signature and crc are
+    verified over the receive buffers in place — no payload copy."""
     head = await reader.readexactly(frame_header_len())
-    d = Decoder(head)
-    magic = d.u32()
+    magic, tag, length = _HEAD.unpack(head)
     if magic != MAGIC:
         raise FrameError(f"bad magic {magic:#x}")
-    tag = d.u8()
-    length = d.u32()
     if length > 1 << 30:
         raise FrameError(f"frame too large: {length}")
     rest = await reader.readexactly(length + 4)
-    payload, crc_bytes = rest[:length], rest[length:]
     if session_key is not None:
         sig = await reader.readexactly(SIG_LEN)
-        want = hmac_mod.new(
-            session_key, head + rest, hashlib.sha256
-        ).digest()[:SIG_LEN]
-        if not hmac_mod.compare_digest(sig, want):
+        h = hmac_mod.new(session_key, digestmod=hashlib.sha256)
+        h.update(head)
+        h.update(rest)
+        if not hmac_mod.compare_digest(sig, h.digest()[:SIG_LEN]):
             raise FrameError("frame signature mismatch")
-    if Decoder(crc_bytes).u32() != ceph_crc32c(0xFFFFFFFF, payload):
+    (want,) = _U32.unpack_from(rest, length)
+    if want != ceph_crc32c(0xFFFFFFFF, rest, length):
         raise FrameError("frame crc mismatch")
+    if tag in _MV_TAGS:
+        payload = memoryview(rest)[:length]
+    else:
+        payload = rest[:length]
     try:
         return Frame(Tag(tag), payload)
     except ValueError as e:
         raise FrameError(f"unknown tag {tag}") from e
+
+
+# -- corked-run batching (Tag.BATCH) ------------------------------------------
+
+
+def make_batch_frame(frames: list) -> Frame:
+    """Wrap a corked run of frames in one outer frame: inner frames lose
+    their per-frame crc/signature (the outer frame's cover the run)."""
+    segs: list = [_U32.pack(len(frames))]
+    for f in frames:
+        inner = f.segments if f.segments is not None else (f.payload,)
+        segs.append(
+            struct_mod.pack("<BI", int(f.tag), sum(len(s) for s in inner))
+        )
+        segs.extend(s for s in inner if len(s))
+    return Frame(Tag.BATCH, segments=tuple(segs))
+
+
+def iter_batch(payload):
+    """Unpack a BATCH payload into inner Frames. Fast-path inner payloads
+    stay memoryview slices of the outer buffer; legacy tags get bytes."""
+    mv = payload if isinstance(payload, memoryview) else memoryview(payload)
+    (count,) = _U32.unpack_from(mv, 0)
+    off = 4
+    for _ in range(count):
+        tag, length = struct_mod.unpack_from("<BI", mv, off)
+        off += 5
+        if off + length > len(mv):
+            raise FrameError("batch inner frame exceeds payload")
+        inner = mv[off : off + length]
+        off += length
+        if tag not in _MV_TAGS:
+            inner = bytes(inner)
+        try:
+            yield Frame(Tag(tag), inner)
+        except ValueError as e:
+            raise FrameError(f"unknown tag {tag} in batch") from e
+
+
+# -- the message envelope -----------------------------------------------------
+
+#: Message.flags bit: `data` is a denc-lite value blob (decode with
+#: decode_payload), not JSON — set per connection at frame-encode time
+FLAG_BIN_DATA = 1
 
 
 @dataclass
@@ -115,8 +226,14 @@ class Message:
 
     Two segments, like the reference's multi-segment frames
     (src/msg/async/frames_v2.h: header segment + data segment): `data`
-    carries the small structured header (JSON here), `raw` carries bulk
-    object bytes verbatim — never hex-inflated into the header."""
+    carries the small structured header, `raw` carries bulk object bytes
+    verbatim — never hex-inflated into the header.
+
+    Hot-path senders set `payload` (the structured dict) instead of
+    pre-serializing `data`; the connection encodes it at frame-build time
+    in whichever format the session negotiated (denc-lite value blob on
+    feature-bit peers, JSON otherwise), so one queued Message replays
+    correctly to either kind of peer."""
 
     type: str  #: e.g. "osd_op", "osd_map", "ping" — src/messages/ analogue
     tid: int = 0  #: client transaction id (resend correlation)
@@ -134,21 +251,27 @@ class Message:
     #: encodes a jaeger trace context into ProtocolV2 message frames the
     #: same way); empty = op is untraced, zero downstream cost
     trace: str = ""
+    #: envelope flags (FLAG_*); encoded at struct v5, old decoders skip it
+    flags: int = 0
+    #: structured payload, encoded into `data` lazily per connection
+    payload: object = None
 
-    def encode(self) -> bytes:
+    def encode(self, inline_raw: bool = True) -> bytes:
+        raw = self.raw if inline_raw else b""
         return (
             Encoder()
             .struct(
-                4,
+                5,
                 1,
                 lambda b: b.string(self.type)
                 .u64(self.tid)
                 .u64(self.seq)
                 .u64(self.epoch)
                 .blob(self.data)
-                .blob(self.raw)
+                .blob(raw)
                 .u64(self.ack)
-                .string(self.trace),
+                .string(self.trace)
+                .u8(self.flags),
             )
             .bytes()
         )
@@ -165,6 +288,86 @@ class Message:
                 raw=b.blob() if version >= 2 else b"",
                 ack=b.u64() if version >= 3 else 0,
                 trace=b.string() if version >= 4 else "",
+                flags=b.u8() if version >= 5 else 0,
             )
 
         return Decoder(raw).struct(1, body)
+
+
+# fixed runs of the v5 envelope layout, hand-packed on the per-op hot
+# path (same bytes Encoder/Message.encode produce — pinned by tests):
+#   <BBII  = struct_v, struct_compat, struct_len, len(type)
+#   <QQQI  = tid, seq, epoch, len(data)
+#   <IQI   = len(raw), ack, len(trace)
+_ENV_HEAD = struct_mod.Struct("<BBII")
+_ENV_MID = struct_mod.Struct("<QQQI")
+_ENV_TAIL = struct_mod.Struct("<IQI")
+
+
+def message_seg_frame(msg: Message) -> Frame:
+    """The MESSAGE_SEG frame for an encoded message: envelope (sans raw)
+    as one segment, `raw` appended verbatim — the raw bytes never visit
+    an encoder or a payload join."""
+    tb = msg.type.encode("utf-8")
+    trb = msg.trace.encode("utf-8") if msg.trace else b""
+    data = msg.data
+    env = bytearray(
+        _ENV_HEAD.pack(
+            5, 1, 49 + len(tb) + len(data) + len(trb), len(tb)
+        )
+    )
+    env += tb
+    env += _ENV_MID.pack(msg.tid, msg.seq, msg.epoch, len(data))
+    env += data
+    env += _ENV_TAIL.pack(0, msg.ack, len(trb))
+    env += trb
+    env.append(msg.flags)
+    segs = (_U32.pack(len(env)), env)
+    if len(msg.raw):
+        segs = segs + (msg.raw,)
+    return Frame(Tag.MESSAGE_SEG, segments=segs)
+
+
+def decode_message_seg(payload) -> Message:
+    """Inverse of message_seg_frame: the envelope is a small copy, the
+    raw segment surfaces as a zero-copy memoryview of the frame buffer."""
+    mv = payload if isinstance(payload, memoryview) else memoryview(payload)
+    (env_len,) = _U32.unpack_from(mv, 0)
+    if 4 + env_len > len(mv):
+        raise FrameError("bad MESSAGE_SEG envelope length")
+    buf = bytes(mv[4 : 4 + env_len])
+    raw = mv[4 + env_len :]
+    ver, compat, _blen, tlen = _ENV_HEAD.unpack_from(buf, 0)
+    if ver != 5 or compat > 1:
+        # an envelope version this fast parser doesn't know: take the
+        # generic versioned-decoder path (skip-unknown-suffix semantics)
+        msg = Message.decode(buf)
+        msg.raw = raw
+        return msg
+    off = 10 + tlen
+    typ = buf[10:off].decode("utf-8")
+    tid, seq, epoch, dlen = _ENV_MID.unpack_from(buf, off)
+    off += 28
+    data = buf[off : off + dlen]
+    off += dlen
+    rlen, ack, trlen = _ENV_TAIL.unpack_from(buf, off)
+    off += 16 + rlen
+    trace = buf[off : off + trlen].decode("utf-8") if trlen else ""
+    off += trlen
+    msg = Message(
+        type=typ, tid=tid, seq=seq, epoch=epoch, data=data,
+        ack=ack, trace=trace, flags=buf[off] if off < len(buf) else 0,
+    )
+    msg.raw = raw
+    return msg
+
+
+def payload_of(msg: Message):
+    """The structured payload of a received message, whichever envelope
+    format the sender used (dispatch sites call this instead of
+    json.loads so both formats — and old peers — decode identically)."""
+    if not len(msg.data):
+        return {}
+    if msg.flags & FLAG_BIN_DATA:
+        return decode_payload(msg.data)
+    return json.loads(msg.data)

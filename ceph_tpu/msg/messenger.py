@@ -36,18 +36,27 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import hmac as hmac_mod
+import json
 import os
 import random
 import socket as socket_mod
 from dataclasses import dataclass, field
 
-from ceph_tpu.common.encoding import Decoder, Encoder
+from ceph_tpu.common.encoding import Decoder, Encoder, encode_payload
 from ceph_tpu.msg.frames import (
     BANNER,
+    FEATURE_BIN_ENVELOPE,
+    FEATURE_FRAME_BATCH,
+    FLAG_BIN_DATA,
+    LOCAL_FEATURES,
     Frame,
     FrameError,
     Message,
     Tag,
+    decode_message_seg,
+    iter_batch,
+    make_batch_frame,
+    message_seg_frame,
     read_frame,
 )
 
@@ -151,31 +160,54 @@ class _InjectingStream:
             except OSError:
                 pass
 
-    async def _maybe_inject(self) -> None:
-        # Always yield once per frame: a burst of writes whose drain()
+    async def _maybe_inject(self, yield_loop: bool = True) -> None:
+        # Yield once per written frame: a burst of writes whose drain()
         # completes synchronously (socket buffer has room) would otherwise
         # starve the event loop, so the reader task never sees the ACKs the
-        # peer is streaming back and the resend window cannot shrink.
-        await asyncio.sleep(0)
+        # peer is streaming back and the resend window cannot shrink. The
+        # read side skips the yield — readexactly already parks the task
+        # whenever the buffer runs dry.
+        if yield_loop:
+            await asyncio.sleep(0)
         m = self._m
-        delay = m.config.get("ms_inject_internal_delays")
+        delay = m._inject_delay
         if delay:
             await asyncio.sleep(delay * m._rng.random())
-        every = m.config.get("ms_inject_socket_failures")
+        every = m._inject_every
         if every and m._rng.randrange(every) == 0:
             m.injected_failures += 1
             self.writer.close()
             raise ConnectionResetError("injected socket failure")
 
     async def send(self, frame: Frame, session_key: bytes | None) -> None:
+        await self.send_frames([frame], session_key)
+
+    async def send_frames(
+        self, frames: list, session_key: bytes | None, coalesced: int = 1
+    ) -> None:
+        """One socket write + one drain for a whole corked run (the
+        AsyncConnection write-event coalescing shape): every frame's
+        buffer parts are gathered and joined once, so a run of N frames
+        costs one syscall and one flow-control wait instead of N."""
         await self._maybe_inject()
-        encoded = frame.encode(session_key)
-        self._m.bytes_sent += len(encoded)
-        self.writer.write(encoded)
+        parts: list = []
+        for f in frames:
+            parts.extend(f.encode_parts(session_key))
+        data = b"".join(parts)
+        m = self._m
+        m.bytes_sent += len(data)
+        perf = m.perf
+        perf.inc("frames_out", len(frames))
+        perf.hinc("corked_run_len", coalesced)
+        if coalesced > 1:
+            perf.inc("corked_runs")
+            perf.inc("corked_msgs", coalesced)
+            perf.inc("bytes_coalesced", len(data))
+        self.writer.write(data)
         await self.writer.drain()
 
     async def recv(self, session_key: bytes | None) -> Frame:
-        await self._maybe_inject()
+        await self._maybe_inject(yield_loop=False)
         return await read_frame(self.reader, session_key)
 
 
@@ -194,6 +226,10 @@ class Connection:
         self.peer_addr = peer_addr
         self.peer_name: str | None = None
         self.peer_nonce: int = 0
+        #: feature bits the peer advertised at HELLO (0 until the
+        #: handshake lands, and against pre-feature-word peers forever —
+        #: every fast-path shape checks a bit before using it)
+        self.peer_features: int = 0
         self.policy = policy
         self.outgoing = outgoing
         self.session_key: bytes | None = None
@@ -269,6 +305,9 @@ class Connection:
     def is_connected(self) -> bool:
         return self._stream is not None and self._ready.is_set()
 
+    def has_feature(self, bit: int) -> bool:
+        return bool(self.peer_features & bit)
+
     # -- outgoing side --------------------------------------------------------
 
     def _start_outgoing(self) -> None:
@@ -340,7 +379,15 @@ class Connection:
         await stream.writer.drain()
         if await stream.reader.readexactly(len(BANNER)) != BANNER:
             raise FrameError("bad banner")
-        hello = Encoder().string(m.name).u64(m.instance_nonce).bytes()
+        # the feature word rides as a trailing u64: pre-feature decoders
+        # ignore trailing HELLO bytes, so negotiation is backward-safe
+        hello = (
+            Encoder()
+            .string(m.name)
+            .u64(m.instance_nonce)
+            .u64(m.local_features)
+            .bytes()
+        )
         await stream.send(Frame(Tag.HELLO, hello), None)
         reply = await stream.recv(None)
         if reply.tag != Tag.HELLO:
@@ -348,6 +395,12 @@ class Connection:
         d = Decoder(reply.payload)
         self.peer_name = d.string()
         self.peer_nonce = d.u64()
+        # the session feature set is the INTERSECTION of both HELLOs
+        # (the msgr2 feature-word rule): a frame shape is legal only
+        # when both ends opted in
+        self.peer_features = (
+            d.u64() if d.remaining() >= 8 else 0
+        ) & m.local_features
         if m.keyring is None:
             return
         service = self.peer_name.split(".", 1)[0]
@@ -463,20 +516,44 @@ class Connection:
             mm for mm in self._unacked if mm.seq > acked
         ]
 
-    def _encode_msg_frame(self, msg: Message) -> Frame:
-        """MESSAGE frame, compressed above the configured floor (the
-        msgr2 compression mode via the compressor registry)."""
+    def _encode_msg_frame(self, msg: Message, corked: int = 1) -> Frame:
+        """MESSAGE / MESSAGE_SEG frame, compressed above the configured
+        floor (the msgr2 compression mode via the compressor registry).
+
+        A lazy `msg.payload` is serialized HERE, per connection: binary
+        denc-lite on sessions that negotiated FEATURE_BIN_ENVELOPE (and
+        whose config asks for it), JSON otherwise — so the same queued
+        Message replays correctly to either kind of peer. On the binary
+        path the bulk `raw` bytes ride as their own frame segment
+        (MESSAGE_SEG) and never pass through an encoder or a join."""
+        m = self.messenger
         sp = getattr(msg, "_send_span", None)
         if sp is not None:
+            if corked > 1:
+                sp.set_tag("corked", corked)
             sp.finish()
             msg._send_span = None  # lossless replays re-encode; once only
         if not self.policy.lossy and self._ack_owed > self._ack_sent:
             msg.ack = self._ack_owed
             self._ack_sent = self._ack_owed
+        m.perf.inc("msgs_out")
+        use_bin = m._env_binary and (
+            self.peer_features & FEATURE_BIN_ENVELOPE
+        )
+        if msg.payload is not None:
+            if use_bin:
+                msg.flags |= FLAG_BIN_DATA
+                msg.data = encode_payload(msg.payload)
+                m.perf.inc("env_binary")
+            else:
+                msg.flags &= ~FLAG_BIN_DATA
+                msg.data = json.dumps(msg.payload).encode()
+                m.perf.inc("env_json")
+        algo = m._compress_algo
+        if algo is None and use_bin:
+            return message_seg_frame(msg)
         payload = msg.encode()
-        algo = self.messenger.config.get("ms_compress_mode")
-        floor = self.messenger.config.get("ms_compress_min_size")
-        if algo and algo != "none" and len(payload) >= floor:
+        if algo is not None and len(payload) >= m._compress_floor:
             try:
                 from ceph_tpu.common.compressor import factory
 
@@ -485,7 +562,7 @@ class Connection:
             except Exception:
                 did = False  # unknown/unavailable codec: ship raw
             if did:
-                self.messenger.compressed_frames += 1
+                m.compressed_frames += 1
                 return Frame(
                     Tag.MESSAGE_COMPRESSED,
                     Encoder().string(algo).blob(packed).bytes(),
@@ -493,78 +570,110 @@ class Connection:
         return Frame(Tag.MESSAGE, payload)
 
     async def _write_loop(self, stream: _InjectingStream) -> None:
+        m = self.messenger
+        q = self._send_q
         while True:
-            kind, item = await self._send_q.get()
-            if kind == "msg":
-                frame = self._encode_msg_frame(item)
-            else:
-                frame = item
-            await stream.send(frame, self.session_key)
+            items = [await q.get()]
+            # cork: drain whatever else is already queued (bounded) and
+            # ship the whole run as one write — with FRAME_BATCH, as one
+            # OUTER frame whose single crc+HMAC covers every frame in it
+            limit = m._cork_max
+            while len(items) < limit:
+                try:
+                    items.append(q.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            n = len(items)
+            frames = [
+                self._encode_msg_frame(it, corked=n)
+                if kind == "msg"
+                else it
+                for kind, it in items
+            ]
+            if n > 1 and (self.peer_features & FEATURE_FRAME_BATCH):
+                m.perf.inc("batch_frames")
+                m.perf.inc("batch_inner", n)
+                frames = [make_batch_frame(frames)]
+            await stream.send_frames(
+                frames, self.session_key, coalesced=n
+            )
 
     async def _read_loop(self, stream: _InjectingStream) -> None:
-        m = self.messenger
         while True:
             frame = await stream.recv(self.session_key)
-            if frame.tag == Tag.MESSAGE_COMPRESSED:
-                from ceph_tpu.common.compressor import factory
-
-                d = Decoder(frame.payload)
-                algo = d.string()
-                frame = Frame(
-                    Tag.MESSAGE, factory(algo).decompress(d.blob())
-                )
-            if frame.tag == Tag.MESSAGE:
-                msg = Message.decode(frame.payload)
-                if not self.policy.lossy:
-                    # coalesced ack-on-receipt: note what we owe and let
-                    # the next outgoing message piggyback it (a timer
-                    # covers idle connections); acks are cumulative so
-                    # one frame covers any number of messages
-                    self._note_ack_owed(msg.seq)
-                    if msg.ack:
-                        self._apply_peer_ack(msg.ack)
-                    # dedup state is per (peer instance, session
-                    # direction): the session we dialed and the one the
-                    # peer dialed carry independent seq streams, and a
-                    # restarted peer (new nonce) starts fresh
-                    key = (self.peer_name, self.peer_nonce, self.outgoing)
-                    last = m._peer_in_seq.get(key, 0)
-                    if msg.seq <= last:
-                        # duplicate from a resend window: the peer is
-                        # replaying because it never saw our ack (the
-                        # frame carrying it died with a connection) —
-                        # re-ack IMMEDIATELY or its window never drains
-                        self._ack_sent = 0
-                        if self._ack_timer is not None:
-                            self._ack_timer.cancel()
-                            self._ack_timer = None
-                        self._flush_ack()
-                        continue
-                    m._peer_in_seq[key] = msg.seq
-                size = max(1, len(msg.data))
-                # receive-side messenger span: throttle wait + handler
-                # (fast-dispatch leg); only traced messages pay anything
-                dsp = None
-                if m.tracer is not None and msg.trace:
-                    dsp = m.tracer.join(
-                        msg.trace, "msg_dispatch",
-                        tags={"type": msg.type, "at": m.name},
-                    )
-                await m.dispatch_throttle.get(size)
-                try:
-                    await _call(m.dispatcher.ms_dispatch, self, msg)
-                finally:
-                    await m.dispatch_throttle.put(size)
-                    if dsp is not None:
-                        dsp.finish()
-            elif frame.tag == Tag.ACK:
-                self._apply_peer_ack(Decoder(frame.payload).u64())
-            elif frame.tag == Tag.KEEPALIVE:
-                pass
-            elif frame.tag == Tag.RESET:
-                raise ConnectionResetError("peer reset")
+            if frame.tag == Tag.BATCH:
+                for inner in iter_batch(frame.payload):
+                    await self._process_frame(inner, batched=True)
             else:
-                raise FrameError(f"unexpected tag {frame.tag}")
+                await self._process_frame(frame)
+
+    async def _process_frame(
+        self, frame: Frame, batched: bool = False
+    ) -> None:
+        m = self.messenger
+        if frame.tag == Tag.MESSAGE_COMPRESSED:
+            from ceph_tpu.common.compressor import factory
+
+            d = Decoder(frame.payload)
+            algo = d.string()
+            frame = Frame(
+                Tag.MESSAGE, factory(algo).decompress(d.blob())
+            )
+        if frame.tag in (Tag.MESSAGE, Tag.MESSAGE_SEG):
+            if frame.tag is Tag.MESSAGE_SEG:
+                msg = decode_message_seg(frame.payload)
+            else:
+                msg = Message.decode(frame.payload)
+            if not self.policy.lossy:
+                # coalesced ack-on-receipt: note what we owe and let
+                # the next outgoing message piggyback it (a timer
+                # covers idle connections); acks are cumulative so
+                # one frame covers any number of messages
+                self._note_ack_owed(msg.seq)
+                if msg.ack:
+                    self._apply_peer_ack(msg.ack)
+                # dedup state is per (peer instance, session
+                # direction): the session we dialed and the one the
+                # peer dialed carry independent seq streams, and a
+                # restarted peer (new nonce) starts fresh
+                key = (self.peer_name, self.peer_nonce, self.outgoing)
+                last = m._peer_in_seq.get(key, 0)
+                if msg.seq <= last:
+                    # duplicate from a resend window: the peer is
+                    # replaying because it never saw our ack (the
+                    # frame carrying it died with a connection) —
+                    # re-ack IMMEDIATELY or its window never drains
+                    self._ack_sent = 0
+                    if self._ack_timer is not None:
+                        self._ack_timer.cancel()
+                        self._ack_timer = None
+                    self._flush_ack()
+                    return
+                m._peer_in_seq[key] = msg.seq
+            size = max(1, len(msg.data))
+            # receive-side messenger span: throttle wait + handler
+            # (fast-dispatch leg); only traced messages pay anything
+            dsp = None
+            if m.tracer is not None and msg.trace:
+                tags = {"type": msg.type, "at": m.name}
+                if batched:
+                    tags["batched"] = True
+                dsp = m.tracer.join(msg.trace, "msg_dispatch", tags=tags)
+            await m.dispatch_throttle.get(size)
+            try:
+                await _call(m.dispatcher.ms_dispatch, self, msg)
+            finally:
+                await m.dispatch_throttle.put(size)
+                if dsp is not None:
+                    dsp.finish()
+        elif frame.tag == Tag.ACK:
+            self._apply_peer_ack(Decoder(frame.payload).u64())
+        elif frame.tag == Tag.KEEPALIVE:
+            pass
+        elif frame.tag == Tag.RESET:
+            raise ConnectionResetError("peer reset")
+        else:
+            raise FrameError(f"unexpected tag {frame.tag}")
 
 
 def _session_key(secret: bytes, nonce_c: bytes, nonce_s: bytes) -> bytes:
@@ -620,6 +729,53 @@ class Messenger:
         self.bytes_sent = 0
         #: MESSAGE frames that went out compressed (ms_compress_mode)
         self.compressed_frames = 0
+        #: feature bits advertised at HELLO; a test can zero this to
+        #: simulate a pre-feature ("old-format") peer end to end
+        self.local_features = LOCAL_FEATURES
+        # wire fast-path counters, adopted into the owning daemon's
+        # `perf dump` collection (-> the Prometheus exporter)
+        from ceph_tpu.common.perf_counters import PerfCounters
+
+        self.perf = PerfCounters(f"msgr.{name}")
+        for key, desc in (
+            ("msgs_out", "messages queued onto the wire"),
+            ("frames_out", "wire frames written (a BATCH counts once)"),
+            ("corked_runs", "write wakeups that coalesced >1 frame"),
+            ("corked_msgs", "frames that shared a corked run"),
+            ("bytes_coalesced", "bytes written in multi-frame runs"),
+            ("batch_frames", "corked runs shipped as one BATCH frame"),
+            ("batch_inner", "frames wrapped inside BATCH envelopes"),
+            ("env_binary", "op payloads encoded as denc-lite values"),
+            ("env_json", "op payloads encoded as JSON (fallback)"),
+        ):
+            self.perf.add_u64_counter(key, desc)
+        self.perf.add_histogram(
+            "corked_run_len", "frames per write wakeup (power-of-two)"
+        )
+        # hot-path knobs are read per frame: cache them and track runtime
+        # changes via config observers instead of paying the env-aware
+        # Config.get on every message
+        self._cork_max = max(1, int(self.config.get("ms_cork_max_frames")))
+        self._env_binary = (
+            self.config.get("ms_envelope_format") == "binary"
+        )
+        algo = self.config.get("ms_compress_mode")
+        self._compress_algo = algo if algo and algo != "none" else None
+        self._compress_floor = int(
+            self.config.get("ms_compress_min_size")
+        )
+        self._inject_delay = float(
+            self.config.get("ms_inject_internal_delays") or 0
+        )
+        self._inject_every = int(
+            self.config.get("ms_inject_socket_failures") or 0
+        )
+        self.config.observe("ms_cork_max_frames", self._note_knobs)
+        self.config.observe("ms_envelope_format", self._note_knobs)
+        self.config.observe("ms_compress_mode", self._note_knobs)
+        self.config.observe("ms_compress_min_size", self._note_knobs)
+        self.config.observe("ms_inject_internal_delays", self._note_knobs)
+        self.config.observe("ms_inject_socket_failures", self._note_knobs)
         #: cephx client state: service ("osd"/"mds") -> (ticket blob,
         #: session key) obtained from the mon's auth service; when a
         #: ticket exists for a peer's service the handshake presents it
@@ -631,6 +787,25 @@ class Messenger:
         #: async callback to refresh service_keys when a ticket arrives
         #: under an epoch we don't hold (rotation raced our timer)
         self.on_service_keys_stale = None
+
+    def _note_knobs(self, _name=None, _value=None) -> None:
+        """Config observer: refresh the cached wire knobs on runtime
+        `set`/injectargs (env-only changes land at construction time)."""
+        self._cork_max = max(1, int(self.config.get("ms_cork_max_frames")))
+        self._env_binary = (
+            self.config.get("ms_envelope_format") == "binary"
+        )
+        algo = self.config.get("ms_compress_mode")
+        self._compress_algo = algo if algo and algo != "none" else None
+        self._compress_floor = int(
+            self.config.get("ms_compress_min_size")
+        )
+        self._inject_delay = float(
+            self.config.get("ms_inject_internal_delays") or 0
+        )
+        self._inject_every = int(
+            self.config.get("ms_inject_socket_failures") or 0
+        )
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -703,6 +878,9 @@ class Messenger:
             hd = Decoder(hello.payload)
             conn.peer_name = hd.string()
             conn.peer_nonce = hd.u64()
+            conn.peer_features = (
+                hd.u64() if hd.remaining() >= 8 else 0
+            ) & self.local_features
             conn.peer_addr = writer.get_extra_info("peername")[:2]
             conn.out_seq = self._peer_out_seq.get(
                 (conn.peer_name, conn.peer_nonce), 0
@@ -713,6 +891,7 @@ class Messenger:
                     Encoder()
                     .string(self.name)
                     .u64(self.instance_nonce)
+                    .u64(self.local_features)
                     .bytes(),
                 ),
                 None,

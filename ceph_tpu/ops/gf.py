@@ -87,6 +87,46 @@ def gf_matvec(a: np.ndarray, v: np.ndarray) -> np.ndarray:
     return gf_matmul(a, v.reshape(-1, 1)).reshape(-1)
 
 
+#: full 256x256 product table, built lazily: row c is the region-op table
+#: for coefficient c (the gf-complete/ec_base "multiply a region by a
+#: constant" idiom — ceph_tpu/native/ec_plugin.cpp:123 uses the same shape)
+_MUL_TABLE: np.ndarray | None = None
+
+
+def _mul_table() -> np.ndarray:
+    global _MUL_TABLE
+    if _MUL_TABLE is None:
+        c = np.arange(256, dtype=np.uint8)
+        _MUL_TABLE = gf_mul(c[:, None], c[None, :])
+    return _MUL_TABLE
+
+
+def gf_region_matmul(a: np.ndarray, planes: np.ndarray) -> np.ndarray:
+    """gf_matmul specialized for wide planar operands: (r,n) x (n,W) with
+    W >> n, XOR-accumulating one uint8 table-gather per nonzero matrix
+    cell instead of materializing the (r,n,W) int32 log-sum temporaries
+    gf_matmul needs. Bit-identical to gf_matmul (same tables, same field);
+    the planar encode fallback is per-write hot, so the constant factor
+    matters."""
+    a = np.asarray(a, dtype=np.uint8)
+    planes = np.asarray(planes, dtype=np.uint8)
+    tbl = _mul_table()
+    out = np.zeros((a.shape[0], planes.shape[1]), dtype=np.uint8)
+    tmp = np.empty(planes.shape[1], dtype=np.uint8)
+    for i in range(a.shape[0]):
+        acc = out[i]
+        for l in range(a.shape[1]):
+            c = a[i, l]
+            if c == 0:
+                continue
+            if c == 1:
+                np.bitwise_xor(acc, planes[l], out=acc)
+            else:
+                np.take(tbl[c], planes[l], out=tmp)
+                np.bitwise_xor(acc, tmp, out=acc)
+    return out
+
+
 def gf_invert_matrix(m: np.ndarray) -> np.ndarray:
     """Gauss-Jordan inversion of a square matrix over GF(2^8).
 

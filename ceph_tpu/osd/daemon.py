@@ -51,7 +51,9 @@ import zlib
 from ceph_tpu.common.config import Config
 from ceph_tpu.common.crc import ceph_crc32c
 from ceph_tpu.common.kv import KeyValueDB
-from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy
+from ceph_tpu.common.watchdog import SharedWatchdog
+from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy, payload_of
+from ceph_tpu.msg.frames import FEATURE_SUBOP_BATCH
 from ceph_tpu.mon.client import MonClient
 from ceph_tpu.osd.cls import ClsError, MethodContext, default_handler
 from ceph_tpu.osd.ecutil import SEED, HashInfo
@@ -102,6 +104,22 @@ class _StalePartial(Exception):
 class _PartialUnfit(Exception):
     """Sub-stripe RMW preconditions failed mid-prepare (degraded shard,
     stale version, codec geometry); fall back to whole-object RMW."""
+
+
+class _SubOpCollector:
+    """Stand-in connection for one op inside a subop_batch frame: the
+    inner handler's sub_reply lands in a future instead of going
+    straight to the wire, so the batch handler can re-coalesce the
+    per-op acks into one reply frame."""
+
+    def __init__(self, conn, fut: asyncio.Future):
+        self.fut = fut
+        self.peer_name = getattr(conn, "peer_name", None)
+        self.peer_nonce = getattr(conn, "peer_nonce", 0)
+
+    def send_message(self, msg) -> None:
+        if not self.fut.done():
+            self.fut.set_result(msg)
 
 
 def pg_coll(pool: int, ps: int) -> str:
@@ -428,6 +446,16 @@ class OSDService(Dispatcher):
         # span latency histograms land beside the op counters, so the
         # Prometheus exporter scrapes trace timings as metrics
         self.perf_collection.add(self.tracer.perf)
+        # wire-path counters (frames out, corked runs, envelope format)
+        # surface through the same dump/Prometheus path
+        self.perf_collection.add(self.messenger.perf)
+        for key, desc in (
+            ("subop_batch_tx", "coalesced multi-op frames sent to peers"),
+            ("subop_batch_tx_ops", "sub-ops that rode a coalesced frame"),
+            ("subop_batch_rx", "coalesced multi-op frames received"),
+            ("subop_direct", "sub-ops sent as their own frame"),
+        ):
+            self.perf.add_u64_counter(key, desc)
         for key, desc in (
             ("op_w", "client writes served as primary"),
             ("op_w_partial", "EC writes served via sub-stripe RMW"),
@@ -469,6 +497,17 @@ class OSDService(Dispatcher):
         self._codecs: dict[int, object] = {}
         self._tids = iter(range(1, 1 << 62))
         self._waiters: dict[int, asyncio.Future] = {}
+        #: one deadline sweep for the whole sub-op fan-out instead of a
+        #: TimerHandle armed+cancelled per _peer_call (Objecter::tick)
+        self._watchdog = SharedWatchdog()
+        #: peer osd -> sub-ops queued this event-loop tick, flushed as
+        #: one subop_batch frame by a call_soon (sub-op coalescing)
+        self._subop_pending: dict[int, list] = {}
+        self._subop_batch = bool(self.config.get("ms_subop_batch"))
+        self.config.observe(
+            "ms_subop_batch",
+            lambda _n, v: setattr(self, "_subop_batch", bool(v)),
+        )
         self._hb_last: dict[int, float] = {}
         #: highest up_thru epoch already requested from the mon (the
         #: OSD::up_thru_wanted role; avoids a request per peering pass)
@@ -712,6 +751,8 @@ class OSDService(Dispatcher):
 
     async def stop(self) -> None:
         self._stopped = True
+        self._watchdog.stop()
+        self._subop_pending.clear()
         # never cancel the task running stop() itself (the fail-stop
         # path shuts the daemon down from inside an ephemeral task)
         cur = asyncio.current_task()
@@ -836,10 +877,17 @@ class OSDService(Dispatcher):
     async def _peer_call(
         self, osd: int, msg_type: str, payload: dict,
         timeout: float = 10.0, raw: bytes = b"",
+        batchable: bool = False,
     ) -> dict:
         """Request/response to a peer OSD (sub-op + ack). Bulk bytes ride
         the raw frame segment, never hex-in-JSON (frames_v2 multi-segment
-        shape); the reply's raw segment surfaces as reply["_raw"]."""
+        shape); the reply's raw segment surfaces as reply["_raw"].
+
+        `batchable` sub-ops to the same peer within one event-loop tick
+        coalesce into a single subop_batch frame (a k+m stripe touching
+        4 peers costs 4 frames, not k+m). Only sub-ops whose senders
+        tolerate per-op timeout+retry (idempotent via the replica's
+        version gate) may opt in."""
         tid = next(self._tids)
         payload = dict(payload)
         payload["tid"] = tid
@@ -855,21 +903,86 @@ class OSDService(Dispatcher):
         sp = self.tracer.child(
             f"subop_{msg_type}", tags={"to": f"osd.{osd}"}
         )
+        wire = "" if sp is None else sp.context().encode()
         fut = asyncio.get_event_loop().create_future()
         self._waiters[tid] = fut
         try:
-            self._osd_conn(osd).send_message(
-                Message(type=msg_type, tid=tid,
-                        epoch=self.osdmap.epoch,
-                        data=json.dumps(payload).encode(), raw=raw,
-                        trace="" if sp is None
-                        else sp.context().encode())
-            )
-            return await asyncio.wait_for(fut, timeout)
+            conn = self._osd_conn(osd)
+            if (
+                batchable
+                and self._subop_batch
+                and conn.is_connected
+                and conn.has_feature(FEATURE_SUBOP_BATCH)
+            ):
+                self._queue_subop(osd, msg_type, payload, raw, wire)
+            else:
+                # ordering: entries already queued for this peer were
+                # logically sent first — they must hit the wire first,
+                # or the replica's version gate drops the later one
+                self._flush_subops(osd)
+                self.perf.inc("subop_direct")
+                conn.send_message(
+                    Message(type=msg_type, tid=tid,
+                            epoch=self.osdmap.epoch,
+                            payload=payload, raw=raw, trace=wire)
+                )
+            return await self._watchdog.wait(fut, timeout)
         finally:
             self._waiters.pop(tid, None)
             if sp is not None:
                 sp.finish()
+
+    #: bound one coalesced frame (keeps head-of-line blocking and the
+    #: receiver's slice bookkeeping sane under deep fan-out backlogs)
+    SUBOP_BATCH_MAX = 32
+
+    def _queue_subop(
+        self, osd: int, msg_type: str, payload: dict, raw, wire: str
+    ) -> None:
+        raw = raw if isinstance(raw, (bytes, bytearray, memoryview)) \
+            else bytes(raw)
+        pend = self._subop_pending.setdefault(osd, [])
+        pend.append((msg_type, payload, raw, wire))
+        if len(pend) >= self.SUBOP_BATCH_MAX:
+            self._flush_subops(osd)
+        elif len(pend) == 1:
+            asyncio.get_event_loop().call_soon(self._flush_subops, osd)
+
+    def _flush_subops(self, osd: int) -> None:
+        """Put this peer's pending sub-ops on the wire: one subop_batch
+        frame when several coalesced, the plain per-op message when one.
+        A send failure here is absorbed — every queued op has a waiter
+        with a deadline, and _sub_op_persist retries on timeout."""
+        pend = self._subop_pending.pop(osd, None)
+        if not pend:
+            return
+        try:
+            conn = self._osd_conn(osd)
+            if len(pend) == 1:
+                mtype, payload, raw, wire = pend[0]
+                self.perf.inc("subop_direct")
+                conn.send_message(
+                    Message(type=mtype, tid=payload["tid"],
+                            epoch=self.osdmap.epoch,
+                            payload=payload, raw=raw, trace=wire)
+                )
+                return
+            ops = [
+                {"type": mtype, "payload": payload,
+                 "raw_len": len(raw), "trace": wire}
+                for mtype, payload, raw, wire in pend
+            ]
+            btid = next(self._tids)
+            conn.send_message(
+                Message(type="subop_batch", tid=btid,
+                        epoch=self.osdmap.epoch,
+                        payload={"tid": btid, "ops": ops},
+                        raw=b"".join(raw for _, _, raw, _ in pend))
+            )
+            self.perf.inc("subop_batch_tx")
+            self.perf.inc("subop_batch_tx_ops", len(pend))
+        except Exception:
+            pass  # waiters time out; _sub_op_persist re-targets/retries
 
     def _reply_peer(
         self, conn, tid: int, payload: dict, raw: bytes = b""
@@ -879,17 +992,30 @@ class OSDService(Dispatcher):
         conn.send_message(
             Message(type="sub_reply", tid=tid,
                     epoch=self.osdmap.epoch,
-                    data=json.dumps(payload).encode(), raw=raw)
+                    payload=payload, raw=raw)
         )
 
     # -- dispatch -------------------------------------------------------------
 
     async def ms_dispatch(self, conn, msg: Message) -> None:
-        p = json.loads(msg.data) if msg.data else {}
+        p = payload_of(msg)
         p["_raw"] = msg.raw  # the bulk data segment, bytes verbatim
         if msg.trace:
             p["_trace"] = msg.trace  # span context rides to the handler
         if msg.type == "sub_reply":
+            replies = p.get("replies")
+            if replies is not None:
+                # coalesced ack for a subop_batch: fan the per-op
+                # replies back out to their waiters
+                raw, off = p["_raw"], 0
+                for r in replies:
+                    n = int(r.pop("_raw_len", 0))
+                    r["_raw"] = raw[off:off + n]
+                    off += n
+                    fut = self._waiters.get(r.get("tid"))
+                    if fut is not None and not fut.done():
+                        fut.set_result(r)
+                return
             fut = self._waiters.get(p.get("tid"))
             if fut is not None and not fut.done():
                 fut.set_result(p)
@@ -897,6 +1023,75 @@ class OSDService(Dispatcher):
         handler = getattr(self, f"_h_{msg.type}", None)
         if handler is not None:
             await handler(conn, p)
+
+    async def _h_subop_batch(self, conn, p) -> None:
+        """Coalesced same-peer sub-ops (one frame, many ops): run each
+        inner op through its normal handler IN ORDER — the ordered
+        handlers enqueue synchronously, so the per-PG FIFO sees sender
+        order and the _sub_op_persist invariant holds. The ack gather
+        runs as its own task: dispatch stays on the read loop's fast
+        path and a stalled inner op never blocks this connection."""
+        self.perf.inc("subop_batch_rx")
+        loop = asyncio.get_event_loop()
+        raw, off = p["_raw"], 0
+        futs = []
+        for op in p.get("ops") or []:
+            ip = dict(op["payload"])
+            n = int(op.get("raw_len") or 0)
+            ip["_raw"] = raw[off:off + n]
+            off += n
+            if op.get("trace"):
+                ip["_trace"] = op["trace"]
+            handler = getattr(self, f"_h_{op['type']}", None)
+            if handler is None:
+                continue  # sender's per-op timeout retries it
+            fut = loop.create_future()
+            await handler(_SubOpCollector(conn, fut), ip)
+            futs.append(fut)
+        if futs:
+            self._spawn(
+                self._subop_batch_ack(conn, p.get("tid", 0), futs)
+            )
+
+    async def _subop_batch_ack(self, conn, btid: int, futs) -> None:
+        """One coalesced sub_reply for every inner op that acked within
+        the window; each op acks/fails INDEPENDENTLY — a straggler is
+        acked on its own when it completes (or the sender's per-op
+        deadline retries it) rather than holding the batch hostage.
+        The window is shorter than _sub_op_persist's 2.0s per-op
+        timeout so on-time acks always beat the sender's retry."""
+        done, pending = await asyncio.wait(futs, timeout=1.5)
+        for fut in pending:
+            fut.add_done_callback(
+                lambda f, c=conn: self._subop_late_ack(c, f)
+            )
+        replies, raws = [], []
+        for fut in futs:
+            if fut not in done or fut.cancelled() or fut.exception():
+                continue
+            m = fut.result()
+            rp = dict(
+                m.payload if m.payload is not None
+                else json.loads(m.data) if m.data else {}
+            )
+            rp["_raw_len"] = len(m.raw)
+            replies.append(rp)
+            raws.append(m.raw)
+        if replies:
+            conn.send_message(
+                Message(type="sub_reply", tid=btid,
+                        epoch=self.osdmap.epoch,
+                        payload={"tid": btid, "replies": replies},
+                        raw=b"".join(raws))
+            )
+
+    def _subop_late_ack(self, conn, fut) -> None:
+        if fut.cancelled() or fut.exception() is not None:
+            return
+        try:
+            conn.send_message(fut.result())
+        except Exception:
+            pass  # the sender's retry loop owns recovery
 
     # -- heartbeats + failure detection ---------------------------------------
 
@@ -2588,8 +2783,7 @@ class OSDService(Dispatcher):
                          "error": str(e)}
             conn.send_message(
                 Message(type="osd_op_reply", tid=p["tid"],
-                        epoch=self.osdmap.epoch,
-                        data=json.dumps(reply).encode())
+                        epoch=self.osdmap.epoch, payload=reply)
             )
             return True
         if op == "delete":
@@ -2618,8 +2812,7 @@ class OSDService(Dispatcher):
                              "error": f"no such object {name!r}"}
                 conn.send_message(
                     Message(type="osd_op_reply", tid=p["tid"],
-                            epoch=self.osdmap.epoch,
-                            data=json.dumps(reply).encode())
+                            epoch=self.osdmap.epoch, payload=reply)
                 )
                 return True
             return False
@@ -2711,11 +2904,9 @@ class OSDService(Dispatcher):
                 Message(
                     type="osd_op_reply", tid=p["tid"],
                     epoch=self.osdmap.epoch,
-                    data=json.dumps(
-                        {"tid": p["tid"], "ok": False,
-                         "errno": "EBLOCKLISTED",
-                         "error": f"{conn.peer_name} is blocklisted"}
-                    ).encode(),
+                    payload={"tid": p["tid"], "ok": False,
+                             "errno": "EBLOCKLISTED",
+                             "error": f"{conn.peer_name} is blocklisted"},
                 )
             )
             return
@@ -2851,11 +3042,9 @@ class OSDService(Dispatcher):
                     Message(
                         type="osd_op_reply", tid=p["tid"],
                         epoch=self.osdmap.epoch,
-                        data=json.dumps(
-                            {"tid": p["tid"], "ok": False,
-                             "wrong_primary": True,
-                             "epoch": self.osdmap.epoch}
-                        ).encode(),
+                        payload={"tid": p["tid"], "ok": False,
+                                 "wrong_primary": True,
+                                 "epoch": self.osdmap.epoch},
                     )
                 )
                 return
@@ -3031,7 +3220,7 @@ class OSDService(Dispatcher):
         conn.send_message(
             Message(type="osd_op_reply", tid=p["tid"],
                     epoch=self.osdmap.epoch,
-                    data=json.dumps(reply).encode(), raw=reply_raw)
+                    payload=reply, raw=reply_raw)
         )
 
     def _obj_version(self, pg: PG, name: str) -> int:
@@ -3082,7 +3271,8 @@ class OSDService(Dispatcher):
                 )
             try:
                 rep = await self._peer_call(
-                    osd, mtype, payload, timeout=2.0, raw=raw
+                    osd, mtype, payload, timeout=2.0, raw=raw,
+                    batchable=True,
                 )
             except (asyncio.TimeoutError, RuntimeError):
                 await asyncio.sleep(0.05)
@@ -4052,21 +4242,31 @@ class OSDService(Dispatcher):
                 available[pos] = osd
         want = {ec.chunk_index(i)
                 for i in range(ec.get_data_chunk_count())}
+        async def _fetch_shard(s: int) -> tuple[int, dict]:
+            try:
+                return s, await self._peer_call(
+                    available[s], "obj_read",
+                    {"coll": pg.coll, "name": shard_name(name, s),
+                     "ver": entry["obj_ver"]},
+                    timeout=2.0, batchable=True,
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                return s, {"ok": False}
+
         while True:
             minimum = ec.minimum_to_decode(want, set(available))
             fetch = [s for s in minimum if s not in chunks]
             failed = None
-            for s in fetch:
-                try:
-                    rep = await self._peer_call(
-                        available[s], "obj_read",
-                        {"coll": pg.coll, "name": shard_name(name, s),
-                         "ver": entry["obj_ver"]},
-                        timeout=2.0,
-                    )
-                except (asyncio.TimeoutError, RuntimeError):
-                    rep = {"ok": False}
+            # all missing shards in flight at once: one gather instead of
+            # k serial round trips, and same-tick fetches to one peer ride
+            # a single batched sub-op frame
+            results = await asyncio.gather(
+                *(_fetch_shard(s) for s in fetch)
+            )
+            for s, rep in results:
                 if not rep.get("ok"):
+                    if failed is not None:
+                        continue  # one miss already drives the retry
                     # acting home lacks the shard (mid-recovery interval):
                     # previous-interval strays may still hold it
                     stray = await self._fetch_copy(
@@ -4080,7 +4280,7 @@ class OSDService(Dispatcher):
                             size = stray[1].get("size")
                         continue
                     failed = s
-                    break
+                    continue
                 chunks[s] = rep["_raw"]
                 if size is None:
                     size = _attrs_from(rep).get("size")
@@ -4260,12 +4460,10 @@ class OSDService(Dispatcher):
             wconn.send_message(
                 Message(
                     type="watch_notify",
-                    data=json.dumps(
-                        {"pool": pg.pool, "name": p["name"],
-                         "notify_id": notify_id,
-                         "cookie": cookie,
-                         "payload": p.get("payload", "")}
-                    ).encode(),
+                    payload={"pool": pg.pool, "name": p["name"],
+                             "notify_id": notify_id,
+                             "cookie": cookie,
+                             "payload": p.get("payload", "")},
                 )
             )
         timeout = p.get("timeout", 5.0)
@@ -4303,8 +4501,7 @@ class OSDService(Dispatcher):
             reply = {"tid": p["tid"], "ok": False, "error": str(e)}
         conn.send_message(
             Message(type="osd_op_reply", tid=p["tid"],
-                    epoch=self.osdmap.epoch,
-                    data=json.dumps(reply).encode())
+                    epoch=self.osdmap.epoch, payload=reply)
         )
 
     async def _h_notify_ack(self, conn, p) -> None:
@@ -4443,7 +4640,7 @@ class OSDService(Dispatcher):
             reply = {"tid": p["tid"], "ok": False, "error": str(e)}
         conn.send_message(
             Message(type="osd_admin_reply", tid=p["tid"],
-                    data=json.dumps(reply).encode())
+                    payload=reply)
         )
 
     async def _h_trace_report(self, conn, p) -> None:

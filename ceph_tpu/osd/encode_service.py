@@ -26,7 +26,7 @@ import asyncio
 import numpy as np
 
 from ceph_tpu.ops import gf_pallas as gp
-from ceph_tpu.ops.gf import gf_matmul
+from ceph_tpu.ops.gf import gf_region_matmul
 
 
 def _bucket_pad(words: np.ndarray) -> tuple[np.ndarray, int]:
@@ -200,7 +200,7 @@ class EncodeService:
                         planes, axis=0
                     )[None]
                 else:
-                    parity = gf_matmul(parity_mat, planes)
+                    parity = gf_region_matmul(parity_mat, planes)
             self.launches += 1
             self.objects += len(q)
             off = 0
@@ -342,7 +342,7 @@ class EncodeService:
                 dm = matrices.decode_matrix(
                     codec._gen, codec.k, list(present), list(targets)
                 )
-                rebuilt = gf_matmul(dm, planes)
+                rebuilt = gf_region_matmul(dm, planes)
             self.launches += 1
             self.objects += len(q)
             off = 0

@@ -19,7 +19,8 @@ import json
 
 from ceph_tpu.common.config import Config
 from ceph_tpu.common.hash import ceph_str_hash_rjenkins
-from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy
+from ceph_tpu.common.watchdog import SharedWatchdog
+from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy, payload_of
 from ceph_tpu.mon.client import MonClient
 from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE
 
@@ -75,6 +76,16 @@ class Objecter(Dispatcher):
         self.messenger.tracer = self.tracer
         #: trace_id -> span ids already shipped to the collector OSD
         self._reported: dict[str, set] = {}
+        #: one deadline sweep for every in-flight op (Objecter::tick)
+        #: instead of an asyncio TimerHandle armed+cancelled per op
+        self._watchdog = SharedWatchdog()
+        #: futures resolved on the next osdmap epoch advance
+        self._epoch_waiters: list[asyncio.Future] = []
+        #: per-epoch (pool, ps) -> primary memo (the daemon's acting_of
+        #: idiom client-side: CRUSH runs once per PG per map, not per op)
+        self._target_cache: dict[tuple[int, int], int] = {}
+        self._target_cache_epoch = -1
+        self.mon.on_map_change(self._note_map_advance)
         self.mon.on_map_change(self._rewatch_on_map)
 
     async def start(self) -> None:
@@ -125,6 +136,7 @@ class Objecter(Dispatcher):
                 await self._ticket_task
             except (asyncio.CancelledError, Exception):
                 pass
+        self._watchdog.stop()
         await self.messenger.shutdown()
         self.tracer.close()
 
@@ -144,13 +156,17 @@ class Objecter(Dispatcher):
             await self.ext_dispatch(conn, msg)
             return
         if msg.type in ("osd_op_reply", "osd_admin_reply"):
-            p = json.loads(msg.data)
-            p["_raw"] = msg.raw  # bulk read payload (raw frame segment)
+            p = payload_of(msg)
+            # bulk read payload (raw frame segment): materialize the
+            # zero-copy frame view here — the librados surface promises
+            # bytes, and the frame buffer must not outlive dispatch
+            raw = msg.raw
+            p["_raw"] = raw if isinstance(raw, bytes) else bytes(raw)
             fut = self._waiters.get(p.get("tid"))
             if fut is not None and not fut.done():
                 fut.set_result(p)
         elif msg.type == "watch_notify":
-            p = json.loads(msg.data)
+            p = payload_of(msg)
             cb = self._watches.get(
                 (p["pool"], p["name"], p.get("cookie", ""))
             )
@@ -161,11 +177,9 @@ class Objecter(Dispatcher):
                     conn.send_message(
                         Message(
                             type="notify_ack",
-                            data=json.dumps(
-                                {"notify_id": p["notify_id"],
-                                 "watcher": self.name,
-                                 "cookie": p.get("cookie", "")}
-                            ).encode(),
+                            payload={"notify_id": p["notify_id"],
+                                     "watcher": self.name,
+                                     "cookie": p.get("cookie", "")},
                         )
                     )
 
@@ -218,8 +232,7 @@ class Objecter(Dispatcher):
             self.messenger.connect(
                 tuple(addr), Policy.lossless_client()
             ).send_message(
-                Message(type="osd_admin", tid=tid,
-                        data=json.dumps(payload).encode())
+                Message(type="osd_admin", tid=tid, payload=payload)
             )
             reply = await asyncio.wait_for(fut, timeout)
         finally:
@@ -266,17 +279,48 @@ class Objecter(Dispatcher):
         if pool is None:
             raise RadosError(f"no pool {pool_id}")
         ps = pool.raw_pg_to_pg(ceph_str_hash_rjenkins(name))
-        _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(
-            pool_id, ps
-        )
+        epoch = self.osdmap.epoch
+        if epoch != self._target_cache_epoch:
+            self._target_cache.clear()
+            self._target_cache_epoch = epoch
+        primary = self._target_cache.get((pool_id, ps))
+        if primary is None:
+            _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(
+                pool_id, ps
+            )
+            self._target_cache[(pool_id, ps)] = primary
         if primary in (-1, CRUSH_ITEM_NONE):
             raise RadosError(f"pg {pool_id}.{ps} has no primary")
         return primary
 
-    async def _refresh_map(self) -> None:
-        epoch = self.osdmap.epoch if self.osdmap else 0
-        self.mon.subscribe(from_epoch=epoch)
-        await asyncio.sleep(0.05)
+    def _note_map_advance(self, _osdmap) -> None:
+        waiters, self._epoch_waiters = self._epoch_waiters, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
+
+    async def _refresh_map(self, timeout: float = 0.2) -> None:
+        """Catch up to the mon's osdmap: subscribe past our epoch, then
+        wait for the actual epoch advance (woken by `on_map_change`)
+        with a deadline — not a blind sleep. The deadline matters: after
+        a retarget the mon may have nothing newer, and the retry loop
+        must keep pacing rather than hang."""
+        cur = self.osdmap.epoch if self.osdmap else 0
+        self.mon.subscribe(from_epoch=cur)
+        deadline = asyncio.get_event_loop().time() + timeout
+        while (self.osdmap.epoch if self.osdmap else 0) <= cur:
+            left = deadline - asyncio.get_event_loop().time()
+            if left <= 0:
+                return
+            fut = asyncio.get_event_loop().create_future()
+            self._epoch_waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, left)
+            except asyncio.TimeoutError:
+                return
+            finally:
+                if fut in self._epoch_waiters:
+                    self._epoch_waiters.remove(fut)
 
     # -- op submission --------------------------------------------------------
 
@@ -400,11 +444,11 @@ class Objecter(Dispatcher):
                 conn.send_message(
                     Message(type="osd_op", tid=tid,
                             epoch=self.osdmap.epoch,
-                            data=json.dumps(payload).encode(),
+                            payload=payload,
                             raw=data or b"",
                             trace=wire_ctx)
                 )
-                reply = await asyncio.wait_for(fut, timeout=3.0)
+                reply = await self._watchdog.wait(fut, 3.0)
             except asyncio.TimeoutError:
                 # primary silent (died?): refresh the map and re-target
                 if span is not None:

@@ -37,6 +37,14 @@ def parse_args():
     ap.add_argument("--m", type=int, default=2)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (tests/dev)")
+    # wire fast-path knobs (A/B runs; env CEPH_TPU_MS_* overrides win)
+    ap.add_argument("--envelope-format", default=None,
+                    choices=("binary", "json"),
+                    help="ms_envelope_format for every daemon + client")
+    ap.add_argument("--cork-max", type=int, default=None,
+                    help="ms_cork_max_frames (1 = no write coalescing)")
+    ap.add_argument("--subop-batch", default=None, choices=("on", "off"),
+                    help="ms_subop_batch (same-peer sub-op coalescing)")
     ap.add_argument("--multiprocess", action="store_true",
                     help="every daemon a real OS process (vstart) + "
                          "--clients client worker processes")
@@ -67,6 +75,12 @@ async def main(args) -> dict:
     cfg.set("mon_election_timeout", 0.4)
     cfg.set("osd_heartbeat_interval", 0.5)
     cfg.set("osd_heartbeat_grace", 5)
+    if args.envelope_format is not None:
+        cfg.set("ms_envelope_format", args.envelope_format)
+    if args.cork_max is not None:
+        cfg.set("ms_cork_max_frames", args.cork_max)
+    if args.subop_batch is not None:
+        cfg.set("ms_subop_batch", args.subop_batch == "on")
 
     from ceph_tpu.vstart import initial_osdmap
 
@@ -114,11 +128,33 @@ async def main(args) -> dict:
         i: (o.encode_service.launches, o.encode_service.objects)
         for i, o in osds.items()
     }
+
+    def wire_counts() -> dict:
+        """Sub-op wire cost across the fleet (frames-per-op source)."""
+        tot = {"subop_frames": 0, "subop_ops": 0, "frames_out": 0,
+               "bytes_coalesced": 0}
+        for o in osds.values():
+            d = o.perf.dump()
+            md = o.messenger.perf.dump()
+            tot["subop_frames"] += (
+                d.get("subop_direct", 0) + d.get("subop_batch_tx", 0)
+            )
+            tot["subop_ops"] += (
+                d.get("subop_direct", 0) + d.get("subop_batch_tx_ops", 0)
+            )
+            tot["frames_out"] += md.get("frames_out", 0)
+            tot["bytes_coalesced"] += md.get("bytes_coalesced", 0)
+        return tot
+
+    wire0 = wire_counts()
     t0 = time.perf_counter()
     await asyncio.gather(
         *(stream(w, per) for w in range(args.concurrency))
     )
     elapsed = time.perf_counter() - t0
+    wire1 = wire_counts()
+    n_writes = per * args.concurrency
+    wire = {k: wire1[k] - wire0[k] for k in wire0}
     total_bytes = per * args.concurrency * len(payload)
     launches = sum(
         o.encode_service.launches - before[i][0] for i, o in osds.items()
@@ -152,6 +188,15 @@ async def main(args) -> dict:
         "k": args.k,
         "m": args.m,
         "osds": args.osds,
+        # sub-op wire frames per client write (fan-out coalescing
+        # effectiveness: < k+m means same-peer sub-ops shared frames)
+        "frames_per_op": wire["subop_frames"] / max(1, n_writes),
+        "subop_frames": wire["subop_frames"],
+        "subop_ops": wire["subop_ops"],
+        "bytes_coalesced": wire["bytes_coalesced"],
+        "envelope_format": str(cfg.get("ms_envelope_format")),
+        "cork_max_frames": int(cfg.get("ms_cork_max_frames")),
+        "subop_batch": bool(cfg.get("ms_subop_batch")),
     }
 
 
