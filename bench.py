@@ -390,6 +390,42 @@ def _data_line() -> None:
         pass
 
 
+def _fleet_line() -> None:
+    """Optional JSON line: coordination-subsystem costs through the
+    full stack — barrier round-trip latency across a multi-host fleet
+    (arrive locks + watch/notify wakeup on the roster's primary OSD)
+    and the per-rank sharded restore aggregate vs one host restoring
+    the whole tree, via tools/fleet_tool.py's in-process bench.
+    Guarded (--fleet / CEPH_TPU_BENCH_FLEET=1) and non-fatal."""
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            [sys.executable, "tools/fleet_tool.py", "bench",
+             "--hosts", os.environ.get("CEPH_TPU_BENCH_FLEET_HOSTS", "4"),
+             "--rounds", "20",
+             "--mb", os.environ.get("CEPH_TPU_BENCH_FLEET_MB", "16")],
+            capture_output=True, timeout=600, check=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        r = json.loads(out.stdout.strip().splitlines()[-1])
+        print(json.dumps({
+            "metric": "fleet_barrier_latency",
+            "value": r["barrier_p50_ms"],
+            "unit": "ms",
+            "p99_ms": r["barrier_p99_ms"],
+            "hosts": r["hosts"],
+            "rounds": r["rounds"],
+            # multi-host restore: every rank fetches only its slab
+            "bytes": r["bytes"],
+            "restore_whole_gbps": r["restore_whole_gbps"],
+            "restore_sharded_gbps": r["restore_sharded_gbps"],
+            "sharded_speedup": r["sharded_speedup"],
+        }))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def main() -> None:
     import jax
 
@@ -444,6 +480,10 @@ def main() -> None:
         _ckpt_line()
     if "--data" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_DATA"):
         _data_line()
+    if "--fleet" in sys.argv[1:] or os.environ.get(
+        "CEPH_TPU_BENCH_FLEET"
+    ):
+        _fleet_line()
 
 
 if __name__ == "__main__":

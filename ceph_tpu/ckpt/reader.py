@@ -49,6 +49,12 @@ class CkptReader:
 
     async def read_head(self) -> dict:
         raw = await self.ioctx.read(layout.head_object(self.name))
+        if not raw:
+            # xattr-only head object (committer lock taken, nothing
+            # committed yet) reads as empty — same as no checkpoint
+            raise ObjectNotFound(
+                f"checkpoint {self.name!r} has no committed HEAD"
+            )
         return json.loads(raw.decode())
 
     async def read_manifest(self, save_id: str | None = None) -> dict:

@@ -178,6 +178,10 @@ class CkptWriter:
             raw = await self.ioctx.read(layout.head_object(self.name))
         except ObjectNotFound:
             return None
+        if not raw:
+            # the object can pre-exist HEAD with empty data: taking the
+            # committer lock (an xattr) creates it
+            return None
         return json.loads(raw.decode()).get("save_id")
 
     _UNSET = object()

@@ -386,6 +386,25 @@ SCHEMA: dict[str, Option] = {
              "this proportional share against weight-1 foreground "
              "clients, so prefetch cannot starve ckpt/RBD traffic",
              min=0.01),
+        # coordination (ceph_tpu.coord: cls_lock leases, leader election,
+        # fleet roster/barriers for multi-host training)
+        _opt("cls_clock_offset", TYPE_FLOAT, LEVEL_DEV, 0.0,
+             "seconds added to the primary's clock when stamping "
+             "MethodContext.now for object-class calls; lets tests "
+             "advance lease time deterministically without sleeping"),
+        _opt("coord_lease", TYPE_FLOAT, LEVEL_ADVANCED, 5.0,
+             "lease duration (seconds) for coordination locks: fleet "
+             "member heartbeats, leader election, and the checkpoint "
+             "committer lock; an expired lease makes the lock breakable "
+             "by survivors", min=0.1),
+        _opt("coord_renew_factor", TYPE_FLOAT, LEVEL_ADVANCED, 0.34,
+             "a Lock's renew loop re-locks every coord_lease * this "
+             "fraction, so a holder survives a couple of missed renewals "
+             "before its lease lapses", min=0.05, max=0.9),
+        _opt("coord_barrier_poll", TYPE_FLOAT, LEVEL_ADVANCED, 1.0,
+             "fallback poll interval (seconds) for barrier/lock waiters; "
+             "watch/notify wakeups make this the slow path, only taken "
+             "when a notify is lost to a primary change", min=0.01),
         # bench / profiling
         _opt("bench_profile_trace_dir", TYPE_STR, LEVEL_DEV, "",
              "write jax.profiler traces here when set",

@@ -1,0 +1,24 @@
+"""ceph_tpu.coord: coordination layer over RADOS — cls_lock leases,
+leader election, and the multi-host training-fleet runtime.
+
+Layer 1 (`coord.lock`) wraps the `lock` object class (osd/cls.py):
+advisory exclusive/shared locks with cookie+owner identity and lease
+TTLs, a background renew loop, break-on-expired recovery, and
+watch/notify wakeup so waiters never poll in the steady state — the
+Chubby recipe (locks/leases/elections layered on a consistent core)
+with RADOS as the core, exactly how the reference's cls_lock serves
+RBD exclusive-lock and RGW.
+
+Layer 2 (`coord.fleet` + `coord.driver`) is the training-side fleet
+runtime: rank registration against a HEAD-CAS-published roster object,
+heartbeat leases, leader election, epoch-numbered barriers, and the
+driver that wires it to CkptStore (exactly-one-committer saves,
+per-rank sharded restore) and the data iterator (roster-derived
+strided slices that re-partition exactly on membership change).
+"""
+
+from ceph_tpu.coord.driver import FleetDriver
+from ceph_tpu.coord.fleet import Fleet
+from ceph_tpu.coord.lock import Lock, make_coord_perf
+
+__all__ = ["Fleet", "FleetDriver", "Lock", "make_coord_perf"]

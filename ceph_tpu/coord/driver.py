@@ -1,0 +1,155 @@
+"""coord.driver: wire the Fleet to the ckpt + data stores.
+
+The multi-host training contract, in three pieces:
+
+  * **exactly-one-committer saves** — only the elected leader runs
+    `save_async`, and it does so while holding the `committer` lease
+    lock on the checkpoint's HEAD object. A leader that dies mid-save
+    leaves an expired lease; the next leader breaks it (cls-side
+    `if_expired` guard) and commits its own save. HEAD can never
+    regress regardless: the async saver's commit-order invariant plus
+    the cas_head guard mean a zombie's late commit either targets the
+    expected predecessor (a valid newer save) or dies with ECANCELED.
+  * **per-rank sharded restore** — each host fetches only the slab of
+    each array its rank owns (`CkptReader.read_shard` underneath),
+    with (rank, num_hosts) derived from the live roster.
+  * **exact data resume** — iterators run the "stride" partition, so a
+    cursor saved at a synchronized step re-partitions onto the
+    SURVIVING host set with zero duplicate and zero missing records
+    (`layout.rebase_cursor`).
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.coord.lock import Lock
+from ceph_tpu.data import layout as data_layout
+from ceph_tpu.parallel.sharding import host_slice
+
+
+class FleetDriver:
+    def __init__(self, fleet, ckpt=None, data=None):
+        self.fleet = fleet
+        self.ckpt = ckpt  # CkptStore
+        self.data = data  # DataReader
+        self._committer: Lock | None = None
+
+    # -- checkpoint write path -------------------------------------------------
+
+    def committer_lock(self) -> Lock:
+        """The lease lock serializing committers, on the HEAD object
+        itself so it travels with the checkpoint name."""
+        if self._committer is None:
+            from ceph_tpu.ckpt import layout as ckpt_layout
+
+            self._committer = Lock(
+                self.ckpt.ioctx, ckpt_layout.head_object(self.ckpt.name),
+                "committer",
+                owner=self.fleet.host_id, cookie=self.fleet.host_id,
+                lease=self.fleet.lease, description="fleet ckpt committer",
+                perf=self.fleet.perf,
+            )
+        return self._committer
+
+    async def save(self, tree, *, iterator=None, save_id=None,
+                   timeout: float | None = None):
+        """Leader-only async save; returns the PendingSave, or None on
+        a non-leader (callers just keep training). Fills a vacant
+        leader seat first, so any survivor calling save() after the
+        leader died re-elects and takes over committing. When
+        `iterator` is given, its cursor rides along as the
+        "data_cursor" leaf."""
+        if not await self.fleet.elect():
+            return None
+        lk = self.committer_lock()
+        if not lk.locked:
+            await lk.acquire(block=True, timeout=timeout, break_dead=True)
+        if iterator is not None:
+            tree = dict(tree)
+            tree["data_cursor"] = data_layout.cursor_array(
+                iterator.state()
+            )
+        return await self.ckpt.save_async(tree, save_id=save_id)
+
+    async def drain(self) -> list[str]:
+        """Join pending saves and give up the committer lease."""
+        try:
+            return await self.ckpt.drain()
+        finally:
+            if self._committer is not None:
+                await self._committer.release()
+
+    # -- checkpoint read path --------------------------------------------------
+
+    async def restore(self, *, mesh=None, save_id=None):
+        """Whole-tree restore on every host (reshard-on-load when a
+        mesh is given); the committed HEAD is the same for all hosts."""
+        return await self.ckpt.restore(mesh=mesh, save_id=save_id)
+
+    async def restore_shard(self, path_key: str, *, axis: int = 0,
+                            save_id=None):
+        """This rank's slab of one array: rows split contiguously along
+        `axis` across the live roster. Returns (array, index) where
+        `index` is the tuple of slices fetched — only those bytes moved."""
+        rank, num_hosts = await self.fleet.rank()
+        reader = self.ckpt.reader()
+        manifest = await reader.read_manifest(save_id)
+        for a in manifest["arrays"]:
+            if "/".join(str(e[1]) for e in a["path"]) == path_key:
+                shape = tuple(a["shape"])
+                idx = tuple(
+                    host_slice(shape[d], num_hosts, rank)
+                    if d == axis else slice(None)
+                    for d in range(len(shape))
+                )
+                reader._manifest_compress = manifest.get("compress", "")
+                block = await reader.fetch_block(manifest, a, idx)
+                return block, idx
+        raise KeyError(path_key)
+
+    async def restore_cursor(self, *, save_id=None) -> dict | None:
+        """The data cursor embedded in the committed checkpoint,
+        rebased onto the CURRENT live roster — or None when the
+        checkpoint carries none."""
+        shard = None
+        try:
+            reader = self.ckpt.reader()
+            manifest = await reader.read_manifest(save_id)
+            for a in manifest["arrays"]:
+                key = "/".join(str(e[1]) for e in a["path"])
+                if key == "data_cursor":
+                    reader._manifest_compress = manifest.get(
+                        "compress", ""
+                    )
+                    idx = tuple(slice(None) for _ in a["shape"])
+                    shard = await reader.fetch_block(manifest, a, idx)
+                    break
+        except KeyError:
+            return None
+        if shard is None:
+            return None
+        cursor = data_layout.cursor_from_array(shard)
+        rank, num_hosts = await self.fleet.rank()
+        return data_layout.rebase_cursor(
+            cursor, num_hosts=num_hosts, host=rank
+        )
+
+    # -- data path -------------------------------------------------------------
+
+    async def data_iterator(self, *, seed: int = 0, batch_size: int = 1,
+                            num_epochs: int | None = 1):
+        """A fresh stride-partitioned iterator for this rank."""
+        rank, num_hosts = await self.fleet.rank()
+        return await self.data.iterator(
+            seed=seed, batch_size=batch_size, num_epochs=num_epochs,
+            num_hosts=num_hosts, host=rank, partition="stride",
+        )
+
+    async def resume_iterator(self, cursor: dict,
+                              num_epochs: int | None = 1):
+        """Resume from a (possibly differently-partitioned-fleet)
+        cursor: rebased onto the current roster, exactly."""
+        rank, num_hosts = await self.fleet.rank()
+        cur = data_layout.rebase_cursor(
+            cursor, num_hosts=num_hosts, host=rank
+        )
+        return await self.data.resume(cur, num_epochs=num_epochs)

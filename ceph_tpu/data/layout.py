@@ -263,11 +263,24 @@ def coalesce_entries(entries: list) -> list[dict]:
 
 CURSOR_FORMAT = 1
 
+#: how an epoch's permuted record ids are split across hosts:
+#:   "slice"  — balanced contiguous runs (parallel.sharding.host_slice);
+#:   "stride" — host h owns perm[base + h :: num_hosts]. The stride
+#: form is what fleet resume needs: at any position p that all hosts
+#: have reached, the globally consumed prefix is EXACTLY
+#: perm[base : base + p*num_hosts], so `rebase_cursor` can hand the
+#: remainder to a different host count with zero duplicate or missing
+#: records — impossible to do exactly with contiguous runs.
+PARTITIONS = ("slice", "stride")
+
 
 def cursor_state(
     *, name: str, ingest_id: str, seed: int, epoch: int, position: int,
     num_hosts: int, host: int, batch_size: int,
+    partition: str = "slice", base: int = 0,
 ) -> dict:
+    if partition not in PARTITIONS:
+        raise ValueError(f"unknown partition {partition!r}")
     return {
         "format": CURSOR_FORMAT,
         "name": name,
@@ -278,7 +291,25 @@ def cursor_state(
         "num_hosts": int(num_hosts),
         "host": int(host),
         "batch_size": int(batch_size),
+        "partition": partition,
+        "base": int(base),
     }
+
+
+def rebase_cursor(cursor: dict, *, num_hosts: int, host: int) -> dict:
+    """Re-partition a synchronized stride cursor onto a new host set
+    (fleet membership changed between save and resume). All old hosts
+    must have reached `position`; the consumed global prefix
+    perm[base : base + position*old_hosts] is folded into the new base,
+    so the new hosts' sequences tile the remainder exactly."""
+    if cursor.get("partition", "slice") != "stride":
+        raise ValueError(
+            "only stride-partitioned cursors re-partition exactly; "
+            f"got {cursor.get('partition', 'slice')!r}"
+        )
+    base = cursor.get("base", 0) + cursor["position"] * cursor["num_hosts"]
+    return dict(cursor, base=base, position=0,
+                num_hosts=int(num_hosts), host=int(host))
 
 
 def cursor_array(state: dict) -> np.ndarray:

@@ -69,6 +69,8 @@ class DataReader:
             raw = await self.ioctx.read(layout.head_object(self.name))
         except ObjectNotFound:
             return None
+        if not raw:
+            return None  # xattr-created head object, nothing committed
         return json.loads(raw.decode())
 
     async def read_manifest(self, ingest_id: str | None = None) -> dict:
@@ -95,13 +97,14 @@ class DataReader:
         self, *, seed: int = 0, epoch: int = 0, position: int = 0,
         num_hosts: int = 1, host: int = 0, batch_size: int = 1,
         num_epochs: int | None = 1, ingest_id: str | None = None,
+        partition: str = "slice", base: int = 0,
     ) -> "DataIterator":
         manifest = await self.read_manifest(ingest_id)
         return DataIterator(
             self, manifest,
             seed=seed, epoch=epoch, position=position,
             num_hosts=num_hosts, host=host, batch_size=batch_size,
-            num_epochs=num_epochs,
+            num_epochs=num_epochs, partition=partition, base=base,
         )
 
     async def resume(self, cursor: dict,
@@ -119,6 +122,8 @@ class DataReader:
             position=cursor["position"], num_hosts=cursor["num_hosts"],
             host=cursor["host"], batch_size=cursor["batch_size"],
             num_epochs=num_epochs, ingest_id=cursor["ingest_id"],
+            partition=cursor.get("partition", "slice"),
+            base=cursor.get("base", 0),
         )
 
     # -- verify ----------------------------------------------------------------
@@ -181,9 +186,12 @@ class DataIterator:
     """
 
     def __init__(self, reader: DataReader, manifest: dict, *, seed, epoch,
-                 position, num_hosts, host, batch_size, num_epochs):
+                 position, num_hosts, host, batch_size, num_epochs,
+                 partition: str = "slice", base: int = 0):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
+        if partition not in layout.PARTITIONS:
+            raise ValueError(f"unknown partition {partition!r}")
         self.reader = reader
         self.manifest = manifest
         self.seed = int(seed)
@@ -193,6 +201,10 @@ class DataIterator:
         self.host = int(host)
         self.batch_size = int(batch_size)
         self.num_epochs = num_epochs
+        self.partition = partition
+        #: permuted ids below `base` belong to PREVIOUS host sets (a
+        #: fleet rebase mid-epoch); only meaningful for "stride"
+        self.base = int(base)
         self._epochs_done = 0
         self._starts = layout.shard_starts(manifest)
         self._striper = reader._striper(manifest)
@@ -235,7 +247,12 @@ class DataIterator:
                     perm = layout.epoch_permutation(n, self.seed, self.epoch)
             else:
                 perm = layout.epoch_permutation(n, self.seed, self.epoch)
-            self._host_ids = perm[host_slice(n, self.num_hosts, self.host)]
+            if self.partition == "stride":
+                self._host_ids = perm[self.base + self.host::self.num_hosts]
+            else:
+                self._host_ids = perm[
+                    host_slice(n, self.num_hosts, self.host)
+                ]
         return self._host_ids
 
     def _advance_epoch(self) -> bool:
@@ -245,6 +262,7 @@ class DataIterator:
             return False
         self.epoch += 1
         self.position = 0
+        self.base = 0  # rebase offsets are an intra-epoch artifact
         self._host_ids = None
         return True
 
@@ -257,6 +275,7 @@ class DataIterator:
             seed=self.seed, epoch=self.epoch, position=self.position,
             num_hosts=self.num_hosts, host=self.host,
             batch_size=self.batch_size,
+            partition=self.partition, base=self.base,
         )
 
     # -- batch fetch (IO half vs decode half) ----------------------------------

@@ -196,6 +196,8 @@ class DataWriter:
             raw = await self.ioctx.read(layout.head_object(self.name))
         except ObjectNotFound:
             return None
+        if not raw:
+            return None  # xattr-created head object, nothing committed
         return json.loads(raw.decode()).get("save_id")
 
     _UNSET = object()

@@ -49,6 +49,11 @@ class MethodContext:
     user_attrs: dict = field(default_factory=dict)
     #: the PG log's object version (0 when absent)
     version: int = 0
+    #: the primary's clock at call time (ceph_clock_now as seen by cls
+    #: methods); lease arithmetic uses this, never the client's clock.
+    #: The OSD stamps it from time.time() + `cls_clock_offset` so tests
+    #: can advance "time" deterministically without sleeping.
+    now: float = 0.0
     _writable: bool = False
     dirty: bool = False
 
@@ -170,37 +175,73 @@ class ClassHandler:
 
 
 # -- cls_lock (src/cls/lock/cls_lock.cc behaviors) ----------------------------
+#
+# Advisory exclusive/shared locks with cookie+owner identity and lease
+# TTLs, held in a user xattr (EC-pool-safe — no omap). A holder with
+# `duration > 0` carries `expiration` (primary clock, ctx.now); expired
+# holders are invisible to conflict checks and breakable by anyone, while
+# a re-lock by the same owner+cookie renews the lease (bumps expiration).
+# `duration == 0` means the lock never expires (reference cls_lock's
+# LOCK_FLAG_MAY_RENEW / utime_t duration semantics).
 
 def _lock_key(name: str) -> str:
     return f"lock.{name}"
 
 
+def _lock_live(h: dict, now: float) -> bool:
+    exp = h.get("expiration", 0)
+    return not exp or exp > now
+
+
 def _lock_op(ctx: MethodContext, inp: dict):
     name = inp["name"]
     ltype = inp.get("type", "exclusive")
+    if ltype not in ("exclusive", "shared"):
+        raise ClsError("EINVAL", f"bad lock type {ltype!r}")
     owner = inp["owner"]
     cookie = inp.get("cookie", "")
+    duration = float(inp.get("duration", 0) or 0)
     state = ctx.getxattr(_lock_key(name)) or {"type": ltype, "holders": []}
-    me = {"owner": owner, "cookie": cookie}
-    if state["holders"]:
-        if me in state["holders"]:
-            return {"ok": True, "renewed": True}  # idempotent re-lock
-        if ltype == "exclusive" or state["type"] == "exclusive":
-            raise ClsError("EBUSY", f"lock {name!r} held")
+    expiration = ctx.now + duration if duration > 0 else 0
+    for h in state["holders"]:
+        if h["owner"] == owner and h["cookie"] == cookie:
+            # idempotent re-lock by the holder renews the lease — even
+            # past expiry, as long as nobody broke or took the lock
+            h["expiration"] = expiration
+            h["description"] = inp.get("description", h.get("description", ""))
+            ctx.setxattr(_lock_key(name), state)
+            return {"ok": True, "renewed": True, "expiration": expiration}
+    live = [h for h in state["holders"] if _lock_live(h, ctx.now)]
+    if live and (ltype == "exclusive" or state["type"] == "exclusive"):
+        raise ClsError("EBUSY", f"lock {name!r} held")
+    # expired holders are pruned the first time a new locker gets in
+    # (reference cls_lock expiration semantics); the reply names them
+    # so the client can log/count the implicit break
+    pruned = [{"owner": h["owner"], "cookie": h["cookie"]}
+              for h in state["holders"] if not _lock_live(h, ctx.now)]
     state["type"] = ltype
-    state["holders"].append(me)
+    state["holders"] = live + [{
+        "owner": owner, "cookie": cookie, "expiration": expiration,
+        "since": ctx.now, "description": inp.get("description", ""),
+    }]
     ctx.setxattr(_lock_key(name), state)
-    return {"ok": True}
+    return {"ok": True, "expiration": expiration, "pruned": pruned}
 
 
 def _unlock_op(ctx: MethodContext, inp: dict):
     name = inp["name"]
     state = ctx.getxattr(_lock_key(name))
-    me = {"owner": inp["owner"], "cookie": inp.get("cookie", "")}
-    if not state or me not in state["holders"]:
+    owner, cookie = inp["owner"], inp.get("cookie", "")
+    # exact owner+cookie match; an expired-but-unbroken holder may still
+    # unlock (its entry is present until pruned)
+    keep = [] if not state else [
+        h for h in state["holders"]
+        if not (h["owner"] == owner and h["cookie"] == cookie)
+    ]
+    if not state or len(keep) == len(state["holders"]):
         raise ClsError("ENOENT", f"not the holder of {name!r}")
-    state["holders"].remove(me)
-    if state["holders"]:
+    if keep:
+        state["holders"] = keep
         ctx.setxattr(_lock_key(name), state)
     else:
         ctx.rmxattr(_lock_key(name))
@@ -209,28 +250,52 @@ def _unlock_op(ctx: MethodContext, inp: dict):
 
 def _lock_info(ctx: MethodContext, inp: dict):
     state = ctx.getxattr(_lock_key(inp["name"]))
-    return {"holders": [] if not state else state["holders"],
-            "type": None if not state else state["type"]}
+    holders = []
+    for h in ([] if not state else state["holders"]):
+        exp = h.get("expiration", 0)
+        holders.append(dict(
+            h,
+            expired=bool(exp) and exp <= ctx.now,
+            ttl=max(0.0, exp - ctx.now) if exp else None,
+        ))
+    return {"holders": holders,
+            "type": None if not state else state["type"],
+            "now": ctx.now}
 
 
 def _break_lock(ctx: MethodContext, inp: dict):
     """cls_lock break_lock: remove a NAMED holder without being it —
     the recovery path after the holder died (the caller blocklists the
-    holder first so its in-flight ops can't outlive the break)."""
+    holder first so its in-flight ops can't outlive the break). With
+    `if_expired`, the break only lands if the holder's lease has lapsed
+    — evaluated against the primary's clock inside the primary, so it
+    is atomic with respect to a racing renewal."""
     name = inp["name"]
     state = ctx.getxattr(_lock_key(name))
     owner = inp["owner"]
+    cookie = inp.get("cookie")  # None = any cookie of that owner
     if not state:
         raise ClsError("ENOENT", f"lock {name!r} not held")
-    keep = [h for h in state["holders"] if h["owner"] != owner]
-    if len(keep) == len(state["holders"]):
+
+    def match(h):
+        return h["owner"] == owner and (cookie is None
+                                        or h["cookie"] == cookie)
+
+    matched = [h for h in state["holders"] if match(h)]
+    if not matched:
         raise ClsError("ENOENT", f"{owner!r} does not hold {name!r}")
+    if inp.get("if_expired"):
+        live = [h for h in matched if _lock_live(h, ctx.now)]
+        if live:
+            raise ClsError("EBUSY", f"{owner!r} lease on {name!r} "
+                                    "is still live")
+    keep = [h for h in state["holders"] if not match(h)]
     if keep:
         state["holders"] = keep
         ctx.setxattr(_lock_key(name), state)
     else:
         ctx.rmxattr(_lock_key(name))
-    return {"ok": True}
+    return {"ok": True, "broken": len(matched)}
 
 
 # -- cls_ckpt (ceph_tpu.ckpt HEAD pointer guard) ------------------------------

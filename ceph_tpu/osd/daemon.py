@@ -4353,6 +4353,9 @@ class OSDService(Dispatcher):
                 self.store.omap_get(pg.coll, name) if ec is None else None
             ),
             omap_supported=ec is None,
+            # lease arithmetic runs on the primary's clock; the offset
+            # knob lets tests advance cls time without sleeping
+            now=time.time() + float(self.config.get("cls_clock_offset")),
         )
         result = self.cls.call(p["cls"], p["method"], ctx, p.get("input"))
         if ctx.dirty:

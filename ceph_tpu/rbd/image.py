@@ -66,7 +66,9 @@ class _ClsHeaderLock:
         self.header = header_name
         m = ioctx.objecter.messenger
         self.owner = f"{ioctx.objecter.name}/{m.instance_nonce}"
-        self.cookie = "rbd"
+        # cookie = client id: `rbd lock ls` equivalents show WHICH
+        # client holds the image, not just which messenger instance
+        self.cookie = ioctx.objecter.name
 
     async def acquire(self, timeout: float = 10.0) -> None:
         """Bounded-retry exclusive acquire (maintenance ops hold the
@@ -119,6 +121,13 @@ class _ClsHeaderLock:
             self.header, "lock", "break_lock",
             {"name": RBD_LOCK_NAME, "owner": owner},
         )
+        try:
+            self.ioctx.objecter.mon.cluster_log(
+                "WRN", f"lock broken: {self.header}/{RBD_LOCK_NAME} "
+                       f"holder {owner!r} by {self.owner!r}"
+            )
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class _HeaderLockCtx:
@@ -214,13 +223,17 @@ class Image:
 
     @classmethod
     async def open(
-        cls, ioctx: IoCtx, name: str, exclusive: bool = False
+        cls, ioctx: IoCtx, name: str, exclusive: bool = False,
+        force: bool = False,
     ) -> "Image":
         """`exclusive=True` = open-for-write under the cluster-side
         exclusive lock (librbd's exclusive-lock feature): held until
         `close()`/`lock_release()`, visible to every other client via
         `lock_holders()`, breakable with `break_lock` when the holder
-        died (which blocklists it first)."""
+        died (which blocklists it first). A second writer fails with
+        EBUSY immediately; `force=True` is the `rbd lock rm`-style
+        operator override — break every current holder (blocklisting
+        their instances) and take the lock."""
         try:
             header = json.loads(await ioctx.read(cls._header_name(name)))
         except ObjectNotFound as e:
@@ -232,7 +245,14 @@ class Image:
                   children=header.get("children", 0),
                   migration=header.get("migration"))
         if exclusive:
-            await img.lock_acquire()
+            try:
+                await img.lock_acquire(timeout=0.0)
+            except RadosError as e:
+                if "EBUSY" not in str(e) or not force:
+                    raise
+                for h in await img.lock_holders():
+                    await img.break_lock(h["owner"])
+                await img.lock_acquire(timeout=0.0)
         return img
 
     # -- the exclusive lock (ManagedLock.h:28 surface) -------------------------

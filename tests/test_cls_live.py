@@ -34,7 +34,9 @@ def test_cls_lock_version_and_custom_class():
         with pytest.raises(RadosError, match="EBUSY"):
             await ioctx.exec("obj", "lock", "lock", other)
         info = await ioctx.exec("obj", "lock", "get_info", {"name": "l1"})
-        assert info["holders"] == [{"owner": "client.a", "cookie": "c1"}]
+        (h,) = info["holders"]
+        assert (h["owner"], h["cookie"]) == ("client.a", "c1")
+        assert h["expiration"] == 0 and not h["expired"]  # no lease
         assert (await ioctx.exec("obj", "lock", "unlock", me))["ok"]
         # now the other client can take it, shared this time
         shared = dict(other, type="shared")

@@ -1,0 +1,414 @@
+"""Coordination subsystem, deterministic tier (ISSUE 10 tentpole).
+
+Lock-cls lease semantics, the client Lock wrapper's watch/notify
+wakeup + break-on-expired recovery, fleet roster/election/barriers,
+the FleetDriver's exactly-one-committer checkpoint story, and the
+stride data partition's zero-dup/zero-missing resume — all with NO
+wall-clock sleeps: lease time advances through the `cls_clock_offset`
+config knob (every in-process daemon shares one Config object, and
+MethodContext.now is stamped from it inside the primary).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ckpt.store import CkptStore
+from ceph_tpu.coord import Fleet, FleetDriver, Lock
+from ceph_tpu.coord.lock import make_coord_perf
+from ceph_tpu.data import layout as data_layout
+from ceph_tpu.data.store import DataStore
+from ceph_tpu.rados.client import Rados, RadosError
+from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster, wait_until
+
+HOSTS = ("host-a", "host-b", "host-c")
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 120))
+
+
+async def start_cluster():
+    cluster = Cluster()
+    await cluster.start()
+    admin = Rados("client.coord", cluster.monmap, config=cluster.cfg)
+    await admin.connect()
+    await cluster.create_pools(admin)
+    return cluster, admin
+
+
+def jump_clock(cluster, seconds: float) -> None:
+    """Advance cls lease time for every in-process daemon at once."""
+    cluster.cfg.set(
+        "cls_clock_offset",
+        float(cluster.cfg.get("cls_clock_offset")) + seconds,
+    )
+
+
+# -- cls-level lease semantics (no client wrapper) ----------------------------
+
+def test_lock_cls_leases_expiry_breaks_and_renewal_races():
+    async def main():
+        cluster, admin = await start_cluster()
+        ioctx = admin.io_ctx(REP_POOL)
+        a = {"name": "L", "owner": "host-a", "cookie": "ca"}
+        b = {"name": "L", "owner": "host-b", "cookie": "cb"}
+
+        # leased exclusive lock: holder carries a ttl
+        rep = await ioctx.exec("obj", "lock", "lock",
+                               dict(a, duration=5.0))
+        assert rep["expiration"] > 0
+        info = await ioctx.exec("obj", "lock", "get_info", {"name": "L"})
+        (h,) = info["holders"]
+        assert not h["expired"] and 0 < h["ttl"] <= 5.0
+
+        # live conflict: EBUSY; live if_expired break: EBUSY
+        with pytest.raises(RadosError, match="EBUSY"):
+            await ioctx.exec("obj", "lock", "lock", dict(b, duration=5.0))
+        with pytest.raises(RadosError, match="EBUSY"):
+            await ioctx.exec("obj", "lock", "break_lock",
+                             {"name": "L", "owner": "host-a",
+                              "if_expired": True})
+
+        # cookie mismatch on unlock -> ENOENT, holder unaffected
+        with pytest.raises(RadosError, match="not the holder"):
+            await ioctx.exec("obj", "lock", "unlock",
+                             {"name": "L", "owner": "host-a",
+                              "cookie": "WRONG"})
+
+        # renewal bumps the lease: +3s of clock, re-lock, ttl back ~5
+        jump_clock(cluster, 3.0)
+        rep = await ioctx.exec("obj", "lock", "lock",
+                               dict(a, duration=5.0))
+        assert rep["renewed"]
+        info = await ioctx.exec("obj", "lock", "get_info", {"name": "L"})
+        assert info["holders"][0]["ttl"] > 4.0
+
+        # renewal RACE: the lease lapses, the holder renews first, a
+        # break_lock(if_expired) that lost the race must fail
+        jump_clock(cluster, 6.0)
+        info = await ioctx.exec("obj", "lock", "get_info", {"name": "L"})
+        assert info["holders"][0]["expired"]
+        assert (await ioctx.exec("obj", "lock", "lock",
+                                 dict(a, duration=5.0)))["renewed"]
+        with pytest.raises(RadosError, match="EBUSY"):
+            await ioctx.exec("obj", "lock", "break_lock",
+                             dict(owner="host-a", name="L",
+                                  if_expired=True))
+
+        # ... and when the holder does NOT renew, the break lands and
+        # the next locker gets in
+        jump_clock(cluster, 6.0)
+        rep = await ioctx.exec("obj", "lock", "break_lock",
+                               {"name": "L", "owner": "host-a",
+                                "if_expired": True})
+        assert rep["broken"] == 1
+        assert (await ioctx.exec("obj", "lock", "lock",
+                                 dict(b, duration=5.0)))["ok"]
+
+        # shared leases on an EC pool (xattr state, no omap)
+        ec = admin.io_ctx(EC_POOL)
+        s1 = {"name": "S", "owner": "host-a", "cookie": "ca",
+              "type": "shared", "duration": 5.0}
+        s2 = {"name": "S", "owner": "host-b", "cookie": "cb",
+              "type": "shared", "duration": 5.0}
+        assert (await ec.exec("eobj", "lock", "lock", s1))["ok"]
+        assert (await ec.exec("eobj", "lock", "lock", s2))["ok"]
+        with pytest.raises(RadosError, match="EBUSY"):
+            await ec.exec("eobj", "lock", "lock",
+                          {"name": "S", "owner": "host-c", "cookie": "cc"})
+        info = await ec.exec("eobj", "lock", "get_info", {"name": "S"})
+        assert len(info["holders"]) == 2
+
+        # an expired shared holder no longer blocks an exclusive taker
+        jump_clock(cluster, 6.0)
+        assert (await ec.exec("eobj", "lock", "lock",
+                              {"name": "S", "owner": "host-c",
+                               "cookie": "cc"}))["ok"]
+        info = await ec.exec("eobj", "lock", "get_info", {"name": "S"})
+        assert [h["owner"] for h in info["holders"]] == ["host-c"]
+
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+# -- client Lock wrapper ------------------------------------------------------
+
+def test_lock_wrapper_watch_wakeup_ordering():
+    """A blocked waiter is woken by the holder's release NOTIFY, not by
+    polling: the poll interval is set far beyond the test timeout, so
+    only the watch/notify path can complete the acquire."""
+
+    async def main():
+        cluster, admin = await start_cluster()
+        cluster.cfg.set("coord_barrier_poll", 60.0)
+        ioctx = admin.io_ctx(REP_POOL)
+        perf = make_coord_perf("t")
+        holder = Lock(ioctx, "wobj", "W", owner="host-a", cookie="a",
+                      lease=0, perf=perf)
+        waiter = Lock(ioctx, "wobj", "W", owner="host-b", cookie="b",
+                      lease=0, perf=perf)
+        await holder.acquire(block=False)
+        assert perf.dump()["locks_held"] == 1
+
+        task = asyncio.ensure_future(waiter.acquire(block=True))
+        # the waiter has seen EBUSY and parked itself on the watch
+        await wait_until(lambda: waiter._watching, timeout=20)
+        assert not task.done()
+        await holder.release()
+        await asyncio.wait_for(task, 10)  # << poll interval: notify won
+        assert waiter.locked
+        info = await waiter.info()
+        assert [h["owner"] for h in info["holders"]] == ["host-b"]
+        await waiter.release()
+        assert perf.dump()["locks_held"] == 0
+
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_lock_wrapper_breaks_dead_holder_and_logs():
+    async def main():
+        cluster, admin = await start_cluster()
+        ioctx = admin.io_ctx(REP_POOL)
+        perf = make_coord_perf("t2")
+        dead = Lock(ioctx, "dobj", "D", owner="host-a", cookie="a",
+                    lease=30.0)
+        await dead.acquire(block=False)
+        dead._stop_renew()  # the process "dies": lease stops renewing
+
+        taker = Lock(ioctx, "dobj", "D", owner="host-b", cookie="b",
+                     lease=30.0, perf=perf)
+        # while the lease is live, a non-blocking acquire still fails
+        with pytest.raises(RadosError, match="EBUSY"):
+            await taker.acquire(block=False)
+        jump_clock(cluster, 31.0)
+        await taker.acquire(block=False)  # break-on-expired + take
+        assert taker.locked
+        assert perf.dump()["lock_breaks"] == 1
+        out = await admin.mon_command("log last", {"n": 20})
+        assert any("lock broken" in ln["message"] for ln in out["lines"])
+        await taker.release()
+
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+# -- fleet: roster, election, barriers, eviction ------------------------------
+
+async def make_fleet(cluster, host):
+    rados = Rados(f"client.{host}", cluster.monmap, config=cluster.cfg)
+    await rados.connect()
+    return rados, Fleet(rados.io_ctx(REP_POOL), "train", host)
+
+
+def test_fleet_join_elect_barrier_status():
+    async def main():
+        cluster, admin = await start_cluster()
+        handles = [await make_fleet(cluster, h) for h in HOSTS]
+        fleets = [f for _, f in handles]
+
+        for f in fleets:
+            await f.join()
+        # every host derives the same coordinates from the same roster
+        assert [await f.rank() for f in fleets] \
+            == [(0, 3), (1, 3), (2, 3)]
+        assert await fleets[0].live_members() == sorted(HOSTS)
+
+        # first through the door leads; the rest lose cleanly
+        assert await fleets[0].elect()
+        assert not await fleets[1].elect()
+        assert [f.is_leader for f in fleets] == [True, False, False]
+        assert await fleets[2].leader() == "host-a"
+
+        # all three meet at two consecutive epoch barriers
+        assert await asyncio.gather(
+            *(f.barrier(timeout=30) for f in fleets)
+        ) == [0, 0, 0]
+        assert await asyncio.gather(
+            *(f.barrier(timeout=30) for f in fleets)
+        ) == [1, 1, 1]
+        d = fleets[1].perf.dump()
+        assert d["barriers"] == 2 and d["barrier_wait"]["avgcount"] == 2
+
+        status = await fleets[0].status()
+        assert status["leader"] == "host-a"
+        assert status["leader_ttl"] > 0
+        assert sorted(status["members"]) == sorted(HOSTS)
+        assert all(m["alive"] and m["lease_age"] >= 0
+                   for m in status["members"].values())
+
+        # leader election shows in the cluster log
+        out = await admin.mon_command("log last", {"n": 20})
+        assert any("leader changed" in ln["message"]
+                   for ln in out["lines"])
+
+        for rados, f in handles:
+            await f.leave()
+            await rados.shutdown()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_fleet_eviction_reelection_after_lease_expiry():
+    async def main():
+        cluster, admin = await start_cluster()
+        handles = [await make_fleet(cluster, h) for h in HOSTS]
+        fa, fb, fc = (f for _, f in handles)
+        events = []
+        fb.on_change(lambda ev, host: events.append((ev, host)))
+        for f in (fa, fb, fc):
+            await f.join()
+        assert await fa.elect()
+
+        # host-a (the LEADER) dies: no leave(), lease just stops
+        await fa.close()
+        jump_clock(cluster, float(cluster.cfg.get("coord_lease")) + 1.0)
+        # survivors' heartbeats renew on re-lock (idempotent acquire)
+        await fb._member_lock.acquire(block=False)
+        await fc._member_lock.acquire(block=False)
+
+        # any survivor's maintenance pass heals the fleet: the vacant
+        # seat is taken (breaking the expired leader lease) and the
+        # dead member is evicted from the roster
+        await fb._maintain()
+        assert fb.is_leader
+        assert await fb.sweep() == []  # idempotent: already evicted
+        assert await fb.live_members() == ["host-b", "host-c"]
+        assert (await fb.rank(), await fc.rank()) == ((0, 2), (1, 2))
+        roster = await fb.members()
+        assert "host-a" not in roster
+
+        out = await admin.mon_command("log last", {"n": 30})
+        assert any("host lease expired" in ln["message"]
+                   for ln in out["lines"])
+        assert ("evict", "host-a") in events  # membership callback fired
+
+        # the shrunken fleet still barriers
+        assert await asyncio.gather(
+            fb.barrier(timeout=30), fc.barrier(timeout=30)
+        ) == [0, 0]
+
+        for rados, f in handles[1:]:
+            await f.leave()
+        for rados, _ in handles:
+            await rados.shutdown()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+# -- driver: exactly-one-committer + sharded restore + cursor rebase ----------
+
+def test_driver_single_committer_failover_and_sharded_restore():
+    async def main():
+        cluster, admin = await start_cluster()
+        handles = [await make_fleet(cluster, h) for h in HOSTS[:2]]
+        (ra, fa), (rb, fb) = handles
+        await fa.join()
+        await fb.join()
+
+        da = FleetDriver(fa, ckpt=CkptStore(ra.io_ctx(REP_POOL), "model"))
+        db = FleetDriver(fb, ckpt=CkptStore(rb.io_ctx(REP_POOL), "model"))
+
+        tree = {"w": np.arange(32, dtype=np.float32).reshape(8, 4),
+                "b": np.ones((4,), dtype=np.float32)}
+        ps = await da.save(tree)  # host-a elects itself and commits
+        assert ps is not None and fa.is_leader
+        # the non-leader's save is a no-op: exactly one committer
+        assert await db.save(tree) is None
+        save1 = (await da.drain())[0]
+
+        # per-rank sharded restore: each host fetches only its rows
+        block_a, idx_a = await da.restore_shard("w")
+        block_b, idx_b = await db.restore_shard("w")
+        assert idx_a[0] == slice(0, 4) and idx_b[0] == slice(4, 8)
+        np.testing.assert_array_equal(
+            np.concatenate([block_a, block_b]), tree["w"]
+        )
+
+        # leader dies mid-save: pending commit cancelled, lease lapses
+        tree2 = {"w": tree["w"] + 1.0, "b": tree["b"] * 2}
+        ps2 = await da.save(tree2)
+        ps2.cancel()  # the in-process kill -9
+        da.committer_lock()._stop_renew()  # ...its lease stops too
+        await fa.close()
+        jump_clock(cluster, float(cluster.cfg.get("coord_lease")) + 1.0)
+        await fb._member_lock.acquire(block=False)
+
+        # HEAD never regressed: the committed save is still restorable
+        head = await db.ckpt.head()
+        assert head["save_id"] == save1
+
+        # the survivor elects, BREAKS the dead committer lease, commits
+        tree3 = {"w": tree["w"] * 3.0, "b": tree["b"] + 5}
+        ps3 = await db.save(tree3)
+        assert ps3 is not None and fb.is_leader
+        save3 = (await db.drain())[0]
+        head = await db.ckpt.head()
+        assert head["save_id"] == save3
+        restored = await db.restore()
+        np.testing.assert_array_equal(restored["w"], tree3["w"])
+        np.testing.assert_array_equal(restored["b"], tree3["b"])
+
+        await fb.leave()
+        for rados, _ in handles:
+            await rados.shutdown()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_driver_data_cursor_rebase_zero_dup_zero_missing():
+    """3 hosts consume a stride-partitioned epoch prefix; the fleet
+    shrinks to 2; resume from the synchronized cursor covers EXACTLY
+    the remaining records — no duplicates, none missing."""
+
+    async def main():
+        cluster, admin = await start_cluster()
+        store = DataStore(admin.io_ctx(REP_POOL), "corpus")
+        records = [f"rec-{i:04d}".encode() for i in range(97)]
+        await store.ingest(records)
+
+        seen = []
+        iters = [
+            await store.iterator(seed=7, batch_size=4, num_hosts=3,
+                                 host=h, partition="stride")
+            for h in range(3)
+        ]
+        for it in iters:  # every host consumes 3 synchronized batches
+            for _ in range(3):
+                seen.extend(await it.__anext__())
+        assert len(seen) == 3 * 3 * 4
+
+        # cursors agree on the global frontier; host 0's is "the" cursor
+        cursor = iters[0].state()
+        assert cursor["partition"] == "stride"
+        assert cursor["position"] == 12
+
+        remaining = []
+        for h in range(2):  # the surviving fleet re-partitions
+            cur = data_layout.rebase_cursor(cursor, num_hosts=2, host=h)
+            assert cur["base"] == 36 and cur["position"] == 0
+            it = await store.resume(cur)
+            async for batch in it:
+                remaining.extend(batch)
+
+        assert sorted(seen + remaining) == sorted(records)
+        assert len(seen + remaining) == len(records)  # zero dups
+
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())

@@ -1,0 +1,161 @@
+"""Multi-host fleet harness, live tier (ISSUE 10 acceptance).
+
+Three REAL worker processes (tools/fleet_tool.py worker) over TCP
+against an in-process cluster: sharded training loop with a barrier
+per step, leader-only checkpoint commits, then SIGKILL the leader
+while its next save is in flight. The survivors' leases detect the
+death, a waiter breaks the expired leader + committer leases, the
+roster shrinks, and training resumes from the committed HEAD with
+ZERO duplicate and ZERO missing data records — the committed cursor
+re-partitions the stream exactly onto the surviving hosts.
+"""
+
+import asyncio
+import json
+import signal
+import sys
+
+import pytest
+
+from ceph_tpu.data.store import DataStore
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import REP_POOL, Cluster
+
+pytestmark = pytest.mark.slow
+
+PRE, MID, BATCH, SEED = 3, 2, 4, 7
+RECORDS = [f"rec-{i:04d}".encode() for i in range(96)]
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 300))
+
+
+async def _spawn_worker(mon_host: str, host_id: str, role: str):
+    return await asyncio.create_subprocess_exec(
+        sys.executable, "tools/fleet_tool.py",
+        "--mon-host", mon_host, "--pool", str(REP_POOL),
+        "--host-id", host_id, "--role", role,
+        "--seed", str(SEED), "--batch", str(BATCH),
+        "--pre-steps", str(PRE), "--mid-steps", str(MID),
+        "--lease", "2.0", "--timeout", "120",
+        "worker", "train",
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.PIPE,
+    )
+
+
+def _events(raw: bytes) -> list[dict]:
+    return [json.loads(ln) for ln in raw.decode().splitlines() if ln]
+
+
+def test_fleet_kill_leader_mid_save_no_acked_loss(tmp_path):
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        admin = Rados("client.fleetadmin", cluster.monmap,
+                      config=cluster.cfg)
+        await admin.connect()
+        await cluster.create_pools(admin)
+        mon_host = ",".join(
+            f"{h}:{p}" for h, p in cluster.monmap.addrs
+        )
+        try:
+            await DataStore(admin.io_ctx(REP_POOL), "corpus").ingest(
+                RECORDS
+            )
+
+            victim = await _spawn_worker(mon_host, "host-a", "victim")
+            survivors = [
+                await _spawn_worker(mon_host, hid, "survivor")
+                for hid in ("host-b", "host-c")
+            ]
+
+            # follow the victim's event stream to its in-flight save,
+            # then SIGKILL it — the real mid-save crash
+            victim_events = []
+            while True:
+                line = await asyncio.wait_for(
+                    victim.stdout.readline(), timeout=120
+                )
+                assert line, "victim exited before mid_save"
+                victim_events.append(json.loads(line))
+                if victim_events[-1]["event"] == "mid_save":
+                    break
+            victim.send_signal(signal.SIGKILL)
+            await victim.wait()
+
+            outs = await asyncio.gather(
+                *(p.communicate() for p in survivors)
+            )
+            for p, (out, err) in zip(survivors, outs):
+                assert p.returncode == 0, err.decode()
+            sb, sc = (_events(out) for out, _ in outs)
+
+            # survivors agree on the committed HEAD they resumed from
+            (rb,) = [e for e in sb if e["event"] == "resumed"]
+            (rc,) = [e for e in sc if e["event"] == "resumed"]
+            assert rb["head"] == rc["head"]
+            assert rb["live"] == ["host-b", "host-c"]
+            (commit,) = [e for e in victim_events
+                         if e["event"] == "commit"]
+
+            # acked = every record covered by the cursor in HEAD: the
+            # drained phase-A save, or — if the in-flight save's
+            # commit beat the SIGKILL — the phase-B save (HEAD can
+            # only move forward, never regress)
+            acked_steps = PRE if rb["head"] == commit["save_id"] \
+                else PRE + MID
+            # the cursor comes back REBASED onto the 2-host fleet:
+            # consumed position folds into the partition base
+            assert rb["position"] == 0
+            assert rb["base"] == acked_steps * BATCH * 3
+            # the restored model is the one the committed save wrote
+            assert rb["w_sum"] == 32.0 * acked_steps
+
+            acked, resumed = [], []
+            for events in (victim_events, sb, sc):
+                for e in events:
+                    if e["event"] == "batch" and e["step"] < acked_steps:
+                        acked.extend(e["ids"])
+            for events in (sb, sc):
+                for e in events:
+                    if e["event"] == "rbatch":
+                        resumed.extend(e["ids"])
+
+            want = sorted(r.decode() for r in RECORDS)
+            assert sorted(acked + resumed) == want  # none missing
+            assert len(acked) + len(resumed) == len(want)  # no dups
+
+            # exactly one survivor committed the post-recovery save
+            finals = [e for ev in (sb, sc) for e in ev
+                      if e["event"] == "final_commit"]
+            assert len(finals) == 1
+            assert all(e[-1]["event"] == "done" for e in (sb, sc))
+
+            # the death left its audit trail in the mon cluster log
+            out = await admin.mon_command("log last", {"n": 100})
+            lines = [ln["message"] for ln in out["lines"]]
+            assert any("host lease expired" in ln and "host-a" in ln
+                       for ln in lines)
+            assert any("leader changed" in ln for ln in lines)
+            assert any("lock broken" in ln for ln in lines)
+
+            # the operator's view over real TCP: everyone left cleanly
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "tools/fleet_tool.py",
+                "--mon-host", mon_host, "--pool", str(REP_POOL),
+                "status", "train",
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+            )
+            out, err = await proc.communicate()
+            assert proc.returncode == 0, err.decode()
+            status = json.loads(out.decode())
+            assert status["leader"] is None
+            assert status["members"] == {}
+        finally:
+            await admin.shutdown()
+            await cluster.stop()
+
+    run(main())

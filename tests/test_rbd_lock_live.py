@@ -148,3 +148,57 @@ def test_exclusive_open_break_lock_fences_dead_holder():
         await cluster.stop()
 
     run(main())
+
+
+def test_exclusive_open_second_writer_ebusy_and_force_break():
+    """Open-for-write adoption: a second exclusive open fails with
+    EBUSY immediately (no retry window), the holder's cookie is its
+    client id, and `force=True` runs the break-lock path — blocklist
+    the old holder, take the lock, line in the mon cluster log."""
+
+    async def main():
+        cluster, admin = await start_cluster()
+        ra = Rados("client.a", cluster.monmap, config=cluster.cfg)
+        rb = Rados("client.b", cluster.monmap, config=cluster.cfg)
+        await ra.connect()
+        await rb.connect()
+
+        await Image.create(admin.io_ctx(REP_POOL), "vol2", 1 << 22,
+                           order=20)
+        a = await Image.open(ra.io_ctx(REP_POOL), "vol2", exclusive=True)
+        await a.write(0, b"A" * 4096)
+
+        with pytest.raises(RadosError, match="EBUSY"):
+            await Image.open(rb.io_ctx(REP_POOL), "vol2", exclusive=True)
+
+        holders = await a.lock_holders()
+        assert [h["cookie"] for h in holders] == ["client.a"]
+
+        b = await Image.open(rb.io_ctx(REP_POOL), "vol2",
+                             exclusive=True, force=True)
+        assert [h["cookie"] for h in await b.lock_holders()] \
+            == ["client.b"]
+
+        epoch = admin.objecter.osdmap.epoch
+        await wait_until(
+            lambda: all(
+                o.osdmap.epoch >= epoch for o in cluster.osds.values()
+            ),
+            timeout=30,
+        )
+        # the forced-out holder is fenced: its delayed write dies
+        with pytest.raises(RadosError, match="EBLOCKLISTED"):
+            await a.write(0, b"stale" * 16)
+        await b.write(0, b"B" * 4096)
+        assert await b.read(0, 4) == b"BBBB"
+
+        out = await admin.mon_command("log last", {"n": 50})
+        assert any("lock broken" in ln["message"]
+                   for ln in out["lines"])
+
+        await ra.shutdown()
+        await rb.shutdown()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())

@@ -1,0 +1,311 @@
+"""Fleet operator CLI + the multi-host training-harness worker.
+
+    python tools/fleet_tool.py --mon-host 127.0.0.1:6789 --pool 1 <cmd>
+
+Commands:
+
+    status <fleet>          roster, per-member lease liveness, leader
+                            and its remaining lease — one JSON blob
+    worker <fleet>          one training host: join, barrier-per-step
+                            data consumption, leader-only checkpoint
+                            commits. Emits one JSON line per event
+                            (joined/batch/commit/mid_save/resumed/
+                            rbatch/final_commit/done) so a harness can
+                            reconstruct exactly which records were
+                            acked by which committed save. --role
+                            victim elects itself leader and parks
+                            mid-save for the harness to SIGKILL;
+                            survivors self-heal (barrier eviction),
+                            restore the committed HEAD, and resume the
+                            data stream with zero dup/missing records.
+    bench [--hosts N]       in-process fleet bench: barrier round-trip
+          [--rounds K]      latency percentiles across N hosts, and
+          [--mb M]          per-rank sharded restore aggregate GB/s vs
+                            one host restoring the whole tree
+
+Output is JSON per command (worker: JSON lines), like tools/ceph.py."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _emit(**fields) -> None:
+    print(json.dumps(fields, sort_keys=True), flush=True)
+
+
+async def _connect(args):
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.mon import MonMap
+    from ceph_tpu.rados.client import Rados
+
+    addrs = []
+    for hostport in args.mon_host.split(","):
+        host, _, port = hostport.rpartition(":")
+        addrs.append((host or "127.0.0.1", int(port)))
+    cfg = Config()
+    if args.lease is not None:
+        cfg.set("coord_lease", args.lease)
+        cfg.set("coord_barrier_poll", min(0.2, args.lease / 4))
+    rados = Rados(args.name_id, MonMap(addrs=addrs), config=cfg)
+    await rados.connect()
+    return rados
+
+
+def _tree(step: int):
+    """The deterministic 'model': weights are a pure function of the
+    step so a harness can recompute what any committed save must hold."""
+    import numpy as np
+
+    return {
+        "w": np.full((8, 4), float(step), dtype=np.float32),
+        "b": np.arange(4, dtype=np.float32) + float(step),
+    }
+
+
+async def _status(args) -> int:
+    from ceph_tpu.coord import Fleet
+
+    rados = await _connect(args)
+    try:
+        fleet = Fleet(rados.io_ctx(args.pool), args.fleet_name,
+                      args.host_id or "status-probe")
+        print(json.dumps(await fleet.status(), indent=2, sort_keys=True))
+        return 0
+    finally:
+        await rados.shutdown()
+
+
+async def _worker(args) -> int:
+    from ceph_tpu.ckpt.store import CkptStore
+    from ceph_tpu.coord import Fleet, FleetDriver
+    from ceph_tpu.data.store import DataStore
+
+    rados = await _connect(args)
+    io = rados.io_ctx(args.pool)
+    fleet = Fleet(io, args.fleet_name, args.host_id)
+    driver = FleetDriver(
+        fleet,
+        ckpt=CkptStore(io, args.ckpt_name),
+        data=DataStore(io, args.data_name),
+    )
+    victim = args.role == "victim"
+    try:
+        rank, hosts = await fleet.join()
+        _emit(event="joined", host=args.host_id, rank=rank, hosts=hosts)
+        if victim:
+            # the victim is the designated first leader, so the
+            # harness knows exactly whose death it is injecting
+            _emit(event="elected", host=args.host_id,
+                  leader=await fleet.elect())
+        await fleet.barrier(timeout=args.timeout)  # registration
+
+        it = await driver.data_iterator(seed=args.seed,
+                                        batch_size=args.batch)
+        step = 0
+
+        async def consume(tag: str) -> None:
+            nonlocal step
+            batch = await it.__anext__()
+            _emit(event=tag, host=args.host_id, step=step,
+                  ids=[r.decode() for r in batch])
+            step += 1
+
+        # phase A: synchronized steps, then a COMMITTED save
+        for _ in range(args.pre_steps):
+            await consume("batch")
+            await fleet.barrier(timeout=args.timeout)
+        ps = await driver.save(_tree(step), iterator=it)
+        if victim:
+            assert ps is not None, "victim must be the committer"
+            (sid,) = await driver.drain()
+            _emit(event="commit", host=args.host_id, save_id=sid,
+                  step=step)
+        else:
+            assert ps is None, "exactly one committer"
+        await fleet.barrier(timeout=args.timeout)  # commit visible
+
+        # phase B: more synchronized steps, NOT yet committed
+        for _ in range(args.mid_steps):
+            await consume("batch")
+            await fleet.barrier(timeout=args.timeout)
+
+        if victim:
+            # submit (don't drain) and park: the save is in flight
+            # when the harness SIGKILLs us — the lease lapses, the
+            # commit either lands (valid newer save) or dies with it
+            await driver.save(_tree(step), iterator=it)
+            _emit(event="mid_save", host=args.host_id, step=step)
+            while True:
+                await asyncio.sleep(0.25)
+
+        # survivors: the barrier self-heals — a waiter elects once the
+        # dead leader's lease lapses, sweeps the roster, and the live
+        # set shrinks to us
+        await fleet.barrier(timeout=args.timeout)
+        head = await driver.ckpt.head()
+        cursor = await driver.restore_cursor()
+        tree = await driver.restore()
+        _emit(event="resumed", host=args.host_id,
+              head=head["save_id"], position=cursor["position"],
+              base=cursor["base"], w_sum=float(tree["w"].sum()),
+              live=await fleet.live_members())
+
+        it2 = await driver.resume_iterator(cursor)
+        async for batch in it2:
+            _emit(event="rbatch", host=args.host_id,
+                  ids=[r.decode() for r in batch])
+        await fleet.barrier(timeout=args.timeout)
+
+        ps = await driver.save(_tree(args.pre_steps + args.mid_steps))
+        if ps is not None:
+            (sid,) = await driver.drain()
+            _emit(event="final_commit", host=args.host_id, save_id=sid)
+        await fleet.barrier(timeout=args.timeout)
+        await fleet.leave()
+        _emit(event="done", host=args.host_id)
+        return 0
+    finally:
+        await rados.shutdown()
+
+
+async def _bench(args) -> dict:
+    """Barrier latency + sharded-restore scaling against an in-process
+    cluster (no external daemons), the `bench.py --fleet` engine."""
+    import numpy as np
+
+    from tests.test_cluster_live import REP_POOL, Cluster
+    from ceph_tpu.ckpt.store import CkptStore
+    from ceph_tpu.coord import Fleet, FleetDriver
+    from ceph_tpu.rados.client import Rados
+
+    cluster = Cluster()
+    await cluster.start()
+    admin = Rados("client.fleetbench", cluster.monmap,
+                  config=cluster.cfg)
+    await admin.connect()
+    await cluster.create_pools(admin)
+    handles = []
+    try:
+        for i in range(args.hosts):
+            r = Rados(f"client.fb{i}", cluster.monmap,
+                      config=cluster.cfg)
+            await r.connect()
+            f = Fleet(r.io_ctx(REP_POOL), "bench", f"host-{i:02d}")
+            await f.join()
+            handles.append((r, f))
+
+        # barrier round-trips: all hosts arrive together, K rounds
+        waits = []
+        for _ in range(args.rounds):
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(f.barrier(timeout=60) for _, f in handles)
+            )
+            waits.append(time.perf_counter() - t0)
+        waits.sort()
+
+        # one committed save, then per-rank sharded restore vs whole
+        rng = np.random.default_rng(0)
+        rows = args.hosts * max(1, (args.mb << 20) // args.hosts // 4096)
+        tree = {"w": rng.integers(0, 256, (rows, 4096), np.uint8)}
+        drivers = [
+            FleetDriver(f, ckpt=CkptStore(r.io_ctx(REP_POOL), "bench"))
+            for r, f in handles
+        ]
+        await drivers[0].save(tree)
+        await drivers[0].drain()
+
+        t0 = time.perf_counter()
+        whole = await drivers[0].restore()
+        t_whole = time.perf_counter() - t0
+        assert np.array_equal(whole["w"], tree["w"])
+
+        t0 = time.perf_counter()
+        shards = await asyncio.gather(
+            *(d.restore_shard("w") for d in drivers)
+        )
+        t_shard = time.perf_counter() - t0
+        assert np.array_equal(
+            np.concatenate([s[0] for s in shards]), tree["w"]
+        )
+        total = tree["w"].nbytes
+        return {
+            "bench": "fleet",
+            "hosts": args.hosts,
+            "rounds": args.rounds,
+            "barrier_p50_ms": round(waits[len(waits) // 2] * 1e3, 3),
+            "barrier_p99_ms": round(
+                waits[min(len(waits) - 1,
+                          int(len(waits) * 0.99))] * 1e3, 3),
+            "bytes": total,
+            "restore_whole_gbps": round(total / t_whole / 1e9, 4),
+            "restore_sharded_gbps": round(total / t_shard / 1e9, 4),
+            "sharded_speedup": round(t_whole / max(t_shard, 1e-9), 2),
+        }
+    finally:
+        for r, f in handles:
+            try:
+                await f.leave()
+            except Exception:  # noqa: BLE001
+                pass
+            await r.shutdown()
+        await admin.shutdown()
+        await cluster.stop()
+
+
+async def _amain(args) -> int:
+    if args.command == "status":
+        return await _status(args)
+    if args.command == "worker":
+        return await _worker(args)
+    if args.command == "bench":
+        print(json.dumps(await _bench(args), sort_keys=True))
+        return 0
+    raise SystemExit(f"unknown command {args.command!r}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleet_tool")
+    ap.add_argument("--mon-host", default="127.0.0.1:6789")
+    ap.add_argument("--pool", type=int, default=1)
+    ap.add_argument("--name", dest="name_id", default="client.fleet")
+    ap.add_argument("--host-id", default="")
+    ap.add_argument("--role", choices=("victim", "survivor"),
+                    default="survivor")
+    ap.add_argument("--ckpt-name", default="model")
+    ap.add_argument("--data-name", default="corpus")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--pre-steps", type=int, default=3)
+    ap.add_argument("--mid-steps", type=int, default=2)
+    ap.add_argument("--lease", type=float, default=None,
+                    help="coord_lease override (short for harnesses)")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="per-barrier timeout for the worker")
+    ap.add_argument("--hosts", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--mb", type=int, default=16)
+    ap.add_argument("command", choices=("status", "worker", "bench"))
+    ap.add_argument("fleet_name", nargs="?", default="train")
+    args = ap.parse_args(argv)
+    if args.command == "worker" and not args.host_id:
+        ap.error("worker requires --host-id")
+    if args.command == "worker" and args.name_id == "client.fleet":
+        # each worker process needs its own RADOS identity (fencing,
+        # watch registrations) — derive it from the host id
+        args.name_id = f"client.{args.host_id}"
+    return asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
