@@ -1,0 +1,43 @@
+"""cephlint — project-invariant static analysis for the ceph_tpu tree.
+
+The reference ships correctness tooling next to the code (clang-tidy
+wiring, denc round-trip checks in src/test/, the kernel-compat rules on
+the CRUSH core); cephlint plays that role here.  PRs 1-10 accreted a set
+of unwritten invariants — sleep-free tier-1 tests, `MethodContext.now`
+instead of wall clocks inside cls methods, declared-knob-only config
+reads, perf counters declared before incremented, no blocking IO on the
+OSD event loop, every `asyncio.create_task` tracked — and cephlint turns
+each into an AST check that fails the build instead of a review comment.
+
+Layout:
+
+  * `core`      — Finding/check registry, `# cephlint: disable=` comment
+                  suppressions, fingerprinted baseline file, runner;
+  * `checks`    — the project checks (async-blocking, task-leak,
+                  clock-discipline, knob-registry, perf-counter,
+                  error-taxonomy);
+  * `cli`       — `python -m ceph_tpu.lint` / `tools/lint.py` front end
+                  (non-zero exit on new findings, `--baseline-update`,
+                  `--json` summary counts);
+  * `racecheck` — the RUNTIME half: opt-in (`CEPH_TPU_RACECHECK=1`)
+                  asyncio instrumentation that detects lock-order
+                  inversions, tasks garbage-collected while pending, and
+                  locks held across network IO awaits.
+"""
+
+from ceph_tpu.lint.core import (  # noqa: F401
+    Finding,
+    LintReport,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from ceph_tpu.lint import checks  # noqa: F401  (registers the checks)
+
+__all__ = [
+    "Finding",
+    "LintReport",
+    "load_baseline",
+    "run_lint",
+    "write_baseline",
+]

@@ -1,0 +1,7 @@
+"""`python -m ceph_tpu.lint` — see ceph_tpu.lint.cli."""
+
+import sys
+
+from ceph_tpu.lint.cli import main
+
+sys.exit(main())

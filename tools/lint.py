@@ -1,0 +1,23 @@
+#!/usr/bin/env python3
+"""tools/lint.py — cephlint entry point (see ceph_tpu/lint/).
+
+    python tools/lint.py                      # lint ceph_tpu + tests
+    python tools/lint.py ceph_tpu tests       # explicit paths
+    python tools/lint.py --json               # summary counters as JSON
+    python tools/lint.py --baseline-update    # regrandfather findings
+
+Exits non-zero on NEW findings (not comment-suppressed, not in
+tools/lint_baseline.json).  Suppress in place with
+`# cephlint: disable=<check>`; the runtime race detector rides along as
+CEPH_TPU_RACECHECK=1 (see ceph_tpu/lint/racecheck.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
