@@ -24,6 +24,7 @@ import asyncio
 import json
 import time
 
+from ceph_tpu.lint import racecheck
 from ceph_tpu.rados.client import ObjectNotFound, RadosError
 
 
@@ -120,6 +121,9 @@ class Lock:
                     busy = e
                 else:
                     self.locked = True
+                    if racecheck.active():
+                        racecheck.note_acquire(self._rc_class(),
+                                               blocking=block)
                     for dead in rep.get("pruned", ()):
                         # the cls dropped a lapsed holder to let us in:
                         # that is a break in all but the syscall
@@ -158,6 +162,8 @@ class Lock:
         if not self.locked:
             return
         self.locked = False
+        if racecheck.active():
+            racecheck.note_release(self._rc_class())
         if self.perf is not None:
             self.perf.dec("locks_held")
         try:
@@ -211,13 +217,21 @@ class Lock:
                     return
                 # transient (retarget/timeout): the lease outlives a
                 # couple of missed renewals by construction
+            # cephlint: disable=error-taxonomy (transient renewal failure: the lease survives missed renewals)
             except Exception:  # noqa: BLE001
                 pass
+
+    def _rc_class(self) -> str:
+        # distributed locks order by identity (obj/name), not creation
+        # site: every host constructs its own instance of the same lock
+        return f"coord.Lock:{self.obj}/{self.name}"
 
     def _lost(self) -> None:
         if not self.locked:
             return
         self.locked = False
+        if racecheck.active():
+            racecheck.note_release(self._rc_class())
         self._stop_renew()
         if self.perf is not None:
             self.perf.dec("locks_held")
@@ -279,12 +293,14 @@ class Lock:
                 self.obj, json.dumps(dict(fields, lock=self.name)),
                 timeout=1.0,
             )
+        # cephlint: disable=error-taxonomy (wakeups are best-effort; pollers converge anyway)
         except Exception:  # noqa: BLE001
             pass  # wakeups are best-effort; pollers converge anyway
 
     def _clog(self, level: str, message: str) -> None:
         try:
             self.ioctx.objecter.mon.cluster_log(level, message)
+        # cephlint: disable=error-taxonomy (the log path itself must never throw)
         except Exception:  # noqa: BLE001
             pass
 

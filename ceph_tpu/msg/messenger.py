@@ -43,6 +43,7 @@ import socket as socket_mod
 from dataclasses import dataclass, field
 
 from ceph_tpu.common.encoding import Decoder, Encoder, encode_payload
+from ceph_tpu.lint import racecheck
 from ceph_tpu.msg.frames import (
     BANNER,
     FEATURE_BIN_ENVELOPE,
@@ -173,6 +174,11 @@ class _InjectingStream:
         delay = m._inject_delay
         if delay:
             await asyncio.sleep(delay * m._rng.random())
+        prob = m._inject_delay_prob
+        if prob and m._rng.random() < prob:
+            # the reference's ms_inject_delay_probability/_max pair:
+            # each frame independently risks a bounded random stall
+            await asyncio.sleep(m._inject_delay_max * m._rng.random())
         every = m._inject_every
         if every and m._rng.randrange(every) == 0:
             m.injected_failures += 1
@@ -204,6 +210,7 @@ class _InjectingStream:
             perf.inc("corked_msgs", coalesced)
             perf.inc("bytes_coalesced", len(data))
         self.writer.write(data)
+        racecheck.note_io("msg.send")
         await self.writer.drain()
 
     async def recv(self, session_key: bytes | None) -> Frame:
@@ -357,6 +364,7 @@ class Connection:
                                 pass
             except asyncio.CancelledError:
                 raise
+            # cephlint: disable=error-taxonomy (teardown race: the reconnect loop owns recovery)
             except Exception:
                 pass
             self._ready.clear()
@@ -559,6 +567,7 @@ class Connection:
 
                 # one ratio policy for wire AND store paths
                 did, packed = factory(algo).maybe_compress(payload)
+            # cephlint: disable=error-taxonomy (unknown/unavailable codec: ship the payload raw)
             except Exception:
                 did = False  # unknown/unavailable codec: ship raw
             if did:
@@ -767,6 +776,12 @@ class Messenger:
         self._inject_delay = float(
             self.config.get("ms_inject_internal_delays") or 0
         )
+        self._inject_delay_prob = float(
+            self.config.get("ms_inject_delay_probability") or 0
+        )
+        self._inject_delay_max = float(
+            self.config.get("ms_inject_delay_max") or 0
+        )
         self._inject_every = int(
             self.config.get("ms_inject_socket_failures") or 0
         )
@@ -775,6 +790,9 @@ class Messenger:
         self.config.observe("ms_compress_mode", self._note_knobs)
         self.config.observe("ms_compress_min_size", self._note_knobs)
         self.config.observe("ms_inject_internal_delays", self._note_knobs)
+        self.config.observe("ms_inject_delay_probability",
+                            self._note_knobs)
+        self.config.observe("ms_inject_delay_max", self._note_knobs)
         self.config.observe("ms_inject_socket_failures", self._note_knobs)
         #: cephx client state: service ("osd"/"mds") -> (ticket blob,
         #: session key) obtained from the mon's auth service; when a
@@ -802,6 +820,12 @@ class Messenger:
         )
         self._inject_delay = float(
             self.config.get("ms_inject_internal_delays") or 0
+        )
+        self._inject_delay_prob = float(
+            self.config.get("ms_inject_delay_probability") or 0
+        )
+        self._inject_delay_max = float(
+            self.config.get("ms_inject_delay_max") or 0
         )
         self._inject_every = int(
             self.config.get("ms_inject_socket_failures") or 0
@@ -934,6 +958,7 @@ class Messenger:
                     pass
         except asyncio.CancelledError:
             raise
+        # cephlint: disable=error-taxonomy (server-side close: the client's reconnect loop recovers)
         except Exception:
             pass
         finally:
@@ -1001,6 +1026,7 @@ class Messenger:
             # window NOW instead of bouncing clients until the timer
             try:
                 await self.on_service_keys_stale()
+            # cephlint: disable=error-taxonomy (stale-key refresh is advisory; open_ticket below decides)
             except Exception:
                 pass
             got = open_ticket(self.service_keys, blob, _time.time())
