@@ -713,6 +713,7 @@ class Monitor(Dispatcher):
             await handler(conn, p)
         except asyncio.CancelledError:
             raise
+        # cephlint: disable=error-taxonomy (handler failures must not tear down the transport read loop)
         except Exception:
             # a handler failure (e.g. an aborted proposal) must not tear
             # down the transport read loop it runs in
@@ -723,6 +724,7 @@ class Monitor(Dispatcher):
             await handler(conn, p)
         except asyncio.CancelledError:
             raise
+        # cephlint: disable=error-taxonomy (reporters retry; commands replied their error already)
         except Exception:
             pass  # reporters retry; commands replied their error already
 
@@ -1005,6 +1007,7 @@ class Monitor(Dispatcher):
                 await handler(None, p)
             except asyncio.CancelledError:
                 raise
+            # cephlint: disable=error-taxonomy (proposal churn: the reporter re-reports)
             except Exception:
                 pass  # proposal churn: the reporter re-reports
 
@@ -1258,6 +1261,15 @@ class Monitor(Dispatcher):
             from ceph_tpu.ec.registry import factory
 
             plugin = profile.get("plugin", "tpu")
+            # the allowlist gates what PROFILES may name, not what the
+            # registry holds: in-process callers can still factory() any
+            # registered codec (OSDMonitor's osd_erasure_code_plugins check)
+            allowed = self.config.get("osd_erasure_code_plugins").split()
+            if plugin not in allowed:
+                raise ValueError(
+                    f"erasure-code plugin {plugin!r} not allowed by"
+                    f" osd_erasure_code_plugins ({' '.join(allowed)})"
+                )
             factory(plugin, {k: v for k, v in profile.items()
                              if k != "plugin"})
             await self._propose_osdmap(
@@ -1924,8 +1936,22 @@ class Monitor(Dispatcher):
                 return {"pool_id": pool_id, "existed": True}
             raise ValueError(f"pool {pool_id} exists")
         profile_name = args.get("erasure_code_profile", "")
+        new_profiles: dict | None = None
         if profile_name:
             profile = self.osdmap.erasure_code_profiles.get(profile_name)
+            if profile is None and profile_name == "default":
+                # the reference materializes the "default" profile on
+                # first use from osd_pool_default_erasure_code_profile
+                # (OSDMonitor::parse_erasure_code_profile); it is stored
+                # in the same incremental that creates the pool
+                profile = dict(
+                    kv.split("=", 1)
+                    for kv in self.config.get(
+                        "osd_pool_default_erasure_code_profile"
+                    ).split()
+                    if "=" in kv
+                )
+                new_profiles = {profile_name: profile}
             if profile is None:
                 raise ValueError(
                     f"no erasure-code profile {profile_name!r}"
@@ -1968,6 +1994,7 @@ class Monitor(Dispatcher):
             )
         await self._propose_osdmap(
             Incremental(epoch=self.osdmap.epoch + 1,
-                        new_pools={pool_id: pool})
+                        new_pools={pool_id: pool},
+                        new_erasure_code_profiles=new_profiles or {})
         )
         return {"pool_id": pool_id}
