@@ -165,6 +165,7 @@ class MDSService:
             await asyncio.sleep(interval)
             try:
                 await self._beacon()
+            # cephlint: disable=error-taxonomy (mon churn: next beacon retries)
             except Exception:
                 pass  # mon churn: next beacon retries
 
@@ -182,6 +183,7 @@ class MDSService:
             event = ev["event"]
             try:
                 await self._apply(event)
+            # cephlint: disable=error-taxonomy (idempotent re-apply: conflicts mean already-done)
             except Exception:
                 pass  # idempotent re-apply: conflicts mean "already done"
             if event.get("client") is not None:
@@ -205,6 +207,7 @@ class MDSService:
         if self._applied_pos % 32 == 0:
             try:
                 await self.journaler.commit_and_trim(self._applied_pos)
+            # cephlint: disable=error-taxonomy (lazy trim is best-effort: the next 32-multiple retries)
             except Exception:
                 pass
 
@@ -216,6 +219,7 @@ class MDSService:
             # out inos that live allocations already took
             try:
                 cur = int((await self.ioctx.read("fs.inotable")).decode())
+            # cephlint: disable=error-taxonomy (missing/unreadable inotable: start numbering from 0)
             except Exception:
                 cur = 0
             await self.ioctx.write_full(
@@ -567,6 +571,7 @@ class MDSService:
                      self.config.get("mds_blocklist_expire")
                  )},
             )
+        # cephlint: disable=error-taxonomy (mon unreachable: drop the session either way; next grant retries)
         except Exception:
             # mon unreachable: still drop the session (we cannot grant
             # safely either way; the next grant retries the blocklist)

@@ -93,6 +93,7 @@ class AsyncSaver:
             except asyncio.CancelledError:
                 if not oldest._task.cancelled():
                     raise  # the submitter itself was cancelled
+            # cephlint: disable=error-taxonomy (surfaced via that handle's own wait()/error)
             except Exception:  # noqa: BLE001
                 pass  # surfaced via that handle's own wait()/error
             self._reap()

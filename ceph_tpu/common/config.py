@@ -88,6 +88,9 @@ SCHEMA: dict[str, Option] = {
     o.name: o
     for o in [
         # erasure code (options.cc:533, 2519)
+        # declared-but-dead on purpose: the reference dlopen()s plugins
+        # from this dir; ours are python imports
+        # cephlint: disable=knob-registry
         _opt("erasure_code_dir", TYPE_STR, LEVEL_ADVANCED, "",
              "unused placeholder: plugins are python entry points here"),
         _opt("osd_erasure_code_plugins", TYPE_STR, LEVEL_ADVANCED,
@@ -98,8 +101,9 @@ SCHEMA: dict[str, Option] = {
              "plugin=tpu technique=isa_cauchy k=8 m=3",
              "default EC profile for new pools"),
         # placement / mapping
-        _opt("crush_chunk_size", TYPE_UINT, LEVEL_DEV, 65536,
-             "x batch per device launch in the vectorized mapper"),
+        _opt("crush_chunk_size", TYPE_UINT, LEVEL_DEV, 0,
+             "x-batch cap (pow2) per device launch in the vectorized "
+             "mapper; 0 = backend default (2^18 on TPU, 2^16 on CPU)"),
         # fault injection (options.cc:1044-1066, 822)
         _opt("ms_compress_mode", TYPE_STR, LEVEL_ADVANCED, "none",
              "on-wire frame compression codec (none|zlib|snappy-like "

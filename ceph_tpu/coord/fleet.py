@@ -291,6 +291,7 @@ class Fleet:
                                      "host": self.host_id}),
                     timeout=1.0,
                 )
+            # cephlint: disable=error-taxonomy (barrier wakeups are best-effort; pollers converge anyway)
             except Exception:  # noqa: BLE001
                 pass
             poll = float(self.config.get("coord_barrier_poll"))
@@ -425,6 +426,7 @@ class Fleet:
                             "host": self.host_id}),
                 timeout=1.0,
             )
+        # cephlint: disable=error-taxonomy (roster notify is best-effort; watchers also poll)
         except Exception:  # noqa: BLE001
             pass
 
@@ -440,8 +442,12 @@ class Fleet:
         for cb in self._callbacks:
             try:
                 cb(event, host)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                # a broken subscriber must not block the others, but its
+                # failure should land in the cluster log, not vanish
+                self._clog("ERR",
+                           f"fleet {self.name}: callback failed for "
+                           f"{event!r}: {e!r}")
 
     async def _unwatch(self) -> None:
         if not self._watching:
@@ -457,5 +463,6 @@ class Fleet:
     def _clog(self, level: str, message: str) -> None:
         try:
             self.ioctx.objecter.mon.cluster_log(level, message)
+        # cephlint: disable=error-taxonomy (the log path itself must never throw)
         except Exception:  # noqa: BLE001
             pass

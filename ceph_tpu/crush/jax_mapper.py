@@ -92,8 +92,13 @@ def _pick_chunk(n: int) -> int:
     chunks are padded to the chunk size, so small batches (tests, one-off
     lookups) must not pay the full-launch padding. The CPU backend (oracle
     tests) caps at 2^16: the big-launch win is TPU HBM/launch economics, and
-    the same shapes just slow the host down."""
-    cap = DEFAULT_CHUNK if jax.default_backend() == "tpu" else 1 << 16
+    the same shapes just slow the host down. `crush_chunk_size` (pow2)
+    overrides the cap on either backend; 0 keeps the per-backend default."""
+    from ceph_tpu.common.config import config
+
+    cap = int(config.get("crush_chunk_size"))
+    if cap <= 0:
+        cap = DEFAULT_CHUNK if jax.default_backend() == "tpu" else 1 << 16
     c = 1 << 12
     while c < n and c < cap:
         c <<= 1

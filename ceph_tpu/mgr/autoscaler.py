@@ -31,6 +31,7 @@ class PgAutoscaler:
                 stats = await self.objecter.osd_admin(
                     osd, "pool_stats", timeout=10.0
                 )
+            # cephlint: disable=error-taxonomy (OSD restarting or pool gone mid-scan: next tick re-polls)
             except Exception:
                 continue
             for pid_s, st in stats.items():

@@ -69,6 +69,7 @@ class MgrService:
                 )
                 if self.active and not was:
                     self._activate()
+            # cephlint: disable=error-taxonomy (mon churn: next beacon retries)
             except Exception:
                 pass  # mon churn: next beacon retries
             await asyncio.sleep(interval)

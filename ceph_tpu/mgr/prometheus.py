@@ -95,6 +95,7 @@ class PrometheusExporter:
             health = await self.objecter.mon.command(
                 "health", timeout=10.0
             )
+        # cephlint: disable=error-taxonomy (mon unreachable: scrape renders without the health section)
         except Exception:
             health = None
         if health is not None:
@@ -128,6 +129,7 @@ class PrometheusExporter:
                 dump = await self.objecter.osd_admin(
                     osd, "perf dump", timeout=10.0
                 )
+            # cephlint: disable=error-taxonomy (daemon restarting: skip its counters this scrape)
             except Exception:
                 continue
             for logger, counters in sorted(dump.items()):

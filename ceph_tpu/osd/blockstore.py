@@ -506,6 +506,7 @@ class BlockStore(KStore):
             if cb is not None:
                 try:
                     cb(reason)
+                # cephlint: disable=error-taxonomy (fencing must proceed even if a death-callback misbehaves)
                 except Exception:  # noqa: BLE001 - fencing must proceed
                     pass
         raise StoreFatalError("EIO", f"store fenced: {reason}")
@@ -1194,6 +1195,7 @@ class BlockStore(KStore):
             for _k, raw in list(self.db.iterate(_ONODE)):
                 try:
                     on = Onode.decode(raw)
+                # cephlint: disable=error-taxonomy (undecodable onode is fsck's department, not stats')
                 except Exception:  # fsck's department, not stats'
                     continue
                 if on.flags & FLAG_COMPRESSED:

@@ -509,6 +509,8 @@ class OSDService(Dispatcher):
             lambda _n, v: setattr(self, "_subop_batch", bool(v)),
         )
         self._hb_last: dict[int, float] = {}
+        #: heartbeat_inject_failure window end (None = disarmed)
+        self._hb_inject_until: float | None = None
         #: highest up_thru epoch already requested from the mon (the
         #: OSD::up_thru_wanted role; avoids a request per peering pass)
         self._up_thru_requested = 0
@@ -552,6 +554,7 @@ class OSDService(Dispatcher):
         queue_kind = self.config.get("osd_op_queue")
         try:
             data_weight = float(self.config.get("osd_mclock_data_weight"))
+        # cephlint: disable=error-taxonomy (config races boot: fall back to the shipped default weight)
         except Exception:
             data_weight = 0.25
 
@@ -784,6 +787,7 @@ class OSDService(Dispatcher):
         must never take the data path down with them."""
         try:
             self.mon.cluster_log(level, message)
+        # cephlint: disable=error-taxonomy (the dout line already landed; cluster log is best-effort)
         except Exception:  # noqa: BLE001 - the dout line already landed
             pass
 
@@ -819,6 +823,7 @@ class OSDService(Dispatcher):
         )
         try:
             self.mon.report_failure(self.id)
+        # cephlint: disable=error-taxonomy (one-way death report: peers will report us anyway)
         except Exception:  # noqa: BLE001 - peers will report us anyway
             pass
         # give the one-way report a beat on the wire before the
@@ -981,6 +986,7 @@ class OSDService(Dispatcher):
             )
             self.perf.inc("subop_batch_tx")
             self.perf.inc("subop_batch_tx_ops", len(pend))
+        # cephlint: disable=error-taxonomy (waiters time out; _sub_op_persist re-targets/retries)
         except Exception:
             pass  # waiters time out; _sub_op_persist re-targets/retries
 
@@ -1090,6 +1096,7 @@ class OSDService(Dispatcher):
             return
         try:
             conn.send_message(fut.result())
+        # cephlint: disable=error-taxonomy (the sender's retry loop owns recovery)
         except Exception:
             pass  # the sender's retry loop owns recovery
 
@@ -1117,7 +1124,8 @@ class OSDService(Dispatcher):
                     from_epoch=self.osdmap.epoch if self.osdmap else 0
                 )
             except Exception:
-                pass
+                if (d := self.dlog.dout(20)) is not None:
+                    d("renew_subs failed; next tick retries")
 
     async def _heartbeat_loop(self) -> None:
         """Periodic concurrent pings + a separate deadline scan (the
@@ -1175,6 +1183,17 @@ class OSDService(Dispatcher):
             await asyncio.sleep(interval)
 
     async def _h_osd_ping(self, conn, p) -> None:
+        inject = int(self.config.get("heartbeat_inject_failure"))
+        if inject:
+            # heartbeat_inject_failure=N: drop incoming pings for N
+            # seconds (options.cc:822) — peers see silence and report us
+            loop = asyncio.get_event_loop()
+            if self._hb_inject_until is None:
+                self._hb_inject_until = loop.time() + inject
+            if loop.time() < self._hb_inject_until:
+                return
+        else:
+            self._hb_inject_until = None  # re-armable once cleared
         self._reply_peer(conn, p["tid"], {"ok": True})
 
     # -- map handling + peering -----------------------------------------------
@@ -1191,6 +1210,7 @@ class OSDService(Dispatcher):
                 await self._handle_map_change()
             except asyncio.CancelledError:
                 raise
+            # cephlint: disable=error-taxonomy (next epoch retries)
             except Exception:
                 pass  # next epoch retries
 
@@ -1308,6 +1328,7 @@ class OSDService(Dispatcher):
                     retry_needed = True  # partial recovery: stay peering
             except asyncio.CancelledError:
                 raise
+            # cephlint: disable=error-taxonomy (transient peer trouble: retry_needed re-queries)
             except Exception:
                 retry_needed = True  # transient peer trouble: try again
         if retry_needed and not self._stopped:
@@ -1348,6 +1369,7 @@ class OSDService(Dispatcher):
             try:
                 await self._fetch_rotating_keys()
                 delay = interval
+            # cephlint: disable=error-taxonomy (mon churn: keep retrying fast)
             except Exception:
                 delay = 1.0  # mon churn: keep retrying fast
 
@@ -1394,6 +1416,7 @@ class OSDService(Dispatcher):
                     "pg stats report",
                     {"osd": self.id, "stats": stats}, timeout=5.0,
                 )
+            # cephlint: disable=error-taxonomy (mon churn: next interval re-reports)
             except Exception:
                 pass  # mon churn: next interval re-reports
 
@@ -1450,6 +1473,7 @@ class OSDService(Dispatcher):
                             )
                 except (asyncio.CancelledError,):
                     raise
+                # cephlint: disable=error-taxonomy (next map change retries)
                 except Exception:
                     continue  # next map change retries
 
@@ -1652,6 +1676,7 @@ class OSDService(Dispatcher):
                 timeout=5.0,
             )
             return int(rep.get("up_thru", 0)) >= need
+        # cephlint: disable=error-taxonomy (mon churn: clear the request so the next pass re-asks)
         except Exception:
             self._up_thru_requested = 0  # mon churn: re-request
             return False
@@ -1672,6 +1697,7 @@ class OSDService(Dispatcher):
                 rep = await self.mon.command(
                     "pg history", {"queries": queries}, timeout=8.0
                 )
+            # cephlint: disable=error-taxonomy (mon churn: peering retries without the history cache)
             except Exception:
                 return None
             self._hist_cache = {
@@ -1977,6 +2003,7 @@ class OSDService(Dispatcher):
             avail.add(pos)
         try:
             minimum = ec.minimum_to_decode({shard}, avail)
+        # cephlint: disable=error-taxonomy (unrecoverable with current shards: caller takes full recovery)
         except Exception:
             return None
         if all(
@@ -2041,6 +2068,7 @@ class OSDService(Dispatcher):
             self.perf.inc("recovery_sub_bytes", len(raw))
         try:
             rebuilt = ec.decode({shard}, chunks, chunk_size=cs)[shard]
+        # cephlint: disable=error-taxonomy (decode failed: caller falls back to full-object recovery)
         except Exception:
             return None
         return rebuilt, attrs
@@ -2754,6 +2782,7 @@ class OSDService(Dispatcher):
                 name = next(iter(dirty))
                 try:
                     await self._tier_flush(pool, pg, acting, name)
+                # cephlint: disable=error-taxonomy (flush failure keeps the object TRACKED for the next pass)
                 except Exception:
                     # keep it TRACKED (dropping it would orphan the
                     # only durable copy in the cache): rotate to the
@@ -2881,6 +2910,7 @@ class OSDService(Dispatcher):
                 await fn(conn, p)
             except asyncio.CancelledError:
                 raise
+            # cephlint: disable=error-taxonomy (the sender retries; never kill the worker)
             except Exception:
                 pass  # the sender retries; never kill the worker
             finally:
@@ -2992,6 +3022,7 @@ class OSDService(Dispatcher):
         try:
             if self.codec(p["pool"]) is None:
                 return False
+        # cephlint: disable=error-taxonomy (not an EC pool or codec unavailable: not a planar candidate)
         except Exception:
             return False
         ops = p.get("ops") or []
@@ -4418,6 +4449,7 @@ class OSDService(Dispatcher):
                       "value": json.dumps(persisted).encode().hex()}],
                     [], None,
                 )
+        # cephlint: disable=error-taxonomy (best effort: live sessions still work this interval)
         except Exception:
             pass  # best effort: live sessions still work this interval
 

@@ -124,6 +124,7 @@ class Objecter(Dispatcher):
             try:
                 await self._renew_ticket()
                 delay = max(1.0, self._ticket_ttl / 2)
+            # cephlint: disable=error-taxonomy (mon churn: keep retrying fast so tickets never lapse)
             except Exception:
                 # mon churn: keep retrying FAST until renewed — backing
                 # off a whole half-life here is how tickets expire
@@ -187,6 +188,10 @@ class Objecter(Dispatcher):
         """Re-register every watch whose primary moved (the linger-op
         resend contract; the new primary's persisted watcher table lists
         us as missed until this lands)."""
+        if self.config.get("objecter_inject_no_watch_ping"):
+            # fault injection (options.cc:1066): suppress watch liveness
+            # maintenance so tests can exercise stale-watcher handling
+            return
         for key in list(self._watches):
             pool_id, name, cookie = key
             try:
@@ -208,6 +213,7 @@ class Objecter(Dispatcher):
                     # stay eligible for the next attempt even if the
                     # primary has not moved again
                     self._watch_primary[key] = primary
+                # cephlint: disable=error-taxonomy (retried on the next map change)
                 except Exception:
                     pass  # retried on the next map change
 

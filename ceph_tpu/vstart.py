@@ -164,12 +164,19 @@ def pick_ports(n: int) -> list[int]:
 # Daemon mains (exec'd via python -m ceph_tpu.mon / ceph_tpu.osd / ...)
 
 
+#: in-flight SIGTERM stop tasks: referenced here so the interpreter can
+#: never garbage-collect one mid-stop (cephlint task-leak rule)
+_TERM_TASKS: set = set()
+
+
 def _install_term_handler(loop, stopper) -> None:
     """SIGTERM -> clean daemon stop (the reference's handle_osd_signal);
     SIGKILL needs no handler — that's the crash path tests exercise."""
 
     def _term():
-        asyncio.ensure_future(stopper())
+        task = asyncio.ensure_future(stopper())
+        _TERM_TASKS.add(task)
+        task.add_done_callback(_TERM_TASKS.discard)
 
     loop.add_signal_handler(signal.SIGTERM, _term)
 
@@ -296,8 +303,9 @@ def daemon_main(kind: str, ident: int, spec_path: str) -> None:
             front = S3Frontend(gw, users=users)
             port = await front.start()
             # the kernel-assigned port is published for the launcher
-            # (vstart.sh writes the same kind of run files)
-            with open(
+            # (vstart.sh writes the same kind of run files); one tiny
+            # write at boot, before any IO is served
+            with open(  # cephlint: disable=async-blocking
                 os.path.join(spec.run_dir, f"rgw.{ident}.port"), "w"
             ) as f:
                 f.write(str(port))
@@ -318,7 +326,8 @@ def daemon_main(kind: str, ident: int, spec_path: str) -> None:
             )
             await mgr.start()
             port = await mgr.serve_http()
-            with open(
+            # boot-time run-file write, before any IO is served
+            with open(  # cephlint: disable=async-blocking
                 os.path.join(spec.run_dir, f"mgr.{ident}.port"), "w"
             ) as f:
                 f.write(str(port))

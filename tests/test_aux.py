@@ -43,7 +43,7 @@ def test_config_validation():
     with pytest.raises(ConfigError):
         cfg.set("ms_inject_delay_probability", 1.5)  # max=1.0
     with pytest.raises(ConfigError):
-        cfg.set("no_such_option", 1)
+        cfg.set("no_such_option", 1)  # cephlint: disable=knob-registry
     with pytest.raises(ConfigError):
         cfg.set("osd_pool_default_size", "not-a-number")
     # bool parsing
