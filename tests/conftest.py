@@ -25,11 +25,32 @@ if "--xla_force_host_platform_device_count" not in os.environ.get(
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_platforms", "cpu")
+except (AttributeError, ValueError):
+    # very old jax spells it jax_platform_name; newest may reject the
+    # update after backend init — JAX_PLATFORMS in the env still wins
+    try:
+        jax.config.update("jax_platform_name", "cpu")
+    except (AttributeError, ValueError):
+        pass
 try:
     jax.config.update("jax_num_cpu_devices", 8)
-except AttributeError:  # pre-0.4.34 jax: XLA_FLAGS above already did it
+except (AttributeError, ValueError, RuntimeError):
+    # pre-0.4.34 jax lacks the option (XLA_FLAGS above already did it);
+    # RuntimeError = backend already initialized, ditto
     pass
+
+# the suite assumes an 8-device mesh: fail loudly AT COLLECTION with a
+# readable message instead of obscurely inside the first pjit test
+_devs = len(jax.devices())
+if _devs < 8:  # pragma: no cover - version-skew guard
+    raise RuntimeError(
+        f"conftest expected >=8 virtual CPU devices, got {_devs}: "
+        "this jax version honored neither jax_num_cpu_devices nor "
+        "XLA_FLAGS --xla_force_host_platform_device_count (set before "
+        "backend init?)"
+    )
 
 
 def pytest_configure(config):
@@ -38,6 +59,29 @@ def pytest_configure(config):
         "slow: long-running live tests excluded from the tier-1 "
         "budgeted run (-m 'not slow')",
     )
+    # runtime race/leak detector rides every tier-1 run (cheap: lock
+    # bookkeeping + task weakrefs); CEPH_TPU_RACECHECK=0 opts out
+    if os.environ.get("CEPH_TPU_RACECHECK", "1") not in ("", "0"):
+        from ceph_tpu.lint import racecheck
+
+        racecheck.install()
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _racecheck_clean():
+    """Fail the run (in teardown, so every test still executes) when the
+    session accumulated lock-order inversions or unawaited-task leaks."""
+    yield
+    from ceph_tpu.lint import racecheck
+
+    if racecheck.active():
+        try:
+            racecheck.assert_clean()
+        finally:
+            racecheck.uninstall()
 
 
 def make_mini_cluster(

@@ -1,0 +1,369 @@
+"""cephlint corpus tests: every check gets a known-bad snippet and a
+clean twin, plus suppression/baseline round-trips and the tier-1 gate
+that keeps the repo itself lint-clean.
+
+The bad snippets live in STRING LITERALS here on purpose: string bodies
+never reach the AST checks when this file itself is linted, so the
+corpus cannot show up as repo findings.
+"""
+
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+from ceph_tpu.lint import load_baseline, run_lint, write_baseline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_src(tmp_path, src, *, check, relpath="ceph_tpu/mod.py",
+             baseline=None, extra=()):
+    """Write `src` at `relpath` under a scratch repo root and lint it."""
+    target = tmp_path / relpath
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(src))
+    for rel, body in extra:
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    paths = [relpath] + [rel for rel, _ in extra]
+    return run_lint(paths, root=str(tmp_path), baseline=baseline,
+                    only={check})
+
+
+# -- async-blocking -----------------------------------------------------------
+
+BAD_ASYNC = """
+    import time
+    async def tick():
+        time.sleep(1)
+"""
+CLEAN_ASYNC = """
+    import asyncio
+    async def tick():
+        await asyncio.sleep(0)
+"""
+
+
+def test_async_blocking_bad(tmp_path):
+    rep = lint_src(tmp_path, BAD_ASYNC, check="async-blocking")
+    assert [f.check for f in rep.findings] == ["async-blocking"]
+    assert "time.sleep" in rep.findings[0].message
+
+
+def test_async_blocking_clean(tmp_path):
+    rep = lint_src(tmp_path, CLEAN_ASYNC, check="async-blocking")
+    assert rep.findings == []
+
+
+def test_async_blocking_only_fires_under_ceph_tpu(tmp_path):
+    rep = lint_src(tmp_path, BAD_ASYNC, check="async-blocking",
+                   relpath="tests/mod.py")
+    assert rep.findings == []
+
+
+def test_async_blocking_open_and_nested_def(tmp_path):
+    rep = lint_src(tmp_path, """
+        async def save(path, data):
+            with open(path, "w") as fp:
+                fp.write(data)
+        async def outer():
+            def inner():  # sync helper: its body is NOT async context
+                import time
+                time.sleep(1)
+            return inner
+    """, check="async-blocking")
+    assert len(rep.findings) == 1
+    assert "open(" in rep.findings[0].message
+
+
+# -- task-leak ----------------------------------------------------------------
+
+
+def test_task_leak_bad_and_clean(tmp_path):
+    rep = lint_src(tmp_path, """
+        import asyncio
+        async def fire():
+            asyncio.create_task(work())     # leaked
+        async def kept():
+            t = asyncio.create_task(work())
+            await t
+    """, check="task-leak")
+    assert [f.line for f in rep.findings] == [4]
+
+
+# -- clock-discipline ---------------------------------------------------------
+
+
+def test_clock_discipline_cls_wall_clock(tmp_path):
+    rep = lint_src(tmp_path, """
+        import time
+        def lock_op(ctx):
+            return time.time()
+    """, check="clock-discipline", relpath="ceph_tpu/osd/cls.py")
+    assert len(rep.findings) == 1
+    rep = lint_src(tmp_path, """
+        def lock_op(ctx):
+            return ctx.now
+    """, check="clock-discipline", relpath="ceph_tpu/osd/cls.py")
+    assert rep.findings == []
+
+
+def test_clock_discipline_test_sleeps(tmp_path):
+    rep = lint_src(tmp_path, """
+        import asyncio, time
+        def test_x():
+            time.sleep(0.2)
+        async def test_y():
+            await asyncio.sleep(0)   # yield point: allowed
+    """, check="clock-discipline", relpath="tests/test_mod.py")
+    assert len(rep.findings) == 1 and rep.findings[0].line == 4
+
+
+def test_clock_discipline_slow_tests_may_sleep(tmp_path):
+    rep = lint_src(tmp_path, """
+        import time
+        import pytest
+        @pytest.mark.slow
+        def test_long():
+            time.sleep(1)
+    """, check="clock-discipline", relpath="tests/test_mod.py")
+    assert rep.findings == []
+
+
+# -- knob-registry ------------------------------------------------------------
+
+SCHEMA_STUB = ("ceph_tpu/common/config.py", """
+    SCHEMA = {"declared_knob": None}
+""")
+
+
+def test_knob_read_undeclared(tmp_path):
+    rep = lint_src(tmp_path, """
+        def f(config):
+            config.get("declared_knob")
+            config.get("mystery_knob")
+    """, check="knob-registry", extra=[SCHEMA_STUB])
+    msgs = [f.message for f in rep.findings]
+    assert any("mystery_knob" in m and "not declared" in m for m in msgs)
+    assert not any("'declared_knob' is not declared" in m for m in msgs)
+
+
+def test_knob_non_config_receiver_ignored(tmp_path):
+    rep = lint_src(tmp_path, """
+        def f(cache):
+            cache.get("mystery_knob")
+    """, check="knob-registry", extra=[SCHEMA_STUB])
+    assert not any("not declared" in f.message for f in rep.findings)
+
+
+# -- perf-counter -------------------------------------------------------------
+
+
+def test_perf_counter_bump_without_declare(tmp_path):
+    rep = lint_src(tmp_path, """
+        def make(perf):
+            perf.add_u64_counter("declared", "d")
+        def f(perf):
+            perf.inc("declared")
+            perf.inc("never_declared")
+    """, check="perf-counter")
+    assert len(rep.findings) == 1
+    assert "never_declared" in rep.findings[0].message
+
+
+def test_perf_counter_declared_ok_including_loop_idiom(tmp_path):
+    rep = lint_src(tmp_path, """
+        def make(perf):
+            perf.add_u64_counter("plain", "d")
+            for key, desc in (("looped_a", "d"), ("looped_b", "d")):
+                perf.add_u64_counter(key, desc)
+        def f(perf):
+            perf.inc("plain")
+            perf.inc("looped_a")
+            perf.inc("looped_b")
+    """, check="perf-counter")
+    assert rep.findings == []
+
+
+# -- error-taxonomy -----------------------------------------------------------
+
+
+def test_error_taxonomy_silent_swallow(tmp_path):
+    rep = lint_src(tmp_path, """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """, check="error-taxonomy")
+    assert len(rep.findings) == 1
+
+
+def test_error_taxonomy_reporting_handlers_ok(tmp_path):
+    rep = lint_src(tmp_path, """
+        import asyncio
+        def f(log, errors):
+            try:
+                g()
+            except Exception as e:
+                errors.append(str(e))      # uses the exception
+            try:
+                g()
+            except Exception:
+                raise                       # re-raises
+            try:
+                g()
+            except (asyncio.CancelledError, Exception):
+                pass                        # shutdown-drain idiom
+    """, check="error-taxonomy")
+    assert rep.findings == []
+
+
+def test_error_taxonomy_store_fatal_never_swallowed(tmp_path):
+    rep = lint_src(tmp_path, """
+        def f(log):
+            try:
+                g()
+            except StoreFatalError as e:
+                log.error("fatal: %s", e)   # logged but NOT re-raised
+    """, check="error-taxonomy")
+    assert len(rep.findings) == 1
+    assert "fail-stop" in rep.findings[0].message
+
+
+# -- suppression & baseline machinery ----------------------------------------
+
+
+def test_line_suppression_inline_and_above(tmp_path):
+    rep = lint_src(tmp_path, """
+        import time
+        async def a():
+            time.sleep(1)  # cephlint: disable=async-blocking
+        async def b():
+            # cephlint: disable=async-blocking (boot-time write)
+            time.sleep(1)
+        async def c():
+            time.sleep(1)
+    """, check="async-blocking")
+    assert [f.line for f in rep.findings] == [9]
+    assert rep.suppressed == 2
+
+
+def test_file_suppression(tmp_path):
+    rep = lint_src(tmp_path, """
+        # cephlint: disable-file=async-blocking
+        import time
+        async def a():
+            time.sleep(1)
+    """, check="async-blocking")
+    assert rep.findings == [] and rep.suppressed == 1
+
+
+def test_suppression_is_per_check(tmp_path):
+    rep = lint_src(tmp_path, """
+        import time
+        async def a():
+            time.sleep(1)  # cephlint: disable=task-leak
+    """, check="async-blocking")
+    assert len(rep.findings) == 1  # wrong check name: not silenced
+
+
+def test_baseline_round_trip(tmp_path):
+    rep = lint_src(tmp_path, BAD_ASYNC, check="async-blocking")
+    assert len(rep.new) == 1
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), rep.findings)
+    rep2 = lint_src(tmp_path, BAD_ASYNC, check="async-blocking",
+                    baseline=load_baseline(str(bl)))
+    assert rep2.new == [] and len(rep2.baselined) == 1
+    assert rep2.ok
+
+
+def test_baseline_fingerprint_survives_line_drift(tmp_path):
+    rep = lint_src(tmp_path, BAD_ASYNC, check="async-blocking")
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), rep.findings)
+    drifted = "x = 1\ny = 2\n" + textwrap.dedent(BAD_ASYNC)
+    rep2 = lint_src(tmp_path, drifted, check="async-blocking",
+                    baseline=load_baseline(str(bl)))
+    assert rep2.new == []  # same content, different line: still matched
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    rep = lint_src(tmp_path, BAD_ASYNC, check="async-blocking")
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), rep.findings)
+    rep2 = lint_src(tmp_path, CLEAN_ASYNC, check="async-blocking",
+                    baseline=load_baseline(str(bl)))
+    assert rep2.findings == [] and len(rep2.stale_baseline) == 1
+
+
+def test_summary_counts(tmp_path):
+    rep = lint_src(tmp_path, BAD_ASYNC, check="async-blocking")
+    s = rep.summary()
+    assert s["findings"] == 1 and s["new"] == 1
+    assert s["files"] == 1 and s["checks_run"] == 1
+    assert s["per_check"] == {"async-blocking": 1}
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    from ceph_tpu.lint.cli import main
+
+    (tmp_path / "ceph_tpu").mkdir()
+    (tmp_path / "ceph_tpu" / "mod.py").write_text(
+        textwrap.dedent(BAD_ASYNC))
+    rc = main(["ceph_tpu", "--root", str(tmp_path), "--no-baseline",
+               "--json"])
+    out = capsys.readouterr().out
+    summary = json.loads(out)
+    assert rc == 1 and summary["new"] == 1
+    (tmp_path / "ceph_tpu" / "mod.py").write_text(
+        textwrap.dedent(CLEAN_ASYNC))
+    rc = main(["ceph_tpu", "--root", str(tmp_path), "--no-baseline"])
+    assert rc == 0
+
+
+def test_cli_baseline_update(tmp_path):
+    from ceph_tpu.lint.cli import main
+
+    (tmp_path / "ceph_tpu").mkdir()
+    (tmp_path / "ceph_tpu" / "mod.py").write_text(
+        textwrap.dedent(BAD_ASYNC))
+    bl = tmp_path / "baseline.json"
+    rc = main(["ceph_tpu", "--root", str(tmp_path),
+               "--baseline", str(bl), "--baseline-update"])
+    assert rc == 0 and bl.exists()
+    rc = main(["ceph_tpu", "--root", str(tmp_path), "--baseline", str(bl)])
+    assert rc == 0  # grandfathered
+
+
+# -- the tier-1 gate: this repo lints clean -----------------------------------
+
+
+def test_repo_is_lint_clean():
+    """The whole point: ceph_tpu/ + tests/ carry zero NEW findings over
+    the checked-in baseline, and the run fits the tier-1 time budget."""
+    baseline = load_baseline(os.path.join(REPO, "tools",
+                                          "lint_baseline.json"))
+    t0 = time.monotonic()
+    rep = run_lint(["ceph_tpu", "tests"], root=REPO, baseline=baseline)
+    elapsed = time.monotonic() - t0
+    assert rep.new == [], (
+        "new cephlint findings (fix, suppress with a reason, or — for "
+        "pre-existing debt only — tools/lint.py --baseline-update):\n"
+        + "\n".join(f.render() for f in rep.new)
+    )
+    # the baseline may only shrink: entries that no longer fire must be
+    # removed so debt cannot silently regrow under a stale fingerprint
+    assert rep.stale_baseline == [], (
+        "stale baseline entries (run tools/lint.py --baseline-update):\n"
+        + "\n".join(str(e) for e in rep.stale_baseline)
+    )
+    assert elapsed < 10.0, f"cephlint took {elapsed:.1f}s (budget 10s)"

@@ -1,0 +1,188 @@
+"""Deterministic scenarios for the runtime race/leak detector
+(ceph_tpu.lint.racecheck): a forced lock-order inversion, a forced
+unawaited-task leak, an io-under-lock report, and clean twins proving
+the detector stays quiet on correct code.
+
+Each test resets the detector's global state on entry AND exit so the
+session-wide conftest assert_clean never sees the deliberate faults.
+"""
+
+import asyncio
+import gc
+
+import pytest
+
+from ceph_tpu.lint import racecheck
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+@pytest.fixture
+def rc():
+    was_active = racecheck.active()
+    if not was_active:
+        racecheck.install()
+    racecheck.reset()
+    yield racecheck
+    racecheck.reset()
+    if not was_active:
+        racecheck.uninstall()
+
+
+def test_lock_order_inversion_detected(rc):
+    async def scenario():
+        # separate lines: creation site IS the lock class
+        a = asyncio.Lock()
+        b = asyncio.Lock()
+
+        async def ab():
+            async with a:
+                await asyncio.sleep(0)
+                async with b:
+                    pass
+
+        async def ba():
+            async with b:
+                await asyncio.sleep(0)
+                async with a:
+                    pass
+
+        # sequential, so it cannot actually deadlock — the ORDER graph
+        # still records a -> b then b -> a, which is the hazard
+        await ab()
+        await ba()
+
+    run(scenario())
+    rep = rc.report()
+    assert len(rep["inversions"]) == 1
+    with pytest.raises(AssertionError, match="lock-order inversion"):
+        rc.assert_clean()
+
+
+def test_consistent_lock_order_is_clean(rc):
+    async def scenario():
+        a = asyncio.Lock()
+        b = asyncio.Lock()
+        for _ in range(3):
+            async with a:
+                async with b:
+                    await asyncio.sleep(0)
+
+    run(scenario())
+    assert rc.report()["inversions"] == []
+    rc.assert_clean()
+
+
+def test_same_creation_site_is_one_lock_class(rc):
+    async def scenario():
+        locks = [asyncio.Lock() for _ in range(4)]  # one site, one class
+        for lk in locks:
+            async with lk:
+                await asyncio.sleep(0)
+
+    run(scenario())
+    assert rc.report()["lock_classes"] <= 1
+    rc.assert_clean()
+
+
+def test_pending_task_gc_is_a_leak(rc):
+    async def scenario():
+        async def forever():
+            await asyncio.Event().wait()
+
+        asyncio.get_running_loop().create_task(forever())  # dropped
+        await asyncio.sleep(0)
+        gc.collect()
+
+    run(scenario())
+    gc.collect()
+    rep = rc.report()
+    assert len(rep["leaks"]) == 1
+    with pytest.raises(AssertionError, match="garbage-collected"):
+        rc.assert_clean()
+
+
+def test_referenced_and_awaited_task_is_clean(rc):
+    async def scenario():
+        async def work():
+            await asyncio.sleep(0)
+
+        t = asyncio.get_running_loop().create_task(work())
+        await t
+
+    run(scenario())
+    gc.collect()
+    assert rc.report()["leaks"] == []
+    rc.assert_clean()
+
+
+def test_tracked_fire_and_forget_is_clean(rc):
+    """The OSD._spawn idiom: registry set + done-callback discard."""
+
+    async def scenario():
+        tracked: set = set()
+
+        async def work():
+            await asyncio.sleep(0)
+
+        t = asyncio.get_running_loop().create_task(work())
+        tracked.add(t)
+        t.add_done_callback(tracked.discard)
+        while tracked:
+            await asyncio.sleep(0)
+        gc.collect()
+
+    run(scenario())
+    gc.collect()
+    assert rc.report()["leaks"] == []
+    rc.assert_clean()
+
+
+def test_io_under_lock_reported_not_asserted(rc):
+    async def scenario():
+        lk = asyncio.Lock()
+        async with lk:
+            racecheck.note_io("test.io")
+
+    run(scenario())
+    rep = rc.report()
+    assert len(rep["io_under_lock"]) == 1
+    assert rep["io_under_lock"][0]["kind"] == "test.io"
+    rc.assert_clean()  # informational: must NOT raise
+
+
+def test_coord_lock_classes_join_the_graph(rc):
+    racecheck.note_acquire("coord.Lock:obj/a")
+    racecheck.note_acquire("coord.Lock:obj/b")
+    racecheck.note_release("coord.Lock:obj/b")
+    racecheck.note_release("coord.Lock:obj/a")
+    # notes outside a running loop are no-ops (no current task)
+    assert rc.report()["inversions"] == []
+
+    async def scenario():
+        racecheck.note_acquire("coord.Lock:obj/a")
+        racecheck.note_acquire("coord.Lock:obj/b")
+        racecheck.note_release("coord.Lock:obj/b")
+        racecheck.note_release("coord.Lock:obj/a")
+        racecheck.note_acquire("coord.Lock:obj/b")
+        racecheck.note_acquire("coord.Lock:obj/a")
+
+    run(scenario())
+    assert len(rc.report()["inversions"]) == 1
+
+
+def test_trylock_does_not_add_waits_for_edges(rc):
+    async def scenario():
+        racecheck.note_acquire("coord.Lock:obj/a")
+        # a trylock while holding a: fails fast, cannot deadlock
+        racecheck.note_acquire("coord.Lock:obj/b", blocking=False)
+        racecheck.note_release("coord.Lock:obj/b")
+        racecheck.note_release("coord.Lock:obj/a")
+        racecheck.note_acquire("coord.Lock:obj/b")
+        racecheck.note_acquire("coord.Lock:obj/a", blocking=False)
+
+    run(scenario())
+    assert rc.report()["inversions"] == []
+    rc.assert_clean()
