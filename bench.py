@@ -426,6 +426,35 @@ def _fleet_line() -> None:
         pass
 
 
+def _lint_line() -> None:
+    """Optional JSON line: cephlint summary counts (files, checks run,
+    findings, suppressions, baseline size) so the BENCH trajectory also
+    tracks static-analysis debt shrinking toward zero. Guarded (--lint /
+    CEPH_TPU_BENCH_LINT=1) and non-fatal."""
+    try:
+        from ceph_tpu.lint import load_baseline, run_lint
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        baseline = load_baseline(
+            os.path.join(root, "tools", "lint_baseline.json"))
+        t0 = time.perf_counter()
+        rep = run_lint(["ceph_tpu", "tests"], root=root, baseline=baseline)
+        s = rep.summary()
+        print(json.dumps({
+            "metric": "cephlint_findings",
+            "value": s["findings"],
+            "unit": "findings",
+            "new": s["new"],
+            "baselined": s["baselined"],
+            "suppressed": s["suppressed"],
+            "files": s["files"],
+            "checks_run": s["checks_run"],
+            "seconds": round(time.perf_counter() - t0, 2),
+        }))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def main() -> None:
     import jax
 
@@ -484,6 +513,8 @@ def main() -> None:
         "CEPH_TPU_BENCH_FLEET"
     ):
         _fleet_line()
+    if "--lint" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_LINT"):
+        _lint_line()
 
 
 if __name__ == "__main__":
