@@ -309,6 +309,51 @@ def _wire_line() -> None:
         pass
 
 
+def _wire_local_line() -> None:
+    """Optional JSON line: PosixStack (TCP loopback) vs LocalStack
+    (uds + shared-memory ring, the co-located default) on the same
+    daemon-path workload. Runs tools/daemon_bench.py twice — once with
+    --stack tcp, once with --stack auto — and reports the read/write
+    ratio plus how many payload bytes the receive side took as
+    zero-copy ring loans. Larger objects than _wire_line's run: the
+    EC-encode share shrinks and the transport delta dominates.
+    Guarded (--wire-local / CEPH_TPU_BENCH_WIRE=1) and non-fatal."""
+    try:
+        import subprocess
+
+        def run_bench(stack: str) -> dict:
+            argv = [sys.executable, "tools/daemon_bench.py", "--cpu",
+                    "--osds", "3", "--k", "2", "--m", "1",
+                    "--size", "2097152", "--objects", "48",
+                    "--concurrency", "24", "--stack", stack]
+            out = subprocess.run(
+                argv, capture_output=True, timeout=600, check=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            return json.loads(out.stdout)
+
+        local = run_bench("auto")
+        tcp = run_bench("tcp")
+        line = {
+            "metric": "wire_local_stack_read_throughput",
+            "value": round(local["read_gbps"], 4),
+            "unit": "GB/s",
+            "write_gbps": round(local["write_gbps"], 4),
+            "stack": local["stack"],
+            "tcp_read_gbps": round(tcp["read_gbps"], 4),
+            "tcp_write_gbps": round(tcp["write_gbps"], 4),
+            "read_speedup": round(
+                local["read_gbps"] / tcp["read_gbps"], 3),
+            "write_speedup": round(
+                local["write_gbps"] / tcp["write_gbps"], 3),
+            "frames_per_op": round(local["frames_per_op"], 2),
+            "bytes_zero_copy": local["bytes_zero_copy"],
+        }
+        print(json.dumps(line))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def _ckpt_line() -> None:
     """Optional JSON line: checkpoint save/restore GB/s through the full
     stack (CkptStore -> RADOS client -> OSD daemons -> EC encode), via
@@ -505,6 +550,10 @@ def main() -> None:
         _fault_overhead_line()
     if "--wire" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_WIRE"):
         _wire_line()
+    if "--wire-local" in sys.argv[1:] or os.environ.get(
+        "CEPH_TPU_BENCH_WIRE"
+    ):
+        _wire_local_line()
     if "--ckpt" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_CKPT"):
         _ckpt_line()
     if "--data" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_DATA"):

@@ -123,6 +123,19 @@ SCHEMA: dict[str, Option] = {
              "signed batch frame when the peer negotiated it). 1 = one "
              "write+drain per frame, the uncorked legacy behavior",
              min=1),
+        _opt("ms_local_stack", TYPE_BOOL, LEVEL_ADVANCED, True,
+             "negotiate the LocalStack (Unix socket + shared-memory "
+             "ring) for co-located peers that advertise a uds:// "
+             "endpoint; false pins every session to TCP, bit-identical "
+             "to the pre-stack wire behavior"),
+        _opt("ms_shm_ring_bytes", TYPE_UINT, LEVEL_ADVANCED, 8 << 20,
+             "per-direction shared-memory ring capacity for upgraded "
+             "local sessions; values below 16KiB disable the ring (the "
+             "session stays on the Unix socket)"),
+        _opt("ms_uds_dir", TYPE_STR, LEVEL_ADVANCED, "",
+             "directory for messenger Unix sockets and ring files; "
+             "empty = a per-process tmp dir. AF_UNIX caps socket paths "
+             "at ~100 bytes, so keep it shallow"),
         _opt("ms_subop_batch", TYPE_BOOL, LEVEL_ADVANCED, True,
              "coalesce same-peer sub-ops issued within one event-loop "
              "tick into a single multi-op frame with a batched reply "

@@ -250,6 +250,88 @@ def check_clock_discipline(ctx: FileContext) -> Iterator[Finding]:
     yield from visit(ctx.tree.body, slow=False)
 
 
+# -- dispatch-blocking --------------------------------------------------------
+
+#: dispatcher entry points: the messenger awaits these inline on the
+#: connection's read loop, so anything they await on stalls EVERY later
+#: message on that connection (and holds dispatch-throttle bytes)
+_HANDLER_PREFIXES = ("ms_handle_", "_h_")
+
+#: receivers whose awaited methods are client-side RADOS round trips —
+#: a dispatch handler awaiting one parks this connection's stream on
+#: another daemon's reply (deadlock-bait when that daemon is also
+#: waiting on us)
+_RADOS_IO_RECEIVERS = {"rados", "objecter", "ioctx"}
+
+
+def _dispatch_handlers(tree: ast.AST) -> Iterator[ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef) and (
+            node.name == "ms_dispatch"
+            or node.name.startswith(_HANDLER_PREFIXES)
+        ):
+            yield node
+
+
+@file_check("dispatch-blocking")
+def check_dispatch_blocking(ctx: FileContext) -> Iterator[Finding]:
+    """No lock waits or client-side RADOS IO inline in dispatch.
+
+    `ms_dispatch` / `ms_handle_*` / `_h_*` handlers run on the
+    connection's single read loop. An `await lock.acquire()` (or
+    `async with lock:`) there stalls every queued message behind the
+    lock holder; awaiting a RADOS round trip parks the stream on a
+    peer's reply. Either belongs in a tracked task the handler spawns.
+    """
+    if not ctx.path.startswith("ceph_tpu/"):
+        return
+    for fn in _dispatch_handlers(ctx.tree):
+        for sub in _walk_same_func(fn.body):
+            if isinstance(sub, ast.AsyncWith):
+                for item in sub.items:
+                    name = dotted_name(item.context_expr) or ""
+                    tail = name.split(".")[-1].lower()
+                    if "lock" in tail or "mutex" in tail:
+                        yield Finding(
+                            "dispatch-blocking", ctx.path,
+                            sub.lineno, sub.col_offset,
+                            f"`async with {name}` inside dispatch handler "
+                            f"`{fn.name}`: every later message on this "
+                            "connection queues behind the lock holder — "
+                            "move the guarded work to a tracked task",
+                        )
+                continue
+            if not isinstance(sub, ast.Await):
+                continue
+            call = sub.value
+            if not isinstance(call, ast.Call):
+                continue
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "acquire"):
+                name = dotted_name(call.func) or "lock.acquire"
+                yield Finding(
+                    "dispatch-blocking", ctx.path, sub.lineno,
+                    sub.col_offset,
+                    f"`await {name}()` inside dispatch handler "
+                    f"`{fn.name}`: the connection's read loop blocks "
+                    "until the lock frees — move the guarded work to a "
+                    "tracked task",
+                )
+                continue
+            tail = receiver_tail(call.func)
+            if (isinstance(call.func, ast.Attribute)
+                    and tail in _RADOS_IO_RECEIVERS):
+                yield Finding(
+                    "dispatch-blocking", ctx.path, sub.lineno,
+                    sub.col_offset,
+                    f"client RADOS IO `await {tail}."
+                    f"{call.func.attr}(...)` inside dispatch handler "
+                    f"`{fn.name}`: the stream parks on another daemon's "
+                    "reply while this connection's messages queue — "
+                    "spawn it as a tracked task instead",
+                )
+
+
 # -- knob-registry ------------------------------------------------------------
 
 _CONFIG_RECEIVERS = ("config", "cfg", "conf")

@@ -52,8 +52,15 @@ class MonClient(Dispatcher):
 
     def _conn(self, rank: int | None = None):
         rank = self.target_rank if rank is None else rank
+        local = getattr(self.monmap, "local_addrs", None)
+        try:
+            hint = local[rank] if local else None
+        except IndexError:
+            hint = None
         return self.messenger.connect(
-            tuple(self.monmap.addrs[rank]), Policy.lossless_client()
+            tuple(self.monmap.addrs[rank]),
+            Policy.lossless_client(),
+            local_addr=hint,
         )
 
     async def ms_dispatch(self, conn, msg: Message) -> None:
@@ -204,8 +211,13 @@ class MonClient(Dispatcher):
         addr: tuple[str, int],
         location: dict | None = None,
         weight: int = 0x10000,
+        local_addr: str | None = None,
     ) -> None:
         payload = {"osd": osd, "addr": list(addr)}
+        if local_addr:
+            # uds:// endpoint for co-located peers; published through the
+            # osdmap so clients on the same host can skip TCP
+            payload["local_addr"] = local_addr
         if location:
             # crush location announced at boot (CrushLocation's role):
             # lets the mon place a brand-new device in the hierarchy

@@ -59,6 +59,9 @@ class MonMap:
     """rank -> address; names are mon.<rank> (the reference's MonMap)."""
 
     addrs: list[tuple[str, int]]
+    #: optional rank -> uds:// endpoint for co-located peers (vstart
+    #: fills this in); None entries / a missing list mean TCP only
+    local_addrs: list | None = None
 
     @property
     def size(self) -> int:
@@ -225,7 +228,14 @@ class Monitor(Dispatcher):
         kernel-assigned port (test clusters bind everyone before anyone
         campaigns, so peer addresses are always real)."""
         host, port = self.monmap.addrs[self.rank]
-        await self.messenger.bind(host, port)
+        local_path = None
+        if self.monmap.local_addrs:
+            ep = self.monmap.local_addrs[self.rank]
+            if ep and ep.startswith("uds://"):
+                # deterministic path so clients can dial it from the
+                # shared monmap without a prior TCP round trip
+                local_path = ep[len("uds://"):]
+        await self.messenger.bind(host, port, local_path=local_path)
         self.monmap.addrs[self.rank] = tuple(self.messenger.my_addr)
 
     def go(self) -> None:
@@ -257,8 +267,11 @@ class Monitor(Dispatcher):
         return self.state == "leader"
 
     def _peer_conn(self, rank: int):
+        la = self.monmap.local_addrs
         return self.messenger.connect(
-            tuple(self.monmap.addrs[rank]), Policy.lossless_client()
+            tuple(self.monmap.addrs[rank]),
+            Policy.lossless_client(),
+            local_addr=la[rank] if la else None,
         )
 
     def _bcast(self, msg_type: str, payload: dict) -> None:
@@ -1067,6 +1080,8 @@ class Monitor(Dispatcher):
             epoch=self.osdmap.epoch + 1,
             new_up=[osd],
             new_osd_addrs={osd: tuple(p["addr"])},
+            # "" clears a previous instance's stale uds endpoint
+            new_osd_local_addrs={osd: p.get("local_addr") or ""},
         )
         if osd >= self.osdmap.max_osd:
             inc.new_max_osd = osd + 1

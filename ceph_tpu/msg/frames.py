@@ -63,8 +63,13 @@ SIG_LEN = 16
 FEATURE_BIN_ENVELOPE = 1 << 0  # MESSAGE_SEG frames + denc-lite op payloads
 FEATURE_FRAME_BATCH = 1 << 1   # Tag.BATCH corked multi-frame envelopes
 FEATURE_SUBOP_BATCH = 1 << 2   # multi-op sub-op messages (subop_batch)
+#: the LocalStack upgrade: HELLO also carries this end's uds:// listener
+#: (trailing string — old decoders skip it), and a UDS-dialed session
+#: where both ends hold the bit negotiates the shm ring via SHM_SETUP
+FEATURE_LOCAL_STACK = 1 << 3
 LOCAL_FEATURES = (
     FEATURE_BIN_ENVELOPE | FEATURE_FRAME_BATCH | FEATURE_SUBOP_BATCH
+    | FEATURE_LOCAL_STACK
 )
 
 
@@ -94,6 +99,14 @@ class Tag(IntEnum):
     #: corked multi-frame envelope: u32 count | (u8 tag | u32 len |
     #: payload)* — one crc + one signature for the whole run
     BATCH = 13
+    #: shm ring offer (client -> server, right after the handshake on a
+    #: UDS session where both HELLOs carried FEATURE_LOCAL_STACK):
+    #: string c2s_path | string s2c_path | u64 ring_bytes (0 = stay on
+    #: the plain socket)
+    SHM_SETUP = 14
+    #: server's answer: u8 ok — 1 means both rings mapped and every
+    #: subsequent frame rides them; 0 falls back to the socket
+    SHM_ACK = 15
 
 
 _HEAD = struct_mod.Struct("<IBI")  # magic, tag, payload length
@@ -111,18 +124,20 @@ class Frame:
 
     def encode_parts(self, session_key: bytes | None = None) -> list:
         """The frame as a list of buffers ready for one coalesced socket
-        write. Segments are joined into one body buffer first: the join
-        is a cost the socket write pays anyway, and handing the checksum
-        (and HMAC) one contiguous bytes object keeps the native crc from
-        copying each memoryview segment on its way in."""
+        write (or one shm-ring record). Segments are NOT joined: the crc
+        chains across them (crc(AB) == crc32c(crc32c(seed, A), B)) and
+        the native crc takes memoryviews in place, so a bulk `raw`
+        segment reaches the transport with zero intermediate copies."""
         segs = self.segments if self.segments is not None else (self.payload,)
-        body = segs[0] if len(segs) == 1 else b"".join(segs)
-        if not isinstance(body, bytes):
-            body = bytes(body)
+        total = 0
+        crc = 0xFFFFFFFF
+        for s in segs:
+            total += len(s)
+            crc = ceph_crc32c(crc, s)
         parts: list = [
-            _HEAD.pack(MAGIC, int(self.tag), len(body)),
-            body,
-            _U32.pack(ceph_crc32c(0xFFFFFFFF, body)),
+            _HEAD.pack(MAGIC, int(self.tag), total),
+            *(s for s in segs if len(s)),
+            _U32.pack(crc),
         ]
         if session_key is not None:
             h = hmac_mod.new(session_key, digestmod=hashlib.sha256)
@@ -170,6 +185,10 @@ async def read_frame(reader, session_key: bytes | None = None) -> Frame:
         payload = memoryview(rest)[:length]
     else:
         payload = rest[:length]
+        if not isinstance(payload, bytes):
+            # ring-backed readers hand memoryviews; legacy decoders
+            # (json.loads, Decoder.string) need real bytes
+            payload = bytes(payload)
     try:
         return Frame(Tag(tag), payload)
     except ValueError as e:

@@ -34,20 +34,21 @@ connections may drop on failure but never duplicate or reorder.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import hashlib
 import hmac as hmac_mod
 import json
 import os
 import random
-import socket as socket_mod
+import tempfile
 from dataclasses import dataclass, field
 
 from ceph_tpu.common.encoding import Decoder, Encoder, encode_payload
-from ceph_tpu.lint import racecheck
 from ceph_tpu.msg.frames import (
     BANNER,
     FEATURE_BIN_ENVELOPE,
     FEATURE_FRAME_BATCH,
+    FEATURE_LOCAL_STACK,
     FLAG_BIN_DATA,
     LOCAL_FEATURES,
     Frame,
@@ -58,8 +59,18 @@ from ceph_tpu.msg.frames import (
     iter_batch,
     make_batch_frame,
     message_seg_frame,
-    read_frame,
 )
+from ceph_tpu.msg.shm import ShmRing, ShmStream
+from ceph_tpu.msg.stack import (
+    STACKS,
+    InjectingStream,
+    format_endpoint,
+    parse_endpoint,
+)
+
+#: compat alias — the stream type moved to ceph_tpu/msg/stack.py with the
+#: NetworkStack split; existing call sites keep working
+_InjectingStream = InjectingStream
 
 
 @dataclass(frozen=True)
@@ -102,6 +113,19 @@ async def _call(fn, *args):
         await r
 
 
+def _est_size(item) -> int:
+    """Rough wire size of a queued send item, for byte-capping cork runs.
+    An estimate is fine: overruns fall back to the chunked ring path."""
+    kind, it = item
+    if kind == "msg":
+        raw = getattr(it, "raw", b"") or b""
+        data = getattr(it, "data", b"") or b""
+        return len(raw) + len(data) + 512
+    if it.segments is not None:
+        return sum(len(s) for s in it.segments) + 64
+    return len(it.payload) + 64
+
+
 def backoff_with_jitter(backoff: float, rng) -> float:
     """Reconnect sleep for one attempt: uniform in [backoff/2, backoff].
     A fenced/killed daemon has EVERY peer's reconnect loop pointed at it;
@@ -141,81 +165,33 @@ class AsyncThrottle:
             self._cond.notify_all()
 
 
-class _InjectingStream:
-    """Wraps (reader, writer) applying config-driven fault injection to
-    every frame I/O — the transport-level ms_inject_* hooks."""
+#: test/observability hook: futures resolved after the next inbound
+#: message dispatch anywhere in this process. Live-test helpers park on
+#: this instead of polling — every cluster state transition (map commit,
+#: recovery push, perf bump) is carried by some dispatched message.
+_dispatch_waiters: list = []
 
-    def __init__(self, reader, writer, messenger: "Messenger"):
-        self.reader = reader
-        self.writer = writer
-        self._m = messenger
-        # request/response sub-ops die under Nagle + delayed-ACK
-        # (~200 ms per round trip); the reference sets TCP_NODELAY on
-        # every messenger socket too (AsyncConnection)
-        sock = writer.get_extra_info("socket")
-        if sock is not None:
+
+def next_dispatch_event() -> asyncio.Future:
+    """A future resolved when any messenger in this process finishes
+    dispatching an inbound message (a condition-variable style wakeup
+    for wait-until-cluster-state helpers)."""
+    fut = asyncio.get_event_loop().create_future()
+    _dispatch_waiters.append(fut)
+    return fut
+
+
+def _notify_dispatch() -> None:
+    if not _dispatch_waiters:
+        return
+    waiters = _dispatch_waiters[:]
+    del _dispatch_waiters[:]
+    for fut in waiters:
+        if not fut.done():
             try:
-                sock.setsockopt(
-                    socket_mod.IPPROTO_TCP, socket_mod.TCP_NODELAY, 1
-                )
-            except OSError:
-                pass
-
-    async def _maybe_inject(self, yield_loop: bool = True) -> None:
-        # Yield once per written frame: a burst of writes whose drain()
-        # completes synchronously (socket buffer has room) would otherwise
-        # starve the event loop, so the reader task never sees the ACKs the
-        # peer is streaming back and the resend window cannot shrink. The
-        # read side skips the yield — readexactly already parks the task
-        # whenever the buffer runs dry.
-        if yield_loop:
-            await asyncio.sleep(0)
-        m = self._m
-        delay = m._inject_delay
-        if delay:
-            await asyncio.sleep(delay * m._rng.random())
-        prob = m._inject_delay_prob
-        if prob and m._rng.random() < prob:
-            # the reference's ms_inject_delay_probability/_max pair:
-            # each frame independently risks a bounded random stall
-            await asyncio.sleep(m._inject_delay_max * m._rng.random())
-        every = m._inject_every
-        if every and m._rng.randrange(every) == 0:
-            m.injected_failures += 1
-            self.writer.close()
-            raise ConnectionResetError("injected socket failure")
-
-    async def send(self, frame: Frame, session_key: bytes | None) -> None:
-        await self.send_frames([frame], session_key)
-
-    async def send_frames(
-        self, frames: list, session_key: bytes | None, coalesced: int = 1
-    ) -> None:
-        """One socket write + one drain for a whole corked run (the
-        AsyncConnection write-event coalescing shape): every frame's
-        buffer parts are gathered and joined once, so a run of N frames
-        costs one syscall and one flow-control wait instead of N."""
-        await self._maybe_inject()
-        parts: list = []
-        for f in frames:
-            parts.extend(f.encode_parts(session_key))
-        data = b"".join(parts)
-        m = self._m
-        m.bytes_sent += len(data)
-        perf = m.perf
-        perf.inc("frames_out", len(frames))
-        perf.hinc("corked_run_len", coalesced)
-        if coalesced > 1:
-            perf.inc("corked_runs")
-            perf.inc("corked_msgs", coalesced)
-            perf.inc("bytes_coalesced", len(data))
-        self.writer.write(data)
-        racecheck.note_io("msg.send")
-        await self.writer.drain()
-
-    async def recv(self, session_key: bytes | None) -> Frame:
-        await self._maybe_inject(yield_loop=False)
-        return await read_frame(self.reader, session_key)
+                fut.set_result(None)
+            except RuntimeError:
+                pass  # future bound to an already-closed loop
 
 
 class Connection:
@@ -231,8 +207,17 @@ class Connection:
     ):
         self.messenger = messenger
         self.peer_addr = peer_addr
+        #: scheme-tagged local endpoint of the peer (uds://...), from the
+        #: cluster map at connect() time — tried before TCP when set
+        self.local_hint: str | None = None
+        #: transport this session actually rides: "tcp", "uds", or "shm"
+        #: (surfaced as a span tag and in daemon_bench's `stack` key)
+        self.stack: str = "tcp"
         self.peer_name: str | None = None
         self.peer_nonce: int = 0
+        #: the peer's advertised uds:// listener from its HELLO (with
+        #: FEATURE_LOCAL_STACK); informational on accepted connections
+        self.peer_local_addr: str = ""
         #: feature bits the peer advertised at HELLO (0 until the
         #: handshake lands, and against pre-feature-word peers forever —
         #: every fast-path shape checks a bit before using it)
@@ -305,7 +290,7 @@ class Connection:
                 pass
         self._tasks.clear()
         if self._stream is not None:
-            self._stream.writer.close()
+            self._stream.close()
             self._stream = None
 
     @property
@@ -320,13 +305,95 @@ class Connection:
     def _start_outgoing(self) -> None:
         self._tasks.append(asyncio.create_task(self._run_outgoing()))
 
+    async def _dial(self) -> InjectingStream:
+        """Open the byte transport for this session: the peer's local
+        (uds://) endpoint when we hold one and ms_local_stack allows it,
+        falling back to TCP when the peer is remote, the socket is stale,
+        or the local stack is disabled — the graceful-fallback leg."""
+        m = self.messenger
+        hint = self.local_hint
+        if hint and m._local_stack:
+            try:
+                scheme, target = parse_endpoint(hint)
+                if scheme == "uds":
+                    reader, writer = await m.stacks["uds"].connect(target)
+                    self.stack = "uds"
+                    return InjectingStream(reader, writer, m)
+            except (OSError, ValueError):
+                pass  # not reachable from this host: take TCP below
+        reader, writer = await m.stacks["tcp"].connect(self.peer_addr)
+        self.stack = "tcp"
+        return InjectingStream(reader, writer, m)
+
+    async def _maybe_upgrade_local(
+        self, stream: InjectingStream
+    ) -> InjectingStream:
+        """Client leg of the shm ring negotiation. On a UDS session where
+        both HELLOs carried FEATURE_LOCAL_STACK the client ALWAYS sends
+        SHM_SETUP (ring_bytes=0 when it can't offer rings), so the server
+        can deterministically expect it; the server's SHM_ACK decides
+        whether frames ride the rings or stay on the socket."""
+        m = self.messenger
+        if self.stack != "uds" or not (
+            self.peer_features & FEATURE_LOCAL_STACK
+        ):
+            return stream
+        ring_bytes = m._ring_bytes_effective()
+        tx = rx = None
+        p_tx = p_rx = ""
+        if ring_bytes:
+            tag = os.urandom(8).hex()
+            try:
+                d = m._uds_dir_path()
+                p_tx = os.path.join(d, f"{tag}.c2s.ring")
+                p_rx = os.path.join(d, f"{tag}.s2c.ring")
+                tx = ShmRing.create(p_tx, ring_bytes)
+                rx = ShmRing.create(p_rx, ring_bytes)
+            except (OSError, ValueError):
+                if tx is not None:
+                    tx.close(unlink=True)
+                tx = rx = None
+                p_tx = p_rx = ""
+        try:
+            await stream.send(
+                Frame(
+                    Tag.SHM_SETUP,
+                    Encoder().string(p_tx).string(p_rx)
+                    .u64(ring_bytes if tx is not None else 0)
+                    .bytes(),
+                ),
+                self.session_key,
+            )
+            reply = await stream.recv(self.session_key)
+        except BaseException:
+            for r in (tx, rx):
+                if r is not None:
+                    r.close(unlink=True)
+            raise
+        if reply.tag != Tag.SHM_ACK:
+            for r in (tx, rx):
+                if r is not None:
+                    r.close(unlink=True)
+            raise FrameError(f"expected SHM_ACK, got {reply.tag}")
+        ok = Decoder(reply.payload).u8()
+        if ok and tx is not None:
+            # the server mapped and unlinked the ring files: the memory
+            # now lives exactly as long as the two maps do (kill -9 safe)
+            self.stack = "shm"
+            return ShmStream(stream.reader, stream.writer, m, tx=tx, rx=rx)
+        for r in (tx, rx):
+            if r is not None:
+                r.close(unlink=True)
+        return stream
+
     async def _run_outgoing(self) -> None:
         backoff = 0.01
         while not self._closed:
+            stream = None
             try:
-                reader, writer = await asyncio.open_connection(*self.peer_addr)
-                stream = _InjectingStream(reader, writer, self.messenger)
+                stream = await self._dial()
                 await self._client_handshake(stream)
+                stream = await self._maybe_upgrade_local(stream)
                 self._stream = stream
                 backoff = 0.01
                 # Start reading BEFORE replaying so ACKs for replayed
@@ -363,12 +430,16 @@ class Connection:
                             except (asyncio.CancelledError, Exception):
                                 pass
             except asyncio.CancelledError:
+                if stream is not None:
+                    stream.close()
                 raise
             # cephlint: disable=error-taxonomy (teardown race: the reconnect loop owns recovery)
             except Exception:
                 pass
             self._ready.clear()
             self._stream = None
+            if stream is not None:
+                stream.close()
             if self._closed or self.policy.lossy:
                 if not self._closed:
                     self._closed = True
@@ -387,13 +458,16 @@ class Connection:
         await stream.writer.drain()
         if await stream.reader.readexactly(len(BANNER)) != BANNER:
             raise FrameError("bad banner")
-        # the feature word rides as a trailing u64: pre-feature decoders
-        # ignore trailing HELLO bytes, so negotiation is backward-safe
+        # the feature word rides as a trailing u64 (and, with
+        # FEATURE_LOCAL_STACK, our uds:// listener as a trailing string):
+        # pre-feature decoders ignore trailing HELLO bytes, so
+        # negotiation is backward-safe
         hello = (
             Encoder()
             .string(m.name)
             .u64(m.instance_nonce)
             .u64(m.local_features)
+            .string(m.my_local_addr or "")
             .bytes()
         )
         await stream.send(Frame(Tag.HELLO, hello), None)
@@ -409,6 +483,7 @@ class Connection:
         self.peer_features = (
             d.u64() if d.remaining() >= 8 else 0
         ) & m.local_features
+        self.peer_local_addr = d.string() if d.remaining() >= 4 else ""
         if m.keyring is None:
             return
         service = self.peer_name.split(".", 1)[0]
@@ -539,6 +614,7 @@ class Connection:
         if sp is not None:
             if corked > 1:
                 sp.set_tag("corked", corked)
+            sp.set_tag("stack", self.stack)
             sp.finish()
             msg._send_span = None  # lossless replays re-encode; once only
         if not self.policy.lossy and self._ack_owed > self._ack_sent:
@@ -587,11 +663,20 @@ class Connection:
             # ship the whole run as one write — with FRAME_BATCH, as one
             # OUTER frame whose single crc+HMAC covers every frame in it
             limit = m._cork_max
-            while len(items) < limit:
+            # byte-capped on ring streams: a run that fits one shm record
+            # is loaned to the receiver zero-copy, while an oversize run
+            # would bounce through the chunked-reassembly path
+            cap = getattr(stream, "max_run_bytes", None)
+            run_bytes = _est_size(items[0])
+            while len(items) < limit and (
+                cap is None or run_bytes < cap
+            ):
                 try:
-                    items.append(q.get_nowait())
+                    it = q.get_nowait()
                 except asyncio.QueueEmpty:
                     break
+                items.append(it)
+                run_bytes += _est_size(it)
             n = len(items)
             frames = [
                 self._encode_msg_frame(it, corked=n)
@@ -659,12 +744,20 @@ class Connection:
                     self._flush_ack()
                     return
                 m._peer_in_seq[key] = msg.seq
+            if len(msg.raw) and not isinstance(msg.raw, bytes):
+                s = self._stream
+                if s is not None and getattr(s, "loans_buffers", False):
+                    # a ring payload is a loan that dies at the next
+                    # recv(), and dispatch handlers enqueue raw past this
+                    # frame's lifetime — materialize the one user-space
+                    # copy here (the kernel copies are already gone)
+                    msg.raw = bytes(msg.raw)
             size = max(1, len(msg.data))
             # receive-side messenger span: throttle wait + handler
             # (fast-dispatch leg); only traced messages pay anything
             dsp = None
             if m.tracer is not None and msg.trace:
-                tags = {"type": msg.type, "at": m.name}
+                tags = {"type": msg.type, "at": m.name, "stack": self.stack}
                 if batched:
                     tags["batched"] = True
                 dsp = m.tracer.join(msg.trace, "msg_dispatch", tags=tags)
@@ -675,6 +768,7 @@ class Connection:
                 await m.dispatch_throttle.put(size)
                 if dsp is not None:
                     dsp.finish()
+                _notify_dispatch()
         elif frame.tag == Tag.ACK:
             self._apply_peer_ack(Decoder(frame.payload).u64())
         elif frame.tag == Tag.KEEPALIVE:
@@ -715,8 +809,16 @@ class Messenger:
         self.dispatch_throttle = AsyncThrottle(dispatch_throttle_bytes)
         self._server: asyncio.base_events.Server | None = None
         self.my_addr: tuple[str, int] | None = None
+        #: pluggable transports (NetworkStack registry): a per-messenger
+        #: copy so tests/backends can swap one endpoint's stack
+        self.stacks = dict(STACKS)
+        #: scheme-tagged local listener ("uds://<path>") once bind() has
+        #: a UDS endpoint up; advertised in HELLO and cluster maps
+        self.my_local_addr: str | None = None
+        self._uds_server = None
+        self._uds_path: str | None = None
         self._conns: dict[tuple[str, int], Connection] = {}
-        self._accepted: list[Connection] = []
+        self._accepted: set[Connection] = set()
         #: (peer_name, peer_nonce, session_outgoing) -> highest seq (dedup)
         self._peer_in_seq: dict[tuple, int] = {}
         #: (peer_name, peer_nonce) -> last seq sent on our accepted side
@@ -756,6 +858,8 @@ class Messenger:
             ("batch_inner", "frames wrapped inside BATCH envelopes"),
             ("env_binary", "op payloads encoded as denc-lite values"),
             ("env_json", "op payloads encoded as JSON (fallback)"),
+            ("bytes_zero_copy",
+             "frame bytes received via the shm ring (no kernel copy)"),
         ):
             self.perf.add_u64_counter(key, desc)
         self.perf.add_histogram(
@@ -785,6 +889,17 @@ class Messenger:
         self._inject_every = int(
             self.config.get("ms_inject_socket_failures") or 0
         )
+        self._local_stack = bool(self.config.get("ms_local_stack"))
+        self._shm_ring_bytes = int(
+            self.config.get("ms_shm_ring_bytes") or 0
+        )
+        if not self._local_stack:
+            # drop the feature bit so peers never expect SHM_SETUP from
+            # us and we never dial uds endpoints — bit-identical to the
+            # pre-stack wire behavior
+            self.local_features &= ~FEATURE_LOCAL_STACK
+        self.config.observe("ms_local_stack", self._note_knobs)
+        self.config.observe("ms_shm_ring_bytes", self._note_knobs)
         self.config.observe("ms_cork_max_frames", self._note_knobs)
         self.config.observe("ms_envelope_format", self._note_knobs)
         self.config.observe("ms_compress_mode", self._note_knobs)
@@ -830,12 +945,65 @@ class Messenger:
         self._inject_every = int(
             self.config.get("ms_inject_socket_failures") or 0
         )
+        self._local_stack = bool(self.config.get("ms_local_stack"))
+        self._shm_ring_bytes = int(
+            self.config.get("ms_shm_ring_bytes") or 0
+        )
+        if not self._local_stack:
+            self.local_features &= ~FEATURE_LOCAL_STACK
+
+    def _ring_bytes_effective(self) -> int:
+        """ms_shm_ring_bytes clamped to a workable window; 0 disables the
+        ring (the session stays on the plain UDS socket)."""
+        rb = self._shm_ring_bytes
+        if rb < (1 << 14):
+            return 0
+        return min(rb, 1 << 30)
+
+    def _uds_dir_path(self) -> str:
+        """Directory for our UDS sockets and ring files (ms_uds_dir, or a
+        per-process tmp dir). AF_UNIX paths are ~108 bytes max, so keep
+        this shallow."""
+        d = self.config.get("ms_uds_dir") or ""
+        if not d:
+            d = os.path.join(
+                tempfile.gettempdir(), f"ceph-tpu-{os.getpid()}"
+            )
+        os.makedirs(d, exist_ok=True)
+        return d
 
     # -- lifecycle ------------------------------------------------------------
 
-    async def bind(self, host: str = "127.0.0.1", port: int = 0) -> None:
-        self._server = await asyncio.start_server(self._accept, host, port)
-        self.my_addr = self._server.sockets[0].getsockname()[:2]
+    async def bind(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        local_path: str | None = None,
+    ) -> None:
+        self._server, self.my_addr = await self.stacks["tcp"].listen(
+            (host, port), self._accept
+        )
+        if not self._local_stack:
+            return
+        # every daemon also listens on a Unix socket so co-located peers
+        # can skip the TCP loopback; failure here is never fatal — the
+        # daemon just stays TCP-only and peers fall back
+        path = local_path or os.path.join(
+            self._uds_dir_path(),
+            f"{self.name}.{self.instance_nonce:016x}.sock",
+        )
+        if len(path.encode()) >= 100:
+            return  # AF_UNIX sun_path limit (108); stay TCP-only
+        try:
+            if local_path is not None and os.path.exists(path):
+                os.unlink(path)  # stale socket from a previous instance
+            self._uds_server, _ = await self.stacks["uds"].listen(
+                path, self._accept_local
+            )
+        except (OSError, NotImplementedError):
+            return
+        self._uds_path = path
+        self.my_local_addr = format_endpoint("uds", path)
 
     async def shutdown(self) -> None:
         # stop accepting FIRST: peers reconnect aggressively (heartbeats,
@@ -843,6 +1011,8 @@ class Messenger:
         # conns would keep wait_closed() blocked forever
         if self._server is not None:
             self._server.close()
+        if self._uds_server is not None:
+            self._uds_server.close()
         for t in list(self._handler_tasks):
             t.cancel()
         for t in list(self._handler_tasks):
@@ -858,21 +1028,37 @@ class Messenger:
         if self._server is not None:
             await self._server.wait_closed()
             self._server = None
+        if self._uds_server is not None:
+            await self._uds_server.wait_closed()
+            self._uds_server = None
+        if self._uds_path is not None:
+            with contextlib.suppress(OSError):
+                os.unlink(self._uds_path)
+            self._uds_path = None
+            self.my_local_addr = None
 
     # -- client side ----------------------------------------------------------
 
     def connect(
-        self, addr: tuple[str, int], policy: Policy | None = None
+        self,
+        addr: tuple[str, int],
+        policy: Policy | None = None,
+        local_addr: str | None = None,
     ) -> Connection:
         """Get (or lazily create) the connection to addr
-        (Messenger::connect_to / get_connection)."""
+        (Messenger::connect_to / get_connection). `local_addr` is an
+        optional scheme-tagged local endpoint (uds://...) the peer
+        advertised; the dial path tries it first and falls back to TCP."""
         addr = tuple(addr)
         conn = self._conns.get(addr)
         if conn is not None and not conn._closed:
+            if local_addr and conn.local_hint is None:
+                conn.local_hint = local_addr
             return conn
         conn = Connection(
             self, addr, policy or Policy.lossless_client(), outgoing=True
         )
+        conn.local_hint = local_addr
         self._conns[addr] = conn
         conn._start_outgoing()
         return conn
@@ -882,7 +1068,10 @@ class Messenger:
 
     # -- server side ----------------------------------------------------------
 
-    async def _accept(self, reader, writer) -> None:
+    async def _accept_local(self, reader, writer) -> None:
+        await self._accept(reader, writer, local=True)
+
+    async def _accept(self, reader, writer, local: bool = False) -> None:
         stream = _InjectingStream(reader, writer, self)
         conn = Connection(
             self, None, Policy.stateful_server(), outgoing=False
@@ -905,7 +1094,17 @@ class Messenger:
             conn.peer_features = (
                 hd.u64() if hd.remaining() >= 8 else 0
             ) & self.local_features
-            conn.peer_addr = writer.get_extra_info("peername")[:2]
+            conn.peer_local_addr = (
+                hd.string() if hd.remaining() >= 4 else ""
+            )
+            if local:
+                # a UDS peername is an empty/raw socket path — useless in
+                # dump_tracing and unstable across reconnects; key the
+                # session by the peer's advertised identity instead
+                conn.stack = "uds"
+                conn.peer_addr = ("local", conn.peer_name)
+            else:
+                conn.peer_addr = writer.get_extra_info("peername")[:2]
             conn.out_seq = self._peer_out_seq.get(
                 (conn.peer_name, conn.peer_nonce), 0
             )
@@ -916,6 +1115,7 @@ class Messenger:
                     .string(self.name)
                     .u64(self.instance_nonce)
                     .u64(self.local_features)
+                    .string(self.my_local_addr or "")
                     .bytes(),
                 ),
                 None,
@@ -924,6 +1124,8 @@ class Messenger:
                 if not await self._server_auth(stream, conn):
                     writer.close()
                     return
+            if local and (conn.peer_features & FEATURE_LOCAL_STACK):
+                stream = await self._accept_local_upgrade(stream, conn)
             # adopt the peer instance's surviving un-acked window: the
             # previous accepted Connection died with the old socket, but
             # lossless server->client messages awaiting ACKs must replay
@@ -932,7 +1134,7 @@ class Messenger:
             conn._unacked = self._peer_unacked.setdefault(ukey, [])
             conn._stream = stream
             conn._ready.set()
-            self._accepted.append(conn)
+            self._accepted.add(conn)
             await _call(self.dispatcher.ms_handle_accept, conn)
 
             async def replay_then_write():
@@ -964,11 +1166,52 @@ class Messenger:
         finally:
             conn._ready.clear()
             conn._stream = None
-            if conn in self._accepted:
-                self._accepted.remove(conn)
-            writer.close()
+            self._accepted.discard(conn)
+            stream.close()
             if not conn._closed:
                 await _call(self.dispatcher.ms_handle_reset, conn)
+
+    async def _accept_local_upgrade(
+        self, stream: InjectingStream, conn: Connection
+    ) -> InjectingStream:
+        """Server leg of the shm ring negotiation (see
+        Connection._maybe_upgrade_local). The client always sends
+        SHM_SETUP on a UDS+feature session; ring_bytes=0 (or a failed
+        attach here) keeps frames on the socket — never an error."""
+        setup = await stream.recv(conn.session_key)
+        if setup.tag != Tag.SHM_SETUP:
+            raise FrameError(f"expected SHM_SETUP, got {setup.tag}")
+        d = Decoder(setup.payload)
+        p_c2s = d.string()
+        p_s2c = d.string()
+        ring_bytes = d.u64()
+        tx = rx = None
+        ok = 0
+        if ring_bytes and p_c2s and p_s2c:
+            try:
+                rx = ShmRing.attach(p_c2s)
+                tx = ShmRing.attach(p_s2c)
+                ok = 1
+            except (OSError, ValueError):
+                if rx is not None:
+                    rx.close()
+                tx = rx = None
+        if ok:
+            # both sides are mapped: unlink now so the memory is anchored
+            # only by the two maps and kill -9 leaves no /tmp litter
+            for p in (p_c2s, p_s2c):
+                with contextlib.suppress(OSError):
+                    os.unlink(p)
+        await stream.send(
+            Frame(Tag.SHM_ACK, Encoder().u8(ok).bytes()),
+            conn.session_key,
+        )
+        if ok:
+            conn.stack = "shm"
+            return ShmStream(
+                stream.reader, stream.writer, self, tx=tx, rx=rx
+            )
+        return stream
 
     async def _server_auth(
         self, stream: _InjectingStream, conn: Connection

@@ -635,6 +635,7 @@ class OSDService(Dispatcher):
                 self.mon.send_boot(
                     self.id, tuple(self.messenger.my_addr),
                     location=self.crush_location,
+                    local_addr=self.messenger.my_local_addr,
                 )
                 next_boot = loop.time() + 1.0
             await asyncio.sleep(0.02)
@@ -877,7 +878,10 @@ class OSDService(Dispatcher):
         addr = self.osdmap.osd_addrs.get(osd)
         if addr is None:
             raise RuntimeError(f"no address for osd.{osd}")
-        return self.messenger.connect(tuple(addr), Policy.lossless_client())
+        return self.messenger.connect(
+            tuple(addr), Policy.lossless_client(),
+            local_addr=self.osdmap.osd_local_addrs.get(osd),
+        )
 
     async def _peer_call(
         self, osd: int, msg_type: str, payload: dict,
@@ -951,7 +955,16 @@ class OSDService(Dispatcher):
         if len(pend) >= self.SUBOP_BATCH_MAX:
             self._flush_subops(osd)
         elif len(pend) == 1:
-            asyncio.get_event_loop().call_soon(self._flush_subops, osd)
+            # flush a few ticks out, not one: sub-ops submitted by ops
+            # that the CURRENT tick's callbacks wake (an EC encode
+            # completing, a batch of client writes resuming) still join
+            # this frame — the extra ticks are microseconds against a
+            # ms-scale sub-op round trip, and ordering is safe because
+            # every direct send flushes this peer's queue first
+            loop = asyncio.get_event_loop()
+            loop.call_soon(
+                loop.call_soon, loop.call_soon, self._flush_subops, osd
+            )
 
     def _flush_subops(self, osd: int) -> None:
         """Put this peer's pending sub-ops on the wire: one subop_batch
@@ -1230,6 +1243,7 @@ class OSDService(Dispatcher):
                 self.mon.send_boot(
                     self.id, tuple(self.messenger.my_addr),
                     location=self.crush_location,
+                    local_addr=self.messenger.my_local_addr,
                 )
 
             async def renudge():

@@ -74,6 +74,9 @@ class OSDMap:
     #: osd -> (host, port) public address (OSDMap::osd_addrs) — how clients
     #: and peers reach a daemon; registered at boot via the mon
     osd_addrs: dict[int, tuple[str, int]] = field(default_factory=dict)
+    #: osd -> scheme-tagged local endpoint (uds://...) announced at boot;
+    #: co-located clients dial this first and fall back to osd_addrs
+    osd_local_addrs: dict[int, str] = field(default_factory=dict)
     #: fencing (OSDMap.h:579 blacklist map): entity identity -> unix expiry.
     #: Identities are "client.name" (every instance of the entity) or
     #: "client.name/nonce" (one messenger instance). OSDs refuse ops from
@@ -611,6 +614,8 @@ class Incremental:
     new_primary_temp: dict = _field(default_factory=dict)
     #: osd -> (host, port) announced at boot
     new_osd_addrs: dict = _field(default_factory=dict)
+    #: osd -> uds:// local endpoint announced at boot ("" clears)
+    new_osd_local_addrs: dict = _field(default_factory=dict)
     #: pool -> new snap_seq (selfmanaged_snap_create commits)
     new_pool_snap_seq: dict = _field(default_factory=dict)
     #: pool -> snap ids to append to removed_snaps (snap deletion)
@@ -668,8 +673,10 @@ class Incremental:
             b.list(sorted(self.old_blocklist), lambda e, v: e.string(v))
             b.mapping(self.new_up_thru, lambda e, k: e.u32(k),
                       lambda e, v: e.u64(v))
+            b.mapping(self.new_osd_local_addrs, lambda e, k: e.u32(k),
+                      lambda e, v: e.string(v))
 
-        return _Encoder().struct(4, 1, body).bytes()
+        return _Encoder().struct(5, 1, body).bytes()
 
     @staticmethod
     def decode(raw: bytes) -> "Incremental":
@@ -724,9 +731,13 @@ class Incremental:
                 inc.new_up_thru = b.mapping(
                     lambda d: d.u32(), lambda d: d.u64()
                 )
+            if version >= 5:
+                inc.new_osd_local_addrs = b.mapping(
+                    lambda d: d.u32(), lambda d: d.string()
+                )
             return inc
 
-        return _Decoder(raw).struct(4, body)
+        return _Decoder(raw).struct(5, body)
 
 
 def apply_incremental(self, inc: Incremental) -> None:
@@ -797,6 +808,11 @@ def apply_incremental(self, inc: Incremental) -> None:
             self.primary_temp.pop(pg, None)
     for osd, addr in inc.new_osd_addrs.items():
         self.osd_addrs[osd] = tuple(addr)
+    for osd, la in inc.new_osd_local_addrs.items():
+        if la:
+            self.osd_local_addrs[osd] = la
+        else:
+            self.osd_local_addrs.pop(osd, None)
     for pid, seq in inc.new_pool_snap_seq.items():
         if pid in self.pools:
             self.pools[pid].snap_seq = max(self.pools[pid].snap_seq, seq)
@@ -856,8 +872,10 @@ def encode_osdmap(self) -> bytes:
         b.list(
             [int(v) for v in self.osd_up_thru], lambda e, v: e.u64(v)
         )
+        b.mapping(self.osd_local_addrs, lambda e, k: e.u32(k),
+                  lambda e, v: e.string(v))
 
-    return _Encoder().struct(3, 1, body).bytes()
+    return _Encoder().struct(4, 1, body).bytes()
 
 
 def decode_osdmap(raw: bytes) -> "OSDMap":
@@ -909,9 +927,13 @@ def decode_osdmap(raw: bytes) -> "OSDMap":
             )
             if len(m.osd_up_thru) != m.max_osd:
                 m.osd_up_thru = np.zeros(m.max_osd, dtype=np.int64)
+        if version >= 4:
+            m.osd_local_addrs = b.mapping(
+                lambda d: d.u32(), lambda d: d.string()
+            )
         return m
 
-    return _Decoder(raw).struct(3, body)
+    return _Decoder(raw).struct(4, body)
 
 
 # bound here so the dataclass body above stays focused on placement; these

@@ -236,7 +236,8 @@ class Objecter(Dispatcher):
         self._waiters[tid] = fut
         try:
             self.messenger.connect(
-                tuple(addr), Policy.lossless_client()
+                tuple(addr), Policy.lossless_client(),
+                local_addr=self.osdmap.osd_local_addrs.get(osd),
             ).send_message(
                 Message(type="osd_admin", tid=tid, payload=payload)
             )
@@ -442,7 +443,8 @@ class Objecter(Dispatcher):
             self._waiters[tid] = fut
             try:
                 conn = self.messenger.connect(
-                    tuple(addr), Policy.lossless_client()
+                    tuple(addr), Policy.lossless_client(),
+                    local_addr=self.osdmap.osd_local_addrs.get(primary),
                 )
                 self._last_conn = conn
                 if span is not None:

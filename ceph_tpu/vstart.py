@@ -97,7 +97,19 @@ class ClusterSpec:
     def monmap(self):
         from ceph_tpu.mon import MonMap
 
-        return MonMap(addrs=[tuple(a) for a in self.mon_addrs])
+        # deterministic uds:// endpoints derived from run_dir: every
+        # daemon and client rebuilds the same monmap from the spec, so
+        # co-located peers can dial the mon's Unix socket directly. The
+        # messenger falls back to TCP whenever the socket is absent (a
+        # remote run_dir) or the path exceeds the AF_UNIX limit.
+        local = [
+            f"uds://{os.path.join(self.run_dir, f'mon.{r}.sock')}"
+            for r in range(len(self.mon_addrs))
+        ]
+        return MonMap(
+            addrs=[tuple(a) for a in self.mon_addrs],
+            local_addrs=local,
+        )
 
     def build_config(self):
         from ceph_tpu.common.config import Config
@@ -388,6 +400,9 @@ class VStart:
             # daemons no longer share a loop: grace can be much tighter
             # than the in-process tier's jit-compile-absorbing 2s
             "osd_heartbeat_grace": 3,
+            # keep every daemon's Unix sockets + ring files inside the
+            # cluster's run_dir so teardown removes them with the dir
+            "ms_uds_dir": run_dir,
         }
         cfg.update(config or {})
         ports = pick_ports(n_mons)

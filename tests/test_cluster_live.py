@@ -10,6 +10,7 @@ import pytest
 
 from ceph_tpu.common.config import Config
 from ceph_tpu.mon import MonMap, Monitor
+from ceph_tpu.msg.messenger import next_dispatch_event
 from ceph_tpu.osd import OSDMap
 from ceph_tpu.osd.daemon import OSDService
 from ceph_tpu.rados.client import Rados
@@ -104,12 +105,22 @@ class Cluster:
 
 
 async def wait_until(pred, timeout=30.0):
+    """Event-driven wait: every cluster state transition checked here
+    (map commit, recovery push, perf bump) rides some dispatched
+    message, so park on the messenger's dispatch hook and re-check on
+    each wakeup. The 0.25s cap covers the rare predicate fed by a
+    purely local transition (a timer firing with nothing inbound)."""
     loop = asyncio.get_event_loop()
     end = loop.time() + timeout
     while not pred():
-        if loop.time() > end:
+        remaining = end - loop.time()
+        if remaining <= 0:
             raise TimeoutError
-        await asyncio.sleep(0.05)
+        fut = next_dispatch_event()
+        try:
+            await asyncio.wait_for(fut, min(0.25, remaining))
+        except asyncio.TimeoutError:
+            pass
 
 
 def test_live_cluster_io_round_trip():

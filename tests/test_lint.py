@@ -235,6 +235,62 @@ def test_error_taxonomy_store_fatal_never_swallowed(tmp_path):
     assert "fail-stop" in rep.findings[0].message
 
 
+# -- dispatch-blocking --------------------------------------------------------
+
+BAD_DISPATCH = """
+    class D:
+        async def ms_dispatch(self, conn, msg):
+            await self.lock.acquire()       # stalls the read loop
+            try:
+                self.n += 1
+            finally:
+                self.lock.release()
+
+        async def ms_handle_accept(self, conn):
+            async with self.map_lock:       # same, the ctx-manager form
+                self.peers += 1
+
+        async def _h_osd_boot(self, conn, msg):
+            data = await self.rados.read("obj")   # client IO in dispatch
+            conn.send_message(data)
+"""
+
+CLEAN_DISPATCH = """
+    class D:
+        async def ms_dispatch(self, conn, msg):
+            self.n += 1                     # sync bookkeeping is fine
+            self._spawn(self._rebalance())  # heavy work deferred
+
+        async def _rebalance(self):
+            async with self.map_lock:       # NOT a dispatch entry point
+                data = await self.rados.read("obj")
+                self.apply(data)
+
+        async def ms_handle_reset(self, conn):
+            await asyncio.sleep(0)          # non-lock awaits are fine
+"""
+
+
+def test_dispatch_blocking_bad(tmp_path):
+    rep = lint_src(tmp_path, BAD_DISPATCH, check="dispatch-blocking")
+    assert [f.check for f in rep.findings] == ["dispatch-blocking"] * 3
+    msgs = " | ".join(f.message for f in rep.findings)
+    assert "acquire" in msgs
+    assert "async with" in msgs
+    assert "rados.read" in msgs
+
+
+def test_dispatch_blocking_clean(tmp_path):
+    rep = lint_src(tmp_path, CLEAN_DISPATCH, check="dispatch-blocking")
+    assert rep.findings == []
+
+
+def test_dispatch_blocking_only_fires_under_ceph_tpu(tmp_path):
+    rep = lint_src(tmp_path, BAD_DISPATCH, check="dispatch-blocking",
+                   relpath="tests/mod.py")
+    assert rep.findings == []
+
+
 # -- suppression & baseline machinery ----------------------------------------
 
 

@@ -17,6 +17,7 @@ from ceph_tpu.common.encoding import (
     encode_value,
 )
 from ceph_tpu.msg import Dispatcher, Message, Messenger
+from ceph_tpu.msg.messenger import next_dispatch_event
 from ceph_tpu.msg.frames import (
     FLAG_BIN_DATA,
     LOCAL_FEATURES,
@@ -191,12 +192,20 @@ class _Collector(Dispatcher):
 
 
 async def _wait(pred, timeout=10.0):
+    """Event-driven wait: park on the messenger's dispatch hook instead
+    of polling — every predicate here is satisfied by some inbound
+    message being dispatched, so re-check exactly then."""
     loop = asyncio.get_event_loop()
     end = loop.time() + timeout
     while not pred():
-        if loop.time() > end:
+        remaining = end - loop.time()
+        if remaining <= 0:
             raise TimeoutError
-        await asyncio.sleep(0.005)
+        fut = next_dispatch_event()
+        try:
+            await asyncio.wait_for(fut, remaining)
+        except asyncio.TimeoutError:
+            raise TimeoutError from None
 
 
 OP = {"op": "write", "name": "o1", "qos": "background",

@@ -45,6 +45,13 @@ def parse_args():
                     help="ms_cork_max_frames (1 = no write coalescing)")
     ap.add_argument("--subop-batch", default=None, choices=("on", "off"),
                     help="ms_subop_batch (same-peer sub-op coalescing)")
+    ap.add_argument("--stack", default="auto",
+                    choices=("tcp", "local", "auto"),
+                    help="transport A/B: tcp pins ms_local_stack=false; "
+                         "local/auto negotiate the Unix-socket + shm-ring "
+                         "LocalStack for the co-located daemons (auto is "
+                         "the production default — remote peers still "
+                         "fall back to TCP per connection)")
     ap.add_argument("--multiprocess", action="store_true",
                     help="every daemon a real OS process (vstart) + "
                          "--clients client worker processes")
@@ -81,6 +88,8 @@ async def main(args) -> dict:
         cfg.set("ms_cork_max_frames", args.cork_max)
     if args.subop_batch is not None:
         cfg.set("ms_subop_batch", args.subop_batch == "on")
+    if args.stack == "tcp":
+        cfg.set("ms_local_stack", False)
 
     from ceph_tpu.vstart import initial_osdmap
 
@@ -132,7 +141,7 @@ async def main(args) -> dict:
     def wire_counts() -> dict:
         """Sub-op wire cost across the fleet (frames-per-op source)."""
         tot = {"subop_frames": 0, "subop_ops": 0, "frames_out": 0,
-               "bytes_coalesced": 0}
+               "bytes_coalesced": 0, "bytes_zero_copy": 0}
         for o in osds.values():
             d = o.perf.dump()
             md = o.messenger.perf.dump()
@@ -144,6 +153,10 @@ async def main(args) -> dict:
             )
             tot["frames_out"] += md.get("frames_out", 0)
             tot["bytes_coalesced"] += md.get("bytes_coalesced", 0)
+            tot["bytes_zero_copy"] += md.get("bytes_zero_copy", 0)
+        tot["bytes_zero_copy"] += rados.objecter.messenger.perf.dump().get(
+            "bytes_zero_copy", 0
+        )
         return tot
 
     wire0 = wire_counts()
@@ -171,6 +184,14 @@ async def main(args) -> dict:
     ))
     read_elapsed = time.perf_counter() - t0
 
+    # what the client's OSD sessions actually negotiated (the uds->shm
+    # upgrade is per connection; "local" means at least one made it)
+    client_stacks = {
+        c.stack for c in rados.objecter.messenger._conns.values()
+    }
+    stack_used = (
+        "local" if client_stacks & {"uds", "shm"} else "tcp"
+    )
     await rados.shutdown()
     for o in osds.values():
         await o.stop()
@@ -194,6 +215,8 @@ async def main(args) -> dict:
         "subop_frames": wire["subop_frames"],
         "subop_ops": wire["subop_ops"],
         "bytes_coalesced": wire["bytes_coalesced"],
+        "stack": stack_used,
+        "bytes_zero_copy": wire1["bytes_zero_copy"],
         "envelope_format": str(cfg.get("ms_envelope_format")),
         "cork_max_frames": int(cfg.get("ms_cork_max_frames")),
         "subop_batch": bool(cfg.get("ms_subop_batch")),
