@@ -471,6 +471,74 @@ def _fleet_line() -> None:
         pass
 
 
+def _balance_line() -> None:
+    """Optional JSON line: placement balancing at reference scale. Runs
+    a 1024-OSD psim scenario whose pools carry ~1M PG instances
+    (rep 262144x3 + EC 32768x6) through one churn epoch and the batched
+    calc_pg_upmaps, reporting PGs mapped per second as the headline
+    value plus balancer convergence (spread before/after, moves,
+    rounds, launches). A batched-vs-scalar speedup rides along, timed
+    steady-state (map launches pre-compiled — the mgr re-balances the
+    same map shape every tick) with an identical move budget, at a
+    scale where the reference baseline's per-PG host CRUSH walks
+    dominate. Guarded (--balance / CEPH_TPU_BENCH_BALANCE=1) and
+    non-fatal."""
+    try:
+        from ceph_tpu.crush import balance
+        from ceph_tpu.sim import build_cluster, run_scenario
+
+        n_osd = int(os.environ.get("CEPH_TPU_BENCH_BALANCE_OSDS", "1024"))
+        report = run_scenario(
+            n_osd=n_osd,
+            rep_pg_num=n_osd * 256,  # x3 replicas
+            ec_pg_num=n_osd * 32,  # x6 shards -> ~1M instances at 1024
+            seed=1, epochs=1, max_changes=2048, measure=True,
+        )
+        bal, timing = report["balance"], report["timing"]
+
+        # batched-vs-scalar: same map shape, same budget, wall time
+        # each. The batched map is warmed once (jit compile is a
+        # per-shape one-time cost, amortized across balancer ticks);
+        # the scalar side's O(PGs) python walks ARE its steady-state
+        # cost, so it is timed cold.
+        h_osd = min(n_osd, 512)
+        budget = 64
+        m = build_cluster(h_osd, rep_pg_num=h_osd * 32, ec_pg_num=h_osd * 4)
+        for pid in m.pools:
+            m.pool_mappings(pid)
+        t0 = time.perf_counter()
+        r = balance.calc_pg_upmaps(m, max_changes=budget)
+        batched_s = time.perf_counter() - t0
+        m = build_cluster(h_osd, rep_pg_num=h_osd * 32, ec_pg_num=h_osd * 4)
+        t0 = time.perf_counter()
+        scalar_changes = balance.calc_pg_upmaps_scalar(
+            m, max_changes=budget)
+        scalar_s = time.perf_counter() - t0
+
+        print(json.dumps({
+            "metric": "balancer_pgs_mapped_throughput",
+            "value": round(timing["pgs_mapped_per_s"], 1),
+            "unit": "PGs/s",
+            "osds": report["osds"],
+            "pg_instances": report["pg_instances"],
+            "spread_before": round(bal["spread_before"], 2),
+            "spread_after": round(bal["spread_after"], 2),
+            "converged": bal["converged"],
+            "moves": bal["changes"],
+            "rounds": bal["rounds"],
+            "launches": bal["launches"],
+            "balance_seconds": round(timing["balance_seconds"], 3),
+            "total_seconds": round(timing["total_seconds"], 3),
+            # warm-map head-to-head at an equal move budget
+            "speedup_vs_scalar": round(scalar_s / batched_s, 2),
+            "speedup_batched_s": round(batched_s, 3),
+            "speedup_scalar_s": round(scalar_s, 3),
+            "speedup_moves": [r.changes, scalar_changes],
+        }))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def _lint_line() -> None:
     """Optional JSON line: cephlint summary counts (files, checks run,
     findings, suppressions, baseline size) so the BENCH trajectory also
@@ -562,6 +630,10 @@ def main() -> None:
         "CEPH_TPU_BENCH_FLEET"
     ):
         _fleet_line()
+    if "--balance" in sys.argv[1:] or os.environ.get(
+        "CEPH_TPU_BENCH_BALANCE"
+    ):
+        _balance_line()
     if "--lint" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_LINT"):
         _lint_line()
 

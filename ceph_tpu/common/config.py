@@ -422,6 +422,31 @@ SCHEMA: dict[str, Option] = {
              "fallback poll interval (seconds) for barrier/lock waiters; "
              "watch/notify wakeups make this the slow path, only taken "
              "when a notify is lost to a primary change", min=0.01),
+        # balancer / placement simulator (ceph_tpu.crush.balance,
+        # ceph_tpu.sim, tools/psim.py; reference mgr/balancer options)
+        _opt("balancer_max_deviation", TYPE_FLOAT, LEVEL_ADVANCED, 1.0,
+             "PG-count deviation from the weight-proportional target "
+             "every OSD must reach before a balancer pass stops "
+             "(upmap_max_deviation role)", min=0.0,
+             see_also=("balancer_max_changes", "balancer_mode")),
+        _opt("balancer_max_changes", TYPE_UINT, LEVEL_ADVANCED, 256,
+             "pg_upmap_items budget per balancer tick; the batched move "
+             "scorer makes hundreds per tick affordable "
+             "(upmap_max_optimizations role)", min=1),
+        _opt("balancer_mode", TYPE_STR, LEVEL_ADVANCED, "upmap",
+             "balancer optimization mode: upmap (per-PG exception "
+             "table) or crush-compat (choose_args weight-set feedback "
+             "that pre-upmap clients honor)"),
+        _opt("psim_default_osds", TYPE_UINT, LEVEL_DEV, 1024,
+             "cluster size tools/psim.py builds when --osds is not "
+             "given", min=1),
+        _opt("psim_default_seed", TYPE_UINT, LEVEL_DEV, 1,
+             "event-script RNG seed tools/psim.py uses when --seed is "
+             "not given"),
+        _opt("psim_bytes_per_pg", TYPE_UINT, LEVEL_DEV, 8 << 30,
+             "assumed bytes stored per PG for psim's backfill-storm "
+             "estimate (PGs moved x this = data moved per epoch)",
+             min=1),
         # bench / profiling
         _opt("bench_profile_trace_dir", TYPE_STR, LEVEL_DEV, "",
              "write jax.profiler traces here when set",

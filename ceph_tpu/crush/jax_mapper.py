@@ -52,7 +52,9 @@ Everything is int32/int64/uint64 exact — no float anywhere.
 
 from __future__ import annotations
 
+import copy
 import functools
+import hashlib
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -596,10 +598,146 @@ def compile_map(cmap: CrushMap, positions: int = 0) -> CompiledMap:
     )
 
 
+# -- structural compile cache ------------------------------------------------
+#
+# CompiledMap is identity-hashed so it can ride in jit static args, which
+# means every fresh CompiledMap recompiles every kernel — even when the
+# crush tree is structurally identical to one already compiled (the mgr
+# re-decodes the map each epoch, the simulator replays scenarios on
+# rebuilt clusters, tests build the same geometry over and over). The
+# fingerprint below covers exactly the inputs compile_map bakes into the
+# executables; equal fingerprints ⇒ byte-identical kernels, so the cached
+# instance is shared and jit's static-arg identity check hits.
+
+def _map_fingerprint(cmap: CrushMap, positions: int) -> str:
+    t = cmap.tunables
+    state = (
+        positions,
+        cmap.max_devices,
+        tuple(
+            (bid, b.type, int(b.alg), b.hash, b.weight, b.item_weight,
+             tuple(b.items), tuple(b.item_weights))
+            for bid, b in sorted(cmap.buckets.items())
+        ),
+        tuple(
+            (rid, r.ruleset, r.type, r.min_size, r.max_size,
+             tuple((int(s.op), s.arg1, s.arg2) for s in r.steps))
+            for rid, r in sorted(cmap.rules.items())
+        ),
+        tuple(
+            (bid,
+             tuple(ca.ids) if ca.ids else None,
+             tuple(map(tuple, ca.weight_set)) if ca.weight_set else None)
+            for bid, ca in sorted(cmap.choose_args.items())
+        ),
+        (t.choose_local_tries, t.choose_local_fallback_tries,
+         t.choose_total_tries, t.chooseleaf_descend_once,
+         t.chooseleaf_vary_r, t.chooseleaf_stable, t.straw_calc_version),
+    )
+    return hashlib.sha256(repr(state).encode()).hexdigest()
+
+
+_COMPILE_CACHE: dict[str, CompiledMap] = {}
+_COMPILE_CACHE_MAX = 8
+
+
+def compile_map_cached(cmap: CrushMap, positions: int = 0) -> CompiledMap:
+    """compile_map behind a small content-keyed cache.
+
+    The cached CompiledMap's `source` is a deep copy, so later mutation of
+    the caller's CrushMap (mon crush edits under the same object) cannot
+    skew the structural reads of an instance other callers still hold.
+    Bounded FIFO: device arrays are real memory, and a handful of live map
+    shapes is the steady state everywhere this is hot.
+    """
+    key = _map_fingerprint(cmap, positions)
+    hit = _COMPILE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    cm = compile_map(copy.deepcopy(cmap), positions)
+    _COMPILE_CACHE[key] = cm
+    while len(_COMPILE_CACHE) > _COMPILE_CACHE_MAX:
+        _COMPILE_CACHE.pop(next(iter(_COMPILE_CACHE)))
+    return cm
+
+
+# -- runtime weight-sets -----------------------------------------------------
+#
+# CompiledMap bakes choose_args weights (and their division magics) into the
+# jitted executables as constants — right for a map whose weight-sets change
+# rarely, hopeless for the crush-compat balancer, which evaluates a NEW
+# candidate weight-set every iteration. runtime_weight_arrays() builds an
+# overlay pytree of device arrays that rides through map_rule as a TRACED
+# argument: the kernels read straw2 weights from it instead of the baked
+# constants (falling back to the exact truncating-division path, since the
+# magic constants are weight-derived), so successive candidates with the same
+# structure reuse one compiled executable — zero recompiles per candidate.
+
+
+def runtime_weight_arrays(
+    compiled: CompiledMap, weight_sets: dict[int, list[list[int]]]
+):
+    """Build the runtime weight overlay for `map_rule(runtime_weights=...)`.
+
+    weight_sets: bucket id -> per-position weight rows (16.16 ints, one row
+    per choose position; shorter sets are clamped to their last row exactly
+    like compile-time choose_args). Buckets absent from the dict keep their
+    compile-time weights. The returned pytree's structure depends only on
+    the compiled map, the override keys, and the max position count — so
+    candidate weight-sets that share those reuse the compiled executables.
+    """
+    _require_x64()
+    cmap = compiled.source
+    p_rt = max(
+        (len(rows) for rows in weight_sets.values() if rows), default=1
+    ) or 1
+    _, _, s_inner = compiled.weights.shape
+    dense = np.asarray(compiled.weights[:, 0, :])  # (B, S_inner)
+    dense = np.repeat(dense[:, None, :], p_rt, axis=1).copy()
+    if compiled.n_positions > 1:
+        base = np.asarray(compiled.weights)
+        for pos in range(p_rt):
+            dense[:, pos, :] = base[:, min(pos, compiled.n_positions - 1), :]
+    rows_sorted = sorted(cmap.buckets)
+    row_of = {bid: i for i, bid in enumerate(rows_sorted)}
+    take_bids = {
+        step.arg1
+        for rule in cmap.rules.values()
+        for step in rule.steps
+        if step.op == RuleOp.TAKE and step.arg1 in cmap.buckets
+    }
+    exact: dict[int, jnp.ndarray] = {}
+    for bid in take_bids:
+        base_ex = np.asarray(compiled.exact[bid][2])  # (P, width)
+        ex = np.repeat(base_ex[:1], p_rt, axis=0).copy()
+        for pos in range(p_rt):
+            ex[pos] = base_ex[min(pos, base_ex.shape[0] - 1)]
+        exact[bid] = ex
+    for bid, rows in weight_sets.items():
+        bucket = cmap.buckets.get(bid)
+        if bucket is None or not rows:
+            continue
+        s = bucket.size
+        for pos in range(p_rt):
+            w = rows[min(pos, len(rows) - 1)]
+            if bid in exact:
+                exact[bid][pos, :s] = w[:s]
+            r = row_of.get(bid)
+            if r is not None and s <= s_inner:
+                dense[r, pos, :s] = w[:s]
+    return {
+        "dense": jnp.asarray(dense, dtype=jnp.int64),
+        "exact": {
+            bid: jnp.asarray(ex, dtype=jnp.int64)
+            for bid, ex in exact.items()
+        },
+    }
+
+
 # -- batched kernels ---------------------------------------------------------
 
 
-def _straw2_choose_inner(cm: CompiledMap, rows, xs, rs, positions):
+def _straw2_choose_inner(cm: CompiledMap, rows, xs, rs, positions, rt=None):
     """(N,) inner-table bucket rows -> (N,) chosen items."""
     if cm.n_positions == 1:
         ids = cm.ids[rows, 0]        # (N, S_inner)
@@ -610,6 +748,15 @@ def _straw2_choose_inner(cm: CompiledMap, rows, xs, rs, positions):
         ids = cm.ids[rows, pos]
         ws = cm.weights[rows, pos]
         mg = (cm.magic_m[rows, pos], cm.magic_s[rows, pos])
+    if rt is not None:
+        # runtime weight overlay: traced weights, magic-free exact division
+        dense = rt["dense"]
+        p_rt = dense.shape[1]
+        if p_rt == 1:
+            ws = dense[rows, 0]
+        else:
+            ws = dense[rows, jnp.minimum(positions, p_rt - 1)]
+        mg = None
     lane = jnp.arange(cm.max_size)[None, :]
     valid = lane < cm.sizes[rows][:, None]
     draws = straw2_draws(
@@ -619,7 +766,8 @@ def _straw2_choose_inner(cm: CompiledMap, rows, xs, rs, positions):
     return cm.items[rows, idx]
 
 
-def _straw2_choose_static(cm: CompiledMap, bid: int, xs, rs, positions):
+def _straw2_choose_static(cm: CompiledMap, bid: int, xs, rs, positions,
+                          rt=None):
     """Static bucket id -> (N,) chosen items; exact width, no row gather."""
     items, ids, weights, size, magic_m, magic_s = cm.exact[bid]
     if cm.n_positions == 1:
@@ -631,6 +779,13 @@ def _straw2_choose_static(cm: CompiledMap, bid: int, xs, rs, positions):
         ids_b = ids[pos]              # (N, S) via position gather
         ws_b = weights[pos]
         mg_b = (magic_m[pos], magic_s[pos])
+    if rt is not None and bid in rt["exact"]:
+        wrt = rt["exact"][bid]  # (P_rt, width)
+        if wrt.shape[0] == 1:
+            ws_b = wrt[0][None, :]
+        else:
+            ws_b = wrt[jnp.minimum(positions, wrt.shape[0] - 1)]
+        mg_b = None
     valid = jnp.arange(items.shape[0])[None, :] < size
     draws = straw2_draws(
         xs[:, None], ids_b, rs[:, None].astype(jnp.int32), ws_b, valid, mg_b
@@ -658,7 +813,7 @@ def _is_out_b(weight_vec, item, x):
     return oob | (~full & (zero | h))
 
 
-def _descend_b(cm, start, xs, rs, want_type, positions, levels):
+def _descend_b(cm, start, xs, rs, want_type, positions, levels, rt=None):
     """Walk lanes down until an item of want_type.
 
     start: either a python int bucket id (static level-0 specialization) or an
@@ -673,7 +828,7 @@ def _descend_b(cm, start, xs, rs, want_type, positions, levels):
             z = jnp.zeros(n, jnp.int32)
             f = jnp.zeros(n, bool)
             return z, z - 1, f, f
-        item = _straw2_choose_static(cm, bid, xs, rs, positions)
+        item = _straw2_choose_static(cm, bid, xs, rs, positions, rt)
         t, nrow = _item_lookup_b(cm, item)
         bad = (item >= cm.max_devices) | ((t != want_type) & (nrow < 0))
         hit = (~bad) & (t == want_type)
@@ -696,7 +851,7 @@ def _descend_b(cm, start, xs, rs, want_type, positions, levels):
         row, item, done, reached, skip = st
         safe_row = jnp.maximum(row, 0)
         empty = cm.sizes[safe_row] == 0
-        nxt = _straw2_choose_inner(cm, safe_row, xs, rs, positions)
+        nxt = _straw2_choose_inner(cm, safe_row, xs, rs, positions, rt)
         t, nrow = _item_lookup_b(cm, nxt)
         bad = (nxt >= cm.max_devices) | ((t != want_type) & (nrow < 0))
         hit = (~empty) & (~bad) & (t == want_type)
@@ -717,7 +872,7 @@ def _descend_b(cm, start, xs, rs, want_type, positions, levels):
 
 def _leaf_firstn_b(
     cm, weight_vec, item_rows, xs, out2, outpos, sub_r, recurse_tries, stable,
-    active,
+    active, rt=None,
 ):
     """Batched chooseleaf recursion for firstn: one non-out, non-leaf-colliding
     device under each lane's item_row (mapper.c:565-585)."""
@@ -729,7 +884,7 @@ def _leaf_firstn_b(
         ftotal, leaf, got, skip = st
         r = rep0 + sub_r + ftotal
         item, _, reached, skp = _descend_b(
-            cm, item_rows, xs, r, 0, outpos, cm.depth
+            cm, item_rows, xs, r, 0, outpos, cm.depth, rt
         )
         collide = jnp.any(
             (slot < outpos[:, None]) & (out2 == item[:, None]), axis=1
@@ -755,6 +910,7 @@ def _leaf_firstn_b(
 def _firstn_try(
     cm, weight_vec, start, xs, out, out2, outpos, rep, ftotal,
     want_type, recurse_to_leaf, recurse_tries, vary_r, stable, active,
+    rt=None,
 ):
     """One firstn attempt for all (active) lanes; returns (item, leaf, good,
     skip)."""
@@ -762,7 +918,7 @@ def _firstn_try(
     slot = jnp.arange(out.shape[1])[None, :]
     r = rep + ftotal
     item, item_row, reached, skp = _descend_b(
-        cm, start, xs, r, want_type, outpos, cm.depth
+        cm, start, xs, r, want_type, outpos, cm.depth, rt
     )
     collide = jnp.any(
         (slot < outpos[:, None]) & (out == item[:, None]), axis=1
@@ -774,7 +930,7 @@ def _firstn_try(
         need_leaf = active & reached & ~collide
         leaf_found, got_leaf = _leaf_firstn_b(
             cm, weight_vec, item_row, xs, out2, outpos, sub_r,
-            recurse_tries, stable, need_leaf,
+            recurse_tries, stable, need_leaf, rt,
         )
         is_dev = item >= 0
         leaf = jnp.where(is_dev, item, leaf_found)
@@ -795,7 +951,7 @@ def _firstn_try(
 )
 def _choose_firstn_static(
     xs, weight_vec, cm, start_bid, numrep, want_type, recurse_to_leaf,
-    tries, recurse_tries, vary_r, stable, out_slots,
+    tries, recurse_tries, vary_r, stable, out_slots, rt=None,
 ):
     """Batched crush_choose_firstn from a static start bucket (mapper.c:460).
 
@@ -823,7 +979,7 @@ def _choose_firstn_static(
     xs_all = jnp.tile(xs, numrep)
     r_all = jnp.repeat(jnp.arange(numrep, dtype=jnp.int32), n)
     item_a, item_row_a, reached_a, skip_a = _descend_b(
-        cm, start_bid, xs_all, r_all, want_type, r_all, cm.depth
+        cm, start_bid, xs_all, r_all, want_type, r_all, cm.depth, rt
     )
     if recurse_to_leaf:
         sub_r_a = (
@@ -831,7 +987,7 @@ def _choose_firstn_static(
         )
         rep0_a = jnp.zeros_like(r_all) if stable else r_all
         leaf_a, _, leaf_reached_a, _ = _descend_b(
-            cm, item_row_a, xs_all, rep0_a + sub_r_a, 0, r_all, cm.depth
+            cm, item_row_a, xs_all, rep0_a + sub_r_a, 0, r_all, cm.depth, rt
         )
         is_dev_a = item_a >= 0
         leaf_pick_a = jnp.where(is_dev_a, item_a, leaf_a)
@@ -915,7 +1071,7 @@ def _choose_firstn_static(
                     cm, weight_vec, start_bid, s_xs, s_out, s_out2, s_outpos,
                     s_rep, jnp.full(k, 0, jnp.int32) + ftotal,
                     want_type, recurse_to_leaf, recurse_tries, vary_r,
-                    stable, act,
+                    stable, act, rt,
                 )
                 s_item = jnp.where(good, it, s_item)
                 s_leaf = jnp.where(good, lf, s_leaf)
@@ -961,7 +1117,7 @@ def _choose_firstn_static(
                     cm, weight_vec, start_bid, xs, out, out2, outpos, rep_i,
                     jnp.full(n, 0, jnp.int32) + ftotal,
                     want_type, recurse_to_leaf, recurse_tries, vary_r,
-                    stable, act,
+                    stable, act, rt,
                 )
                 item = jnp.where(good, it, item)
                 leaf = jnp.where(good, lf, leaf)
@@ -1007,7 +1163,7 @@ def _choose_firstn_static(
 )
 def _choose_firstn_dynamic(
     xs, start_items, weight_vec, cm, numrep, want_type, recurse_to_leaf,
-    tries, recurse_tries, vary_r, stable, out_slots,
+    tries, recurse_tries, vary_r, stable, out_slots, rt=None,
 ):
     """As _choose_firstn_static but from per-lane start buckets (chained
     choose steps); no straggler compaction (these stages are small)."""
@@ -1030,7 +1186,7 @@ def _choose_firstn_dynamic(
                 cm, weight_vec, start_rows, xs, out, out2, outpos, rep_i,
                 jnp.zeros(n, jnp.int32) + ftotal,
                 want_type, recurse_to_leaf, recurse_tries, vary_r, stable,
-                act,
+                act, rt,
             )
             item = jnp.where(good, it, item)
             leaf = jnp.where(good, lf, leaf)
@@ -1069,7 +1225,7 @@ def _choose_firstn_dynamic(
 )
 def _choose_indep_b(
     xs, start_items, weight_vec, cm, start_bid, numrep, out_slots, want_type,
-    recurse_to_leaf, tries, recurse_tries,
+    recurse_to_leaf, tries, recurse_tries, rt=None,
 ):
     """Batched crush_choose_indep (mapper.c:655). start_bid is the static
     start bucket id, or None with start_items an (N,) array."""
@@ -1094,7 +1250,7 @@ def _choose_indep_b(
             r = rep + numrep * ftotal
             item, item_row, reached, skp = _descend_b(
                 cm, start, xs, jnp.full(n, 0, jnp.int32) + r, want_type,
-                jnp.zeros(n, dtype=jnp.int32), cm.depth,
+                jnp.zeros(n, dtype=jnp.int32), cm.depth, rt,
             )
             collide = jnp.any(out == item[:, None], axis=1)
             leaf = jnp.full(n, none, dtype=jnp.int32)
@@ -1105,7 +1261,7 @@ def _choose_indep_b(
                     r2 = rep + r + numrep * ft2
                     it2, _, ok2, _ = _descend_b(
                         cm, item_row, xs, jnp.full(n, 0, jnp.int32) + r2, 0,
-                        jnp.full(n, rep, dtype=jnp.int32), cm.depth,
+                        jnp.full(n, rep, dtype=jnp.int32), cm.depth, rt,
                     )
                     good2 = ok2 & ~_is_out_b(weight_vec, it2, xs)
                     lf = jnp.where(good2 & ~got, it2, lf)
@@ -1180,7 +1336,8 @@ def _assemble_blocks(blocks, n: int, result_max: int) -> np.ndarray:
     return out, pos.astype(np.int32)
 
 
-def _map_rule_chunk(compiled, rule, tunables, xs, weight_vec, result_max):
+def _map_rule_chunk(compiled, rule, tunables, xs, weight_vec, result_max,
+                    rt=None):
     t = tunables
     choose_tries = t.choose_total_tries + 1  # off-by-one compat (mapper.c:922)
     choose_leaf_tries = 0
@@ -1261,18 +1418,18 @@ def _map_rule_chunk(compiled, rule, tunables, xs, weight_vec, result_max):
                         out, out2 = _choose_firstn_static(
                             xs, weight_vec, compiled, bid, numrep,
                             step.arg2, recurse, choose_tries, recurse_tries,
-                            vary_r, stable, slots,
+                            vary_r, stable, slots, rt,
                         )
                     else:
                         out, out2 = _choose_firstn_dynamic(
                             xs, col, weight_vec, compiled, numrep,
                             step.arg2, recurse, choose_tries, recurse_tries,
-                            vary_r, stable, slots,
+                            vary_r, stable, slots, rt,
                         )
                 else:
                     out, out2 = _choose_indep_b(
                         xs, col, weight_vec, compiled, bid, numrep, slots,
-                        step.arg2, recurse, choose_tries, recurse_tries,
+                        step.arg2, recurse, choose_tries, recurse_tries, rt,
                     )
                 picked = out2 if recurse else out
                 new_cols.extend((None, picked[:, j]) for j in range(slots))
@@ -1320,6 +1477,7 @@ def map_rule(
     result_max: int,
     chunk: int | None = None,
     return_lengths: bool = False,
+    runtime_weights=None,
 ):
     """Evaluate one rule for a whole batch of x on device.
 
@@ -1332,6 +1490,10 @@ def map_rule(
     return_lengths=True additionally returns the (N,) per-row emitted result
     length — the reference result vector's size, which distinguishes an indep
     row's trailing NONE holes (inside the result) from padding (outside it).
+
+    runtime_weights: overlay from runtime_weight_arrays() — straw2 weights
+    flow in as traced device arrays (candidate weight-sets re-evaluate with
+    zero recompiles), everything else keeps the compile-time constants.
     """
     _require_x64()
     cmap = compiled.source
@@ -1358,7 +1520,7 @@ def map_rule(
             part = np.concatenate([part, np.zeros(pad, dtype=np.int32)])
         blocks = _map_rule_chunk(
             compiled, rule, cmap.tunables, jnp.asarray(part), weight_vec,
-            result_max,
+            result_max, runtime_weights,
         )
         chunk_blocks.append((blocks, len(part), pad))
 

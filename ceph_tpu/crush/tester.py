@@ -114,7 +114,7 @@ class CrushTester:
             )
         if jm.supports(self.cmap, ruleno):
             if self._compiled is None:
-                self._compiled = jm.compile_map(self.cmap)
+                self._compiled = jm.compile_map_cached(self.cmap)
             compiled = self._compiled
             got, lengths = jm.map_rule(
                 compiled, ruleno, real_xs, weight, nr, return_lengths=True

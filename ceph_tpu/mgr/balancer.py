@@ -4,8 +4,10 @@ The loop the reference's balancer module runs (pybind/mgr/balancer):
 
   1. fetch the latest committed OSDMap from the mon (MgrStandby's map
      subscription);
-  2. optimize: OSDMap.calc_pg_upmaps on a local copy — here the batched
-     TPU mapper computes whole-pool placements per device launch;
+  2. optimize: OSDMap.calc_pg_upmaps on a local copy — the batched move
+     scorer (crush/balance.py) evaluates every candidate
+     (pg, from, to) move per device launch, so max_changes is a real
+     per-tick budget instead of a wall of 10;
   3. execute: commit the new pg_upmap_items via mon commands
      (`ceph osd pg-upmap-items` per PG; module.py:execute), after which the
      next map epoch re-routes the moved PGs and primaries re-peer.
@@ -14,37 +16,81 @@ The loop the reference's balancer module runs (pybind/mgr/balancer):
 (module.py do_crush_compat, :63-78): instead of per-PG upmap exceptions
 it writes a compat WEIGHT-SET (choose_args) that nudges each device's
 straw2 draw weight until observed PG counts track crush-weight targets —
-older clients that know nothing of upmaps still map identically. The
-candidate weight-sets are evaluated with the scalar oracle mapper (a
-full recompile of the batched mapper per candidate would dwarf the
-mini-scale pool walks; at reference scale the batched mapper with
-weights as runtime inputs is the drop-in).
+older clients that know nothing of upmaps still map identically. Each
+candidate weight-set rides into the compiled batched mapper as RUNTIME
+inputs (jax_mapper.runtime_weight_arrays): one batched launch per pool
+per iteration, zero recompiles across iterations — the map is compiled
+once and only the weight arrays change.
+
+Defaults for deviation/changes/mode come from the `balancer_*` config
+knobs when a Config is wired in (the mgr daemon passes its own);
+explicit arguments always win. The module keeps a `balancer` perf block
+(moves, launches, score latency, spread before/after) that the mgr's
+prometheus exporter scrapes, and tags its `mgr_balancer_tick` span with
+launches + spread so traces show what a tick actually did.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from ceph_tpu.common.perf_counters import PerfCounters
 from ceph_tpu.crush.types import ChooseArg
-from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE, OSDMap
+
+
+def _make_perf() -> PerfCounters:
+    p = PerfCounters("balancer")
+    p.add_u64_counter("moves", "pg_upmap_items entries committed")
+    p.add_u64_counter(
+        "launches",
+        "device launches spent (pool maps + move-scoring chunks)",
+    )
+    p.add_u64_counter("ticks", "balancer passes run")
+    p.add_time_avg(
+        "score_lat", "host-visible seconds inside batched move scoring"
+    )
+    p.add_u64(
+        "spread_before",
+        "max |PG-count deviation| entering the last pass (rounded)",
+    )
+    p.add_u64(
+        "spread_after",
+        "max |PG-count deviation| leaving the last pass (rounded)",
+    )
+    return p
 
 
 class BalancerModule:
-    def __init__(self, mon_client, tracer=None):
+    def __init__(self, mon_client, tracer=None, config=None):
         self.mon = mon_client
         #: optional common.tracer.Tracer: each run_once becomes a root
         #: `mgr_balancer_tick` span (sampled by tracer_sample_rate_
         #: balancer) whose mon command hops nest beneath it
         self.tracer = tracer
+        #: optional common.config.Config supplying balancer_* defaults
+        self.config = config
+        self.perf = _make_perf()
+
+    def _default(self, name: str, fallback):
+        if self.config is not None:
+            return self.config.get(name)
+        return fallback
 
     async def run_once(
         self,
         pools: set[int] | None = None,
-        max_deviation: float = 1.0,
-        max_changes: int = 10,
-        mode: str = "upmap",
+        max_deviation: float | None = None,
+        max_changes: int | None = None,
+        mode: str | None = None,
     ) -> dict:
         """One balancer pass; returns {changes, mappings} as committed."""
+        if max_deviation is None:
+            max_deviation = self._default("balancer_max_deviation", 1.0)
+        if max_changes is None:
+            max_changes = self._default("balancer_max_changes", 10)
+        if mode is None:
+            mode = self._default("balancer_mode", "upmap")
         span = token = None
         if self.tracer is not None:
             span = self.tracer.start(
@@ -56,8 +102,29 @@ class BalancerModule:
             result = await self._run_once_inner(
                 pools, max_deviation, max_changes, mode
             )
+            self.perf.inc("ticks")
+            self.perf.inc("moves", result.get("changes", 0))
+            self.perf.inc("launches", result.get("launches", 0))
+            if "score_seconds" in result:
+                self.perf.tinc("score_lat", result["score_seconds"])
+            if "spread_before" in result:
+                self.perf.set(
+                    "spread_before", int(round(result["spread_before"]))
+                )
+                self.perf.set(
+                    "spread_after",
+                    int(round(result.get("spread_after", 0.0))),
+                )
             if span is not None:
                 span.set_tag("changes", result.get("changes", 0))
+                span.set_tag("launches", result.get("launches", 0))
+                if "spread_before" in result:
+                    span.set_tag(
+                        "spread_before", result["spread_before"]
+                    )
+                    span.set_tag(
+                        "spread_after", result.get("spread_after")
+                    )
             return result
         finally:
             if span is not None:
@@ -79,8 +146,17 @@ class BalancerModule:
             max_changes=max_changes,
             pools=pools,
         )
+        bal = scratch.last_balance
+        stats = {}
+        if bal is not None:
+            stats = {
+                "launches": bal.launches,
+                "spread_before": bal.spread_before,
+                "spread_after": bal.spread_after,
+                "score_seconds": bal.score_seconds,
+            }
         if not changes:
-            return {"changes": 0, "mappings": {}}
+            return {"changes": 0, "mappings": {}, **stats}
         mappings: dict[str, list] = {}
         for pg, items in scratch.pg_upmap_items.items():
             if before.get(pg) != items:
@@ -91,7 +167,7 @@ class BalancerModule:
         result = await self.mon.command(
             "osd pg-upmap-items", {"mappings": mappings}
         )
-        return {"changes": changes, "mappings": mappings, **result}
+        return {"changes": changes, "mappings": mappings, **stats, **result}
 
     async def crush_compat(
         self,
@@ -103,7 +179,13 @@ class BalancerModule:
         adjustments (w *= (target/actual)^step, the reference's
         do_crush_compat feedback loop), keep the best iterate by PG-count
         spread, and commit the choose_args through `osd crush set` (the
-        whole-map commit path every client re-reads)."""
+        whole-map commit path every client re-reads).
+
+        The map is compiled ONCE; every candidate weight-set is threaded
+        into the compiled mapper as runtime device arrays, so evaluating
+        an iterate costs one batched launch per pool and never recompiles.
+        """
+        from ceph_tpu.crush import jax_mapper
         from ceph_tpu.crush.compiler import decompile_crushmap
 
         osdmap = await self.mon.wait_for_map()
@@ -112,18 +194,6 @@ class BalancerModule:
         target_pools = sorted(pools if pools else scratch.pools)
         if not target_pools:
             return {"changes": 0}
-
-        def pg_counts() -> np.ndarray:
-            c = np.zeros(scratch.max_osd, dtype=np.int64)
-            for pid in target_pools:
-                pool = scratch.pools[pid]
-                for ps in range(pool.pg_num):
-                    for osd in scratch.pg_to_up_acting_osds(
-                        pid, ps
-                    )[2]:
-                        if 0 <= osd < scratch.max_osd:
-                            c[osd] += 1
-            return c
 
         # crush-weight targets: device weights from the hierarchy
         dev_weight = np.zeros(scratch.max_osd, dtype=np.float64)
@@ -159,7 +229,13 @@ class BalancerModule:
                     else b.item_weight
                     for j in range(len(b.items))
                 ]]
-            amap[bid] = ChooseArg(weight_set=rows)
+            # ids (if any) are preserved: the compiled mapper baked them
+            # and only weights ride as runtime inputs
+            amap[bid] = ChooseArg(
+                ids=(list(existing.ids)
+                     if existing is not None and existing.ids else None),
+                weight_set=rows,
+            )
 
         def subtree_devices(item: int) -> list[int]:
             if item >= 0:
@@ -172,8 +248,42 @@ class BalancerModule:
             return out
 
         def install(a: dict[int, ChooseArg]) -> None:
+            # choose_args stay in sync with the runtime overlay: the
+            # sparse scalar re-runs inside pool_mappings read the map
             cmap.choose_args = a
             cmap.choose_args_maps = {-1: a} if a else {}
+
+        # compiled once — candidate weight-sets ride in as traced inputs
+        compiled = scratch._compile()
+        launches = 0
+
+        def pg_counts() -> np.ndarray:
+            nonlocal launches
+            rt = jax_mapper.runtime_weight_arrays(
+                compiled,
+                {bid: a.weight_set for bid, a in cmap.choose_args.items()},
+            )
+            c = np.zeros(scratch.max_osd, dtype=np.int64)
+            for pid in target_pools:
+                pool = scratch.pools[pid]
+                rows = scratch.pool_mappings(pid, runtime_weights=rt)
+                launches += 1
+                flat = rows[rows != CRUSH_ITEM_NONE]
+                c += np.bincount(
+                    flat, minlength=scratch.max_osd
+                )[: scratch.max_osd]
+                # acting differs from up only where pg_temp overrides
+                # placement mid-recovery: patch those few rows sparsely
+                for (tp, tps) in scratch.pg_temp:
+                    if tp != pid or tps >= pool.pg_num:
+                        continue
+                    row = rows[tps]
+                    for o in row[row != CRUSH_ITEM_NONE]:
+                        c[o] -= 1
+                    for o in scratch.pg_to_up_acting_osds(pid, tps)[2]:
+                        if 0 <= o < scratch.max_osd:
+                            c[o] += 1
+            return c
 
         def spread(c: np.ndarray) -> float:
             share = dev_weight / dev_weight.sum()
@@ -225,7 +335,10 @@ class BalancerModule:
                     weight_set=[list(r) for r in a.weight_set]
                 ) for bid, a in amap.items()}
         if best_spread >= start_spread:
-            return {"changes": 0, "spread": start_spread}
+            return {
+                "changes": 0, "spread": start_spread,
+                "launches": launches,
+            }
         install(best)
         await self.mon.command(
             "osd crush set",
@@ -235,4 +348,5 @@ class BalancerModule:
             "changes": len(best),
             "spread_before": start_spread,
             "spread_after": best_spread,
+            "launches": launches,
         }

@@ -78,18 +78,27 @@ class MgrService:
         """Instantiate the module tier (MgrStandby::handle_mgr_map's
         active transition). Modules are plain objects over our objecter;
         operators drive them through this daemon from now on."""
+        from ceph_tpu.common.perf_counters import PerfCountersCollection
         from ceph_tpu.mgr.autoscaler import PgAutoscaler
         from ceph_tpu.mgr.balancer import BalancerModule
         from ceph_tpu.mgr.dashboard import DashboardModule
         from ceph_tpu.mgr.prometheus import PrometheusExporter
 
+        balancer = BalancerModule(
+            self.objecter.mon,
+            tracer=getattr(self.objecter, "tracer", None),
+            config=self.config,
+        )
+        # mgr-local counter blocks (balancer moves/launches/spread) ride
+        # the same exporter as the per-daemon perf dumps
+        self.perf_collection = PerfCountersCollection()
+        self.perf_collection.add(balancer.perf)
         self.modules = {
-            "balancer": BalancerModule(
-                self.objecter.mon,
-                tracer=getattr(self.objecter, "tracer", None),
-            ),
+            "balancer": balancer,
             "pg_autoscaler": PgAutoscaler(self.objecter),
-            "prometheus": PrometheusExporter(self.objecter),
+            "prometheus": PrometheusExporter(
+                self.objecter, local_perf=self.perf_collection
+            ),
             "dashboard": DashboardModule(self.objecter),
         }
 

@@ -59,8 +59,11 @@ def render_perf_value(emit, key: str, value, labels: dict) -> None:
 class PrometheusExporter:
     PREFIX = "ceph_tpu"
 
-    def __init__(self, objecter):
+    def __init__(self, objecter, local_perf=None):
         self.objecter = objecter
+        #: optional PerfCountersCollection of mgr-LOCAL blocks (balancer
+        #: moves/launches/spread): scraped in-process, no admin hop
+        self.local_perf = local_perf
 
     async def collect(self) -> str:
         osdmap = self.objecter.osdmap
@@ -119,6 +122,20 @@ class PrometheusExporter:
         for pid, pool in sorted(osdmap.pools.items()):
             gauge("pool_pg_num", pool.pg_num, {"pool": pid})
             gauge("pool_size", pool.size, {"pool": pid})
+
+        # mgr-local module counters (the balancer block): same rendering
+        # as daemon counters under the `mgr_` family
+        if self.local_perf is not None:
+            for logger, counters in sorted(self.local_perf.dump().items()):
+                for key, value in sorted(counters.items()):
+                    render_perf_value(
+                        lambda n, v, lab, t, type_name=None: gauge(
+                            f"mgr_{n}", v, lab, t,
+                            type_name=(None if type_name is None
+                                       else f"mgr_{type_name}"),
+                        ),
+                        key, value, {"module": logger},
+                    )
 
         # per-daemon perf counters (TIME_AVG/HISTOGRAM expanded into
         # their native Prometheus representations)

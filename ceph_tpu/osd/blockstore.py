@@ -337,6 +337,11 @@ class BlockStore(KStore):
         self._lock = threading.RLock()
         self._flusher: threading.Thread | None = None
         self._flusher_stop = threading.Event()
+        # set whenever the deferred backlog is empty — an event-driven
+        # "WAL drained" signal (tests and external drivers wait on it
+        # instead of polling the _DEFER prefix)
+        self._deferred_drained = threading.Event()
+        self._deferred_drained.set()
         self._closed = False
         # device fault layer: cached 1-in-N rates (config-observed so
         # injectargs flips them live) + the deterministic per-object set;
@@ -440,6 +445,8 @@ class BlockStore(KStore):
         # mount; the flusher itself stays lazy (first write commit) so a
         # store opened only for inspection never mutates itself
         self._deferred_since = time.monotonic() if rows else None
+        if rows:
+            self._deferred_drained.clear()
         self._sync_gauges()
         if mkfs:
             kv = KVTransaction()
@@ -638,6 +645,7 @@ class BlockStore(KStore):
         self._deferred_ops = len(rows)
         if not rows:
             self._deferred_since = None
+            self._deferred_drained.set()
         for key in set(self._staged) | self._batch_drops:
             self._onode_cache.pop(key, None)
             self._buffer_drop(key)
@@ -663,9 +671,11 @@ class BlockStore(KStore):
         if self._deferred_bytes > 0:
             if self._deferred_since is None:
                 self._deferred_since = time.monotonic()
+            self._deferred_drained.clear()
             self._maybe_start_flusher()
         else:
             self._deferred_since = None
+            self._deferred_drained.set()
         self._sync_gauges()
         self._last_deferred_n = self._batch_deferred_n
         self._last_big_n = self._batch_big_n
@@ -864,6 +874,13 @@ class BlockStore(KStore):
         since = self._deferred_since
         return 0.0 if since is None else time.monotonic() - since
 
+    def wait_deferred_drained(self, timeout: float | None = None) -> bool:
+        """Block until the deferred backlog is empty — event-driven: the
+        aging flusher, byte pressure, or an explicit flush sets the
+        event the moment the last WAL row commits to the device. Returns
+        False on timeout."""
+        return self._deferred_drained.wait(timeout)
+
     def tick(self) -> int:
         """Age-based deferred flush: drain the backlog iff its oldest
         entry exceeds blockstore_deferred_max_age_ms. Called by the
@@ -943,6 +960,7 @@ class BlockStore(KStore):
                 self._deferred_bytes = 0
                 self._deferred_ops = 0
                 self._deferred_since = None
+                self._deferred_drained.set()
                 self._sync_gauges()
                 return 0
             kv = KVTransaction()
@@ -977,6 +995,7 @@ class BlockStore(KStore):
             self._deferred_bytes = 0
             self._deferred_ops = 0
             self._deferred_since = None
+            self._deferred_drained.set()
             self.perf.inc("deferred_flush")
             self.perf.inc("deferred_flush_ops", len(moved))
             self.perf.tinc("l_flush", time.perf_counter() - t0)

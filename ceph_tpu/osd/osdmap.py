@@ -96,6 +96,9 @@ class OSDMap:
         if self.osd_up_thru is None:
             self.osd_up_thru = np.zeros(n, dtype=np.int64)
         self._compiled = None
+        #: BalanceResult of the most recent calc_pg_upmaps pass (not
+        #: encoded; diagnostics for the balancer module / bench)
+        self.last_balance = None
 
     # -- state transitions (the failure-detection consumer) -------------------
 
@@ -329,16 +332,32 @@ class OSDMap:
         from ceph_tpu.crush import jax_mapper
 
         if self._compiled is None:
-            self._compiled = jax_mapper.compile_map(self.crush)
+            self._compiled = jax_mapper.compile_map_cached(self.crush)
         return self._compiled
 
-    def pool_mappings(self, pool_id: int) -> np.ndarray:
+    def pool_mappings(
+        self, pool_id: int, runtime_weights=None, return_raw=False
+    ) -> np.ndarray:
         """Up sets for EVERY PG of a pool in one batched mapper run.
 
         Returns (pg_num, size) int32, CRUSH_ITEM_NONE-padded, after the full
         raw -> upmap -> up pipeline (erasure pools keep positional NONE
         holes; replicated pools are left-compacted). One device launch maps
         the whole pool — the batch axis is the PG id.
+
+        runtime_weights: optional jax_mapper.runtime_weight_arrays overlay —
+        candidate choose_args weight-sets evaluated as traced inputs with no
+        recompile (the crush-compat balancer's per-iteration path). Callers
+        must keep self.crush.choose_args in sync with the overlay: the
+        sparse overrides below (upmap entries, primary-affinity rows) re-run
+        through the scalar pipeline, which reads choose_args from the map.
+
+        return_raw=True additionally returns the pre-upmap CRUSH rows
+        ((pg_num, size) int32, the _pg_to_raw_osds stage before
+        _remove_nonexistent) as a second array: the balancer revalidates
+        candidate moves by replaying apply_upmap/raw_to_up_osds over these
+        cached rows — bit-identical to a full scalar remap without paying
+        the per-PG CRUSH walk per move.
         """
         from ceph_tpu.crush import jax_mapper
 
@@ -347,7 +366,10 @@ class OSDMap:
         pps = pool.raw_pg_to_pps_np(pool_id, ps)
         ruleno = self.find_rule(pool.crush_rule, pool.type, pool.size)
         if ruleno < 0:
-            return np.full((pool.pg_num, pool.size), CRUSH_ITEM_NONE, np.int32)
+            empty = np.full(
+                (pool.pg_num, pool.size), CRUSH_ITEM_NONE, np.int32
+            )
+            return (empty, empty.copy()) if return_raw else empty
         if not jax_mapper.supports(self.crush, ruleno):
             # PER-RULE scope gate: only rules that reach legacy buckets
             # pay the scalar path — straw2 rules keep the batched 10x
@@ -355,13 +377,20 @@ class OSDMap:
             out = np.full(
                 (pool.pg_num, pool.size), CRUSH_ITEM_NONE, np.int32
             )
+            raw_out = np.full_like(out, CRUSH_ITEM_NONE)
             for pg_ord in range(pool.pg_num):
                 up, *_ = self.pg_to_up_acting_osds(pool_id, pg_ord)
                 out[pg_ord, : len(up)] = up
-            return out
+                if return_raw:
+                    rr = scalar_mapper.do_rule(
+                        self.crush, ruleno,
+                        int(pps[pg_ord]), list(self.osd_weight), pool.size,
+                    )
+                    raw_out[pg_ord, : len(rr)] = rr
+            return (out, raw_out) if return_raw else out
         raw = jax_mapper.map_rule(
             self._compile(), ruleno, pps.astype(np.int32), self.osd_weight,
-            pool.size,
+            pool.size, runtime_weights=runtime_weights,
         )  # (pg_num, size)
 
         # vectorized _remove_nonexistent + _raw_to_up_osds: valid = exists & up
@@ -396,12 +425,14 @@ class OSDMap:
             out[pg_ord] = row
 
         if pool.can_shift_osds():
-            # left-compact each row (replicated semantics)
-            compacted = np.full_like(out, CRUSH_ITEM_NONE)
-            for i in range(out.shape[0]):
-                row = out[i][out[i] != CRUSH_ITEM_NONE]
-                compacted[i, : len(row)] = row
-            out = compacted
+            # left-compact each row (replicated semantics): a stable argsort
+            # on the NONE mask pulls placed entries left in order — one
+            # vectorized pass instead of a per-row python loop (which
+            # dominated whole-pool mapping at simulator scale)
+            order = np.argsort(out == CRUSH_ITEM_NONE, axis=1, kind="stable")
+            out = np.take_along_axis(out, order, axis=1)
+        if return_raw:
+            return out, np.asarray(raw, dtype=np.int32)
         return out
 
     # -- balancer (calc_pg_upmaps, OSDMap.cc:4512) ------------------------------
@@ -412,91 +443,29 @@ class OSDMap:
         max_changes: int = 10,
         pools: set[int] | None = None,
     ) -> int:
-        """Greedy upmap balancing on the batched mapping.
+        """Batched greedy upmap balancing (crush/balance.py).
 
-        Computes per-OSD PG counts over the selected pools (one batched
-        mapper launch per pool), then repeatedly remaps one PG from the most
-        overfull OSD to the most underfull OSD not already in that PG's up
-        set, recording pg_upmap_items entries, until every OSD's deviation
-        from its weight-proportional target is within `max_deviation` PGs or
-        `max_changes` entries were made. Returns the number of changes.
+        Per-OSD PG loads come from one batched mapper launch per pool, every
+        candidate (pg, from, to) move is scored in one vectorized call per
+        PG-table chunk, and moves are committed greedily with pg_upmap_items
+        entries until every OSD's deviation from its weight-proportional
+        target is within `max_deviation` PGs or `max_changes` entries were
+        made. Returns the number of changes; the full BalanceResult (spread
+        before/after, launches, score latency) lands in `self.last_balance`.
 
         This is the balancer-module usage of the reference's calc_pg_upmaps
-        (pybind/mgr/balancer/module.py:902 -> OSDMap.cc:4512), with the
-        candidate search simplified as documented in the module docstring.
+        (pybind/mgr/balancer/module.py:902 -> OSDMap.cc:4512).
         """
-        pool_ids = sorted(pools if pools is not None else self.pools)
-        # per-osd pg load + which pgs live on each osd
-        pgs_by_osd: dict[int, set[tuple[int, int]]] = {
-            o: set() for o in range(self.max_osd)
-        }
-        up_cache: dict[tuple[int, int], np.ndarray] = {}
-        total_pgs = 0
-        for pid in pool_ids:
-            pool = self.pools[pid]
-            total_pgs += pool.pg_num * pool.size
-            ups = self.pool_mappings(pid)
-            for ps in range(pool.pg_num):
-                up_cache[(pid, ps)] = ups[ps]
-                for o in ups[ps]:
-                    if o != CRUSH_ITEM_NONE:
-                        pgs_by_osd[int(o)].add((pid, ps))
+        from ceph_tpu.crush import balance
 
-        weights = self.osd_weight * (self.osd_exists & self.osd_up)
-        wtotal = int(weights.sum())
-        if wtotal == 0 or total_pgs == 0:
-            return 0
-        pgs_per_weight = total_pgs / wtotal
-
-        def deviation(o: int) -> float:
-            return len(pgs_by_osd[o]) - int(weights[o]) * pgs_per_weight
-
-        changed = 0
-        for _ in range(max_changes):
-            devs = sorted(
-                (deviation(o), o) for o in range(self.max_osd)
-                if weights[o] > 0 or pgs_by_osd[o]
-            )
-            if not devs:
-                break
-            over_dev, over = devs[-1]
-            if over_dev <= max_deviation:
-                break
-            moved = False
-            for pg in sorted(pgs_by_osd[over]):
-                up = up_cache[pg]
-                members = {int(o) for o in up if o != CRUSH_ITEM_NONE}
-                for under_dev, under in devs:
-                    if under_dev >= over_dev - 1:
-                        break
-                    if under in members or weights[under] == 0:
-                        continue
-                    items = self.pg_upmap_items.setdefault(pg, [])
-                    items.append((over, under))
-                    # re-validate by remapping this one PG
-                    new_up, *_ = self.pg_to_up_acting_osds(*pg)
-                    if over in new_up or under not in new_up or len(
-                        set(new_up) - {CRUSH_ITEM_NONE}
-                    ) != len([o for o in new_up if o != CRUSH_ITEM_NONE]):
-                        items.pop()
-                        if not items:
-                            del self.pg_upmap_items[pg]
-                        continue
-                    row = np.full(len(up), CRUSH_ITEM_NONE, np.int32)
-                    row[: len(new_up)] = new_up
-                    up_cache[pg] = row
-                    pgs_by_osd[over].discard(pg)
-                    pgs_by_osd[under].add(pg)
-                    changed += 1
-                    moved = True
-                    break
-                if moved:
-                    break
-            if not moved:
-                break
-        if changed:
-            self.epoch += 1
-        return changed
+        result = balance.calc_pg_upmaps(
+            self,
+            max_deviation=max_deviation,
+            max_changes=max_changes,
+            pools=pools,
+        )
+        self.last_balance = result
+        return result.changes
 
 
 # -- incremental maps + encoding (OSDMap::Incremental, OSDMap.cc:encode) ------

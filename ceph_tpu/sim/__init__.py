@@ -1,0 +1,19 @@
+"""ceph_tpu.sim — the big-cluster placement simulator.
+
+The standing rig for exercising placement at reference scale without
+daemons: synthetic thousand-OSD maps with a host/rack hierarchy
+(cluster.build_cluster), deterministic seeded event scripts — OSD flaps
+out/in, reweights, map churn epochs — with per-epoch backfill-storm
+estimation, and batched-balancer convergence reporting
+(scenario.run_scenario). tools/psim.py is the CLI front.
+
+Everything is seeded and wall-clock free: the same seed produces a
+byte-identical report (timing fields appear only under measure=True),
+so tier-1 can assert on a mini scenario while the bench drives the
+1000-OSD / million-PG scale.
+"""
+
+from ceph_tpu.sim.cluster import build_cluster
+from ceph_tpu.sim.scenario import run_scenario
+
+__all__ = ["build_cluster", "run_scenario"]

@@ -1,0 +1,220 @@
+"""Batched balancer property tests (ceph_tpu.crush.balance).
+
+Three invariants anchor the batched path to the scalar spec:
+
+* placements: per-PG batched rows (OSDMap.pool_mappings) bit-match the
+  scalar pg_to_up_acting_osds oracle — before balancing, after
+  balancing, with pg_upmap_items and choose_args installed;
+* legality: every committed upmap preserves CRUSH's failure-domain
+  invariant (at most one replica per host under these rules) and never
+  duplicates an OSD in an up set;
+* progress: spread never worsens, the move budget is a hard cap, and a
+  generous budget converges the synthetic cluster to max_deviation.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import balance
+from ceph_tpu.crush.types import ChooseArg
+from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE
+from ceph_tpu.sim import build_cluster
+from ceph_tpu.sim.cluster import REP_RULE, TYPE_HOST
+
+
+def make_map(n_osd=32, rep=128, ec=64, **kw):
+    # geometries are deliberately repeated across tests: the batched
+    # mapper jit-compiles per map shape, so sharing shapes keeps the
+    # whole module inside a handful of compiles
+    return build_cluster(n_osd, rep_pg_num=rep, ec_pg_num=ec, **kw)
+
+
+def oracle_rows(m, pid):
+    """Per-PG up sets via the scalar pipeline, NONE-padded to pool.size."""
+    pool = m.pools[pid]
+    rows = np.full((pool.pg_num, pool.size), CRUSH_ITEM_NONE, np.int32)
+    for ps in range(pool.pg_num):
+        up, *_ = m.pg_to_up_acting_osds(pid, ps)
+        rows[ps, : len(up)] = up
+    return rows
+
+
+def assert_bitmatch(m):
+    for pid in m.pools:
+        got = np.asarray(m.pool_mappings(pid))
+        want = oracle_rows(m, pid)
+        assert np.array_equal(got, want), f"pool {pid} batched != oracle"
+
+
+def osd_host(m):
+    """osd -> host bucket id under the replicated rule (rule 0)."""
+    ruleno = m.find_rule(REP_RULE, m.pools[1].type, m.pools[1].size)
+    return balance.rule_failure_domains(m.crush, ruleno, m.max_osd)
+
+
+def install_choose_args(m, seed=11):
+    """Per-host weight_set rows (one position) so the compat path is on."""
+    rng = np.random.default_rng(seed)
+    for bid, b in m.crush.buckets.items():
+        if b.type != TYPE_HOST:
+            continue
+        m.crush.choose_args[bid] = ChooseArg(
+            weight_set=[
+                [int(rng.integers(0x8000, 2 * 0x10000))
+                 for _ in range(b.size)]
+            ]
+        )
+
+
+def skew_weights(m, seed=5):
+    """Uneven in-weights so the map starts measurably imbalanced."""
+    rng = np.random.default_rng(seed)
+    for o in range(0, m.max_osd, 3):
+        m.osd_weight[o] = int((0.4 + 0.5 * rng.random()) * 0x10000)
+
+
+def test_batched_counts_bitmatch_oracle_plain():
+    m = make_map()
+    assert_bitmatch(m)
+
+
+def test_batched_bitmatch_with_upmaps_and_choose_args():
+    # the full placement-stack layering: choose_args reweighting below,
+    # pg_upmap_items exceptions above — batched rows must still equal the
+    # scalar oracle PG for PG, on both pool kinds (8 hosts so even the
+    # 6-wide EC pool leaves a free host to remap into)
+    m = make_map(osds_per_host=4)
+    install_choose_args(m)
+    for pid in (1, 2):
+        rows = np.asarray(m.pool_mappings(pid))
+        host = osd_host(m)
+        installed = 0
+        for ps in range(m.pools[pid].pg_num):
+            members = [int(o) for o in rows[ps] if o != CRUSH_ITEM_NONE]
+            used = {int(host[o]) for o in members}
+            frm = members[0]
+            to = next(
+                (o for o in range(m.max_osd)
+                 if o not in members and int(host[o]) not in used),
+                None,
+            )
+            if to is None:
+                continue
+            m.pg_upmap_items[(pid, ps)] = [(frm, to)]
+            installed += 1
+            if installed >= 4:
+                break
+        assert installed
+    assert_bitmatch(m)
+
+
+def test_moves_are_crush_legal():
+    m = make_map()
+    skew_weights(m)
+    res = balance.calc_pg_upmaps(m, max_deviation=1.0, max_changes=64)
+    assert res.changes > 0
+    host = osd_host(m)
+    for (pid, ps), items in m.pg_upmap_items.items():
+        up, *_ = m.pg_to_up_acting_osds(pid, ps)
+        placed = [o for o in up if o != CRUSH_ITEM_NONE]
+        # no duplicate devices in the up set
+        assert len(set(placed)) == len(placed)
+        # the failure-domain invariant survives: one replica per host
+        hosts = [int(host[o]) for o in placed]
+        assert len(set(hosts)) == len(hosts), (pid, ps, placed)
+        # items on one PG can chain (an earlier `to` later remapped on);
+        # the net sources must be gone and the net targets present
+        frms = {i[0] for i in items}
+        tos = {i[1] for i in items}
+        for o in frms - tos:
+            assert o not in up
+        for o in tos - frms:
+            assert o in up
+    # the post-balance map still bit-matches the oracle
+    assert_bitmatch(m)
+
+
+def test_budget_is_hard_and_spread_never_worsens():
+    m = make_map()
+    skew_weights(m)
+    res = balance.calc_pg_upmaps(m, max_deviation=0.5, max_changes=7)
+    assert res.changes <= 7
+    assert res.spread_after <= res.spread_before
+
+
+def test_converges_with_generous_budget():
+    m = make_map(n_osd=32, rep=128, ec=0)
+    res = balance.calc_pg_upmaps(m, max_deviation=1.0, max_changes=4096)
+    assert res.spread_after <= 1.0 + 1e-9
+    assert res.launches > 0
+    assert res.launches < 4 * res.rounds * len(m.pools) + len(m.pools) + 64
+
+
+def test_launch_count_is_o_pools_not_o_pgs():
+    # the whole point: growing pg_num 4x must not grow launches 4x
+    small = make_map(n_osd=16, rep=64, ec=0)
+    big = make_map(n_osd=16, rep=256, ec=0)
+    r_small = balance.calc_pg_upmaps(small, max_changes=8)
+    r_big = balance.calc_pg_upmaps(big, max_changes=8)
+    assert r_big.launches <= 4 * max(1, r_small.launches)
+
+
+def test_scalar_and_batched_both_satisfy_oracle():
+    ma = make_map(n_osd=32, rep=128, ec=0)
+    mb = make_map(n_osd=32, rep=128, ec=0)
+    skew_weights(ma)
+    skew_weights(mb)
+    balance.calc_pg_upmaps(ma, max_changes=16)
+    balance.calc_pg_upmaps_scalar(mb, max_changes=16)
+    assert_bitmatch(ma)
+    assert_bitmatch(mb)
+    host = osd_host(mb)
+    for (pid, ps) in mb.pg_upmap_items:
+        up, *_ = mb.pg_to_up_acting_osds(pid, ps)
+        placed = [o for o in up if o != CRUSH_ITEM_NONE]
+        assert len(set(placed)) == len(placed)
+
+
+def test_empty_and_degenerate_maps():
+    m = make_map(n_osd=8, rep=0, ec=0)  # no pools
+    res = balance.calc_pg_upmaps(m)
+    assert res.changes == 0 and res.launches == 0
+    m = make_map(n_osd=8, rep=32, ec=0)
+    m.osd_weight[:] = 0  # nothing carries weight
+    res = balance.calc_pg_upmaps(m)
+    assert res.changes == 0
+
+
+def test_failure_domain_geometry():
+    m = make_map(n_osd=16, rep=32, ec=0, osds_per_host=4)
+    ruleno = m.find_rule(REP_RULE, m.pools[1].type, m.pools[1].size)
+    assert balance.rule_failure_domain_type(m.crush, ruleno) == TYPE_HOST
+    dom = balance.rule_failure_domains(m.crush, ruleno, m.max_osd)
+    # 4 osds per host, contiguous: same host id within, distinct across
+    for h in range(4):
+        block = dom[4 * h : 4 * h + 4]
+        assert (block == block[0]).all()
+        assert block[0] != -1
+    assert len({int(d) for d in dom}) == 4
+    dense = balance._dense_domains(dom)
+    assert set(dense) == {0, 1, 2, 3}
+
+
+@pytest.mark.parametrize("mode", ["firstn", "indep"])
+def test_moves_legal_on_both_pool_kinds(mode):
+    pid = 1 if mode == "firstn" else 2
+    m = make_map(n_osd=32, rep=128 if pid == 1 else 0,
+                 ec=0 if pid == 1 else 128)
+    skew_weights(m)
+    res = balance.calc_pg_upmaps(m, max_changes=32, pools={pid})
+    host = osd_host(m) if pid == 1 else balance.rule_failure_domains(
+        m.crush, m.find_rule(1, m.pools[2].type, m.pools[2].size), m.max_osd
+    )
+    for (p, ps) in m.pg_upmap_items:
+        assert p == pid
+        up, *_ = m.pg_to_up_acting_osds(p, ps)
+        placed = [o for o in up if o != CRUSH_ITEM_NONE]
+        hosts = [int(host[o]) for o in placed]
+        assert len(set(hosts)) == len(hosts)
+    if res.changes:
+        assert res.spread_after <= res.spread_before

@@ -8,7 +8,6 @@ import os
 import signal
 import subprocess
 import sys
-import time
 
 import pytest
 
@@ -291,6 +290,8 @@ while True:
     st.queue_transaction(t)
     if i == 3:
         print("warm", flush=True)
+    if i == 400:
+        print("storm", flush=True)
 """
 
 
@@ -307,7 +308,11 @@ def test_kill9_mid_transaction_reopens_consistent(tmp_path):
     try:
         line = proc.stdout.readline()  # first txns committed
         assert b"warm" in line, proc.stderr.read().decode()
-        time.sleep(0.5)  # let it race through the write/remove loop
+        # event-driven: wait for the child to report 400 transactions
+        # through the write/remove loop, then kill it mid-stream — the
+        # loop keeps racing past the marker until the signal lands
+        line = proc.stdout.readline()
+        assert b"storm" in line, proc.stderr.read().decode()
     finally:
         proc.send_signal(signal.SIGKILL)
         proc.wait(timeout=30)
@@ -315,7 +320,7 @@ def test_kill9_mid_transaction_reopens_consistent(tmp_path):
     st = BlockStore(FileDB(path))
     assert st.fsck(deep=True) == []
     names = st.list_objects("c")
-    assert names, "no object survived a 0.5s write storm"
+    assert names, "no object survived a 400-txn write storm"
     for name in names:
         data = st.read("c", name)
         ver = st.getattrs("c", name).get("ver")

@@ -250,10 +250,10 @@ def test_background_flusher_drains_backlog_by_age():
             Transaction().write("c", f"s{i}", bytes([i + 1]) * 100)
         )
     assert st._flusher is not None and st._flusher.is_alive()
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline and list(st.db.iterate(_DEFER)):
-        time.sleep(0.02)
-    assert list(st.db.iterate(_DEFER)) == [], "aging flush never fired"
+    # event-driven: the flusher sets the drained event when the last WAL
+    # row commits — no polling
+    assert st.wait_deferred_drained(10), "aging flush never fired"
+    assert list(st.db.iterate(_DEFER)) == []
     d = st.perf.dump()
     assert d["deferred_flush_aged"] >= 1
     assert d["deferred_flush_ops"] >= 1
