@@ -467,6 +467,31 @@ def _fleet_line() -> None:
             "restore_sharded_gbps": r["restore_sharded_gbps"],
             "sharded_speedup": r["sharded_speedup"],
         }))
+        # mesh-native fleet-parallel save: N real writer processes,
+        # each putting only its slab-aligned shards, vs the N-host
+        # single-committer baseline (remote shards gathered through
+        # the store, one host serializing + putting every byte)
+        out = subprocess.run(
+            [sys.executable, "tools/fleet_tool.py", "bench",
+             "--parallel-save",
+             "--hosts", os.environ.get(
+                 "CEPH_TPU_BENCH_PSAVE_HOSTS", "3"),
+             "--mb", os.environ.get("CEPH_TPU_BENCH_PSAVE_MB", "48")],
+            capture_output=True, timeout=600, check=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        r = json.loads(out.stdout.strip().splitlines()[-1])
+        print(json.dumps({
+            "metric": "fleet_parallel_save",
+            "value": r["parallel_save_speedup"],
+            "unit": "x",
+            "parallel_save_speedup": r["parallel_save_speedup"],
+            "peak_host_bytes_frac": r["peak_host_bytes_frac"],
+            "hosts": r["hosts"],
+            "bytes": r["bytes"],
+            "single_save_s": r["single_save_s"],
+            "parallel_save_s": r["parallel_save_s"],
+        }))
     except Exception:  # noqa: BLE001 - strictly best-effort
         pass
 

@@ -110,6 +110,21 @@ async def collect(
         retained.add(head_id)
     pinned = retained | set(keep)
 
+    # a fleet-parallel save between its staging CAS and the leader's
+    # HEAD CAS has live chunks with no manifest: the staging record
+    # auto-pins that save_id so a concurrent gc can never reclaim
+    # another rank's uncommitted put_chunks output. A stale `staged`
+    # record (leader died before flipping it) over-pins harmlessly —
+    # the next successful save CASes it away.
+    try:
+        staging = json.loads(
+            (await ioctx.read(layout.staging_object(name))).decode()
+        )
+        if staging.get("state") == "staged" and staging.get("save_id"):
+            pinned.add(staging["save_id"])
+    except (ObjectNotFound, ValueError):
+        pass
+
     # reachability: chunks ANY retained/pinned manifest references stay
     # live, even when their owning save_id is being reclaimed (dedup)
     reachable: set[str] = set()

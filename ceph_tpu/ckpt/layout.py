@@ -25,6 +25,9 @@ from ceph_tpu.rados.striper import object_name
 FORMAT = 1
 #: replicated pools have no stripe constraint; align to the allocator page
 MIN_ALIGN = 4096
+#: the fleet mesh axis name (coord/mesh.py builds meshes with this axis;
+#: specs naming it on axis 0 slab-align the parallel-save chunk cuts)
+FLEET_AXIS = "fleet"
 
 try:  # the container ships xxhash; blake2b keeps the layout importable
     import xxhash as _xxhash
@@ -103,6 +106,23 @@ def head_object(name: str) -> str:
     return f"{name}.ckpt-head"
 
 
+def staging_object(name: str) -> str:
+    """The fleet-parallel save's staging record: a HEAD-CAS document
+    (same cls guard as the commit point) naming the in-flight save_id,
+    its ordered writer set and dedup parent. gc pins whatever it says
+    is `staged` so concurrent gc never reclaims a rank's uncommitted
+    chunks mid-parallel-save."""
+    return f"{name}.ckpt-staging"
+
+
+def rank_meta_object(name: str, save_id: str, rank: int) -> str:
+    """Rank `rank`'s per-save completion record: the chunk fields
+    (hash/crc/stored/compressed/reused/object) for the chunks that rank
+    owned, merged into the manifest by the leader after the arrival
+    barrier."""
+    return f"{save_soid(name, save_id)}.rank-{rank:04d}"
+
+
 def save_soid(name: str, save_id: str) -> str:
     return f"{name}@{save_id}"
 
@@ -135,6 +155,73 @@ def chunk_bytes(target: int, alignment: int) -> int:
     """Round the configured chunk target UP to the pool alignment."""
     target = max(int(target), 1)
     return ((target + alignment - 1) // alignment) * alignment
+
+
+# -- fleet-parallel slab math --------------------------------------------------
+#
+# jax shards an axis of n rows over N mesh devices in ceil(n/N) slabs
+# (GSPMD padding convention) — NamedSharding.addressable_devices_indices_map
+# is the ground truth and parallel/sharding.device_slices exposes it. The
+# chunk cutter must agree exactly, so each chunk of a fleet-sharded array
+# falls inside ONE rank's slab (exactly one writer, zero-reassembly
+# restore); fleet_slab() is that convention as pure math, and the tier-1
+# units assert it against device_slices on a live fleet mesh.
+
+
+def fleet_slab(n: int, num_hosts: int, rank: int) -> slice:
+    """Rank `rank`'s row slab of an axis of `n` rows sharded over
+    `num_hosts` fleet positions, in jax's ceil-div convention (the last
+    ranks may run short or empty when num_hosts does not divide n)."""
+    if num_hosts <= 0:
+        raise ValueError("num_hosts must be positive")
+    if not 0 <= rank < num_hosts:
+        raise ValueError(f"rank {rank} outside [0, {num_hosts})")
+    shard = -(-n // num_hosts) if n else 0
+    return slice(min(n, rank * shard), min(n, (rank + 1) * shard))
+
+
+def fleet_sharded(entry, nrows: int, num_hosts: int) -> bool:
+    """Does this leading-axis spec entry shard over the fleet axis?"""
+    if num_hosts <= 1 or nrows <= 0:
+        return False
+    if isinstance(entry, (tuple, list)):
+        return FLEET_AXIS in entry and len(entry) == 1
+    return entry == FLEET_AXIS
+
+
+def writer_regions(
+    arrays: list[dict], num_hosts: int,
+) -> list[tuple[int, int, int | None]]:
+    """Partition the serialized stream into (start, end, writer) regions:
+    each fleet-sharded array contributes one region per rank slab (that
+    rank is the sole writer), everything else pools into writer=None
+    regions whose chunks round-robin across ranks. Regions are disjoint,
+    exhaustive, and sorted; empty slabs are dropped."""
+    regions: list[tuple[int, int, int | None]] = []
+
+    def emit(start: int, end: int, writer: int | None) -> None:
+        if end <= start:
+            return
+        if (writer is None and regions and regions[-1][2] is None
+                and regions[-1][1] == start):
+            regions[-1] = (regions[-1][0], end, None)
+            return
+        regions.append((start, end, writer))
+
+    for a in arrays:
+        spec = a.get("spec")
+        shape = a["shape"]
+        nrows = int(shape[0]) if shape else 0
+        if (spec and shape
+                and fleet_sharded(spec[0], nrows, num_hosts)):
+            row = a["nbytes"] // nrows
+            for r in range(num_hosts):
+                sl = fleet_slab(nrows, num_hosts, r)
+                emit(a["offset"] + sl.start * row,
+                     a["offset"] + sl.stop * row, r)
+        else:
+            emit(a["offset"], a["offset"] + a["nbytes"], None)
+    return regions
 
 
 # -- pytree <-> flat paths ----------------------------------------------------
@@ -248,9 +335,20 @@ def build_manifest(
     chunk_size: int,
     compress: str = "",
     parent: str | None = None,
+    writers: int = 0,
 ) -> dict:
     """The array table + chunk table (crc/stored fields filled by the
-    writer as chunks go out)."""
+    writer as chunks go out).
+
+    `writers=0` (the single-committer path) cuts the stream at every
+    `chunk_size` boundary, exactly as always. `writers=N` is the
+    fleet-parallel layout: the stream is FIRST cut at shard slab
+    boundaries (writer_regions) so each chunk lies inside one rank's
+    slab, THEN every `chunk_size` within a region; each chunk carries a
+    `writer` rank (slab regions: the slab's rank; replicated regions:
+    round-robin). Pure and deterministic, so every rank computes the
+    SAME manifest locally from the staging record — nothing but the
+    save_id travels between hosts before the chunks themselves."""
     arrays, offset = [], 0
     for r in records:
         nbytes = int(np.dtype(r["dtype"]).itemsize * int(np.prod(r["shape"], dtype=np.int64)))
@@ -264,21 +362,32 @@ def build_manifest(
         })
         offset += nbytes
     stream = offset
-    n_chunks = (stream + chunk_size - 1) // chunk_size if stream else 0
+
+    def cuts():
+        if writers <= 0:
+            for off in range(0, stream, chunk_size):
+                yield off, min(chunk_size, stream - off), None
+            return
+        for start, end, writer in writer_regions(arrays, writers):
+            for off in range(start, end, chunk_size):
+                yield off, min(chunk_size, end - off), writer
+
     chunks = []
-    for i in range(n_chunks):
-        off = i * chunk_size
-        chunks.append({
+    for i, (off, length, writer) in enumerate(cuts()):
+        chunk = {
             "object": chunk_object_name(name, save_id, i),
             "offset": off,
-            "length": min(chunk_size, stream - off),
+            "length": length,
             "crc": None,        # crc32c of the uncompressed payload
             "stored": None,     # bytes on the wire (== length uncompressed)
             "compressed": False,
             "hash": None,       # chunk_fingerprint of the payload
             "reused": False,    # True: `object` lives in a prior save
-        })
-    return {
+        }
+        if writers > 0:
+            chunk["writer"] = i % writers if writer is None else writer
+        chunks.append(chunk)
+    manifest = {
         "format": FORMAT,
         "name": name,
         "save_id": save_id,
@@ -289,6 +398,9 @@ def build_manifest(
         "arrays": arrays,
         "chunks": chunks,
     }
+    if writers > 0:
+        manifest["writers"] = writers
+    return manifest
 
 
 def encode_manifest(manifest: dict) -> bytes:

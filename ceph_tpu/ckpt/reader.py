@@ -21,6 +21,7 @@ reused chunk's `object` already names the save that stored the bytes.
 from __future__ import annotations
 
 import asyncio
+import bisect
 import json
 
 import numpy as np
@@ -161,6 +162,8 @@ class CkptReader:
         # placement target: one preallocated buffer, filled per chunk
         # as its read lands (no read-then-place barrier, no join copy)
         buf = bytearray(manifest["stream_bytes"])
+        if self.perf is not None:
+            self.perf.inc("restore_host_bytes", manifest["stream_bytes"])
         inflight = 0
 
         async def get(chunk):
@@ -200,12 +203,18 @@ class CkptReader:
     ) -> bytes:
         """`length` bytes at stream `offset`, spliced across chunks with
         partial object reads (the fewer-bytes fast path). Compressed
-        chunks cannot be ranged — they fetch whole, once, via `cache`."""
-        chunk_size = manifest["chunk_bytes"]
+        chunks cannot be ranged — they fetch whole, once, via `cache`.
+        Chunk lookup bisects the offset table (cached per manifest):
+        fleet-parallel manifests cut chunks at shard slab boundaries, so
+        chunk lengths are NOT uniform."""
         chunks = manifest["chunks"]
+        offs = manifest.get("_chunk_offs")
+        if offs is None:
+            # read-side cache only; never serialized back
+            offs = manifest["_chunk_offs"] = [c["offset"] for c in chunks]
         out = []
         while length > 0:
-            ci = offset // chunk_size
+            ci = bisect.bisect_right(offs, offset) - 1
             chunk = chunks[ci]
             off_in = offset - chunk["offset"]
             take = min(length, chunk["length"] - off_in)
@@ -245,6 +254,12 @@ class CkptReader:
         cache = cache if cache is not None else {}
         dtype = np.dtype(a["dtype"])
         runs = slice_byte_runs(a["shape"], dtype.itemsize, idx)
+        if self.perf is not None:
+            # host-resident bytes this slab materializes: the counter
+            # the zero-reassembly bound is verified against (shard
+            # bytes, not full-array bytes)
+            self.perf.inc("restore_host_bytes",
+                          sum(r[1] for r in runs))
         parts = await asyncio.gather(*(
             self._read_range(
                 manifest, a["offset"] + off, length, window, cache

@@ -38,6 +38,11 @@ class CkptStore:
             "logical bytes those reused chunks would have re-uploaded",
         )
         p.add_u64_counter("save_commits", "HEAD CAS commits")
+        p.add_u64_counter(
+            "save_prepared_bytes",
+            "host bytes serialized for saves (a fleet-parallel rank "
+            "prepares only its owned chunks: ≈ tree_bytes / num_hosts)",
+        )
         p.add_u64_counter("save_async_submits", "save_async() snapshots")
         p.add_u64(
             "save_async_pending_peak",
@@ -54,6 +59,11 @@ class CkptStore:
             "restore_read_bytes",
             "bytes actually fetched from RADOS (partial-read savings "
             "show up here)",
+        )
+        p.add_u64_counter(
+            "restore_host_bytes",
+            "host bytes materialized by restores (a mesh restore is "
+            "bounded by this host's shard bytes, never the full tree)",
         )
         p.add_u64_counter("gc_removed", "orphaned objects reclaimed")
         p.add_u64("inflight_peak", "peak concurrent chunk ops")

@@ -9,16 +9,24 @@ Chubby recipe (locks/leases/elections layered on a consistent core)
 with RADOS as the core, exactly how the reference's cls_lock serves
 RBD exclusive-lock and RGW.
 
-Layer 2 (`coord.fleet` + `coord.driver`) is the training-side fleet
-runtime: rank registration against a HEAD-CAS-published roster object,
-heartbeat leases, leader election, epoch-numbered barriers, and the
-driver that wires it to CkptStore (exactly-one-committer saves,
-per-rank sharded restore) and the data iterator (roster-derived
-strided slices that re-partition exactly on membership change).
+Layer 2 (`coord.fleet` + `coord.driver` + `coord.mesh`) is the
+training-side fleet runtime: rank registration against a
+HEAD-CAS-published roster object, heartbeat leases, leader election,
+epoch-numbered barriers (with sub-group barriers for pipeline stages
+and per-save writer sets), a Mesh + NamedSharding view of the roster
+(`coord.mesh`), and the driver that wires it all to CkptStore
+(fleet-parallel saves where every host writes only its shards,
+mesh-native zero-reassembly restore) and the data iterator
+(roster-derived strided slices that re-partition exactly on
+membership change).
 """
 
 from ceph_tpu.coord.driver import FleetDriver
 from ceph_tpu.coord.fleet import Fleet
 from ceph_tpu.coord.lock import Lock, make_coord_perf
+from ceph_tpu.coord.mesh import fleet_mesh, fleet_spec, from_fleet, shard_tree
 
-__all__ = ["Fleet", "FleetDriver", "Lock", "make_coord_perf"]
+__all__ = [
+    "Fleet", "FleetDriver", "Lock", "make_coord_perf",
+    "fleet_mesh", "fleet_spec", "from_fleet", "shard_tree",
+]

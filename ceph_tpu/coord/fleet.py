@@ -249,21 +249,35 @@ class Fleet:
 
     # -- barriers --------------------------------------------------------------
 
-    def _barrier_obj(self, epoch: int) -> str:
+    def _barrier_obj(self, epoch: int, tag: str | None = None) -> str:
+        if tag is not None:
+            return f"fleet.{self.name}.barrier.{tag}.{epoch}"
         return f"fleet.{self.name}.barrier.{epoch}"
 
     async def barrier(self, *, timeout: float | None = None,
-                      epoch: int | None = None) -> int:
+                      epoch: int | None = None,
+                      members: list[str] | None = None,
+                      tag: str | None = None) -> int:
         """Arrive at the epoch barrier and wait until every LIVE member
-        has arrived. Returns the epoch number passed."""
+        has arrived. Returns the epoch number passed.
+
+        `members` restricts the barrier to an explicit SUB-GROUP: it
+        completes when (members ∩ live) ⊆ arrived, so pipeline stages
+        (or a parallel save's writer set) barrier independently of the
+        full roster, and a sub-group member dying still releases the
+        survivors via the usual eviction shrink. `tag` namespaces the
+        barrier object (e.g. one per save_id) without consuming the
+        fleet-wide epoch counter."""
         if epoch is None:
-            epoch = self._barrier_epoch
-        self._barrier_epoch = epoch + 1
-        obj = self._barrier_obj(epoch)
+            epoch = 0 if tag is not None else self._barrier_epoch
+        if tag is None:
+            self._barrier_epoch = epoch + 1
+        obj = self._barrier_obj(epoch, tag)
         span = self.tracer.start(
             "coord_barrier",
             tags={"fleet": self.name, "epoch": epoch,
-                  "host": self.host_id},
+                  "host": self.host_id,
+                  **({"tag": tag} if tag is not None else {})},
             op_type="coord_barrier",
         )
         t0 = time.monotonic()
@@ -297,14 +311,25 @@ class Fleet:
             poll = float(self.config.get("coord_barrier_poll"))
             stragglers: set = set()
             while True:
-                info = await self.ioctx.exec(
-                    obj, "lock", "get_info", {"name": "arrive"}
-                )
-                arrived = {h["cookie"] for h in info["holders"]}
-                live = await self.live_members()
-                if live and set(live) <= arrived:
+                try:
+                    info = await self.ioctx.exec(
+                        obj, "lock", "get_info", {"name": "arrive"}
+                    )
+                    arrived = {h["cookie"] for h in info["holders"]}
+                except RadosError:
+                    arrived = set()
+                if self.host_id not in arrived:
+                    # our arrival persists (lease=0) until the object is
+                    # groomed, and grooming happens strictly AFTER the
+                    # barrier completed — racing in behind the groom IS
+                    # completion, not a straggle
                     break
-                stragglers = set(live) - arrived
+                live = await self.live_members()
+                want = (set(live) if members is None
+                        else set(members) & set(live))
+                if want and want <= arrived:
+                    break
+                stragglers = want - arrived
                 await self._maintain()  # evictions shrink `live`
                 wake.clear()
                 wait = poll
@@ -333,7 +358,7 @@ class Fleet:
             # epochs back — out of every live host's reach
             if self.is_leader:
                 await self.sweep()
-                if epoch >= 2:
+                if tag is None and epoch >= 2:
                     try:
                         await self.ioctx.remove(
                             self._barrier_obj(epoch - 2)

@@ -1,0 +1,93 @@
+"""coord.mesh: Mesh + NamedSharding bootstrap from the live Fleet
+roster — the fleet-side analogue of `jax.distributed.initialize`.
+
+The roster document (coord/fleet.py) already gives every host the same
+sorted live-member list, so every host derives the same 1-D mesh over
+the axis `"fleet"`: position r on the mesh IS roster rank r. On a real
+pod each mesh position is a different host's devices; in tests and the
+single-process simulator the positions are the virtual CPU devices of
+tests/conftest.py, which is exactly what lets tier-1 assert the
+chunk-cut/slab agreement (`layout.fleet_slab` vs `device_slices`)
+without hardware.
+
+`shard_tree` is the SNIPPETS.md [3] idiom: leading-axis sharding when
+the axis divides the fleet, replication otherwise — the shape the
+fleet-parallel save path (coord/driver.py) slab-aligns its chunk cuts
+around.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.ckpt.layout import FLEET_AXIS
+
+
+def fleet_mesh(num_hosts: int, *, devices=None):
+    """A 1-D (`fleet`,) mesh over `num_hosts` positions. `devices`
+    defaults to the first num_hosts local jax devices (the simulator /
+    test arrangement; a real fleet passes its per-host device list)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if num_hosts <= 0:
+        raise ValueError("num_hosts must be positive")
+    if len(devices) < num_hosts:
+        raise ValueError(
+            f"fleet of {num_hosts} needs {num_hosts} devices, "
+            f"have {len(devices)}"
+        )
+    return Mesh(np.array(devices[:num_hosts]), (FLEET_AXIS,))
+
+
+def fleet_spec(shape, num_hosts: int):
+    """The PartitionSpec a fleet of `num_hosts` gives an array of
+    `shape`: leading axis sharded over `fleet` when it divides evenly
+    (the SNIPPETS.md [2] rule), replicated otherwise."""
+    from jax.sharding import PartitionSpec as P
+
+    shape = tuple(shape)
+    if (num_hosts > 1 and shape and shape[0] >= num_hosts
+            and shape[0] % num_hosts == 0):
+        return P(FLEET_AXIS)
+    return P()
+
+
+def shard_tree(tree, mesh):
+    """device_put every leaf onto the fleet mesh under fleet_spec —
+    the input shape FleetDriver.save_async slab-aligns around."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    num_hosts = mesh.shape[FLEET_AXIS]
+
+    def place(leaf):
+        arr = np.asarray(leaf)
+        return jax.device_put(
+            arr, NamedSharding(mesh, fleet_spec(arr.shape, num_hosts))
+        )
+
+    return jax.tree_util.tree_map(place, tree)
+
+
+def rank_slab(shape, spec, mesh, rank: int):
+    """Roster rank `rank`'s index-tuple of an array sharded as `spec`
+    on the fleet mesh — straight from jax's own
+    addressable_devices_indices_map (parallel/sharding.device_slices),
+    the ground truth the chunk cutter's `layout.fleet_slab` math must
+    agree with."""
+    from ceph_tpu.parallel.sharding import device_slices
+
+    idx_map = device_slices(tuple(shape), spec, mesh)
+    dev = mesh.devices.flat[rank]
+    return idx_map[dev]
+
+
+async def from_fleet(fleet):
+    """(mesh, rank, num_hosts) for the CURRENT live roster. Every live
+    host computes the same mesh from the same roster read; elastic
+    reshard is just calling this again after the roster changed."""
+    rank, num_hosts = await fleet.rank()
+    return fleet_mesh(num_hosts), rank, num_hosts

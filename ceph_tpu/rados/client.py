@@ -171,18 +171,22 @@ class Objecter(Dispatcher):
             cb = self._watches.get(
                 (p["pool"], p["name"], p.get("cookie", ""))
             )
-            if cb is not None:
-                try:
+            # ack even with no callback registered (cookie already
+            # unwatched locally): the OSD awaits acks from every watcher
+            # it fanned out to, so a dropped ack stalls the NOTIFIER for
+            # the whole notify timeout
+            try:
+                if cb is not None:
                     cb(p["name"], p.get("payload", ""))
-                finally:
-                    conn.send_message(
-                        Message(
-                            type="notify_ack",
-                            payload={"notify_id": p["notify_id"],
-                                     "watcher": self.name,
-                                     "cookie": p.get("cookie", "")},
-                        )
+            finally:
+                conn.send_message(
+                    Message(
+                        type="notify_ack",
+                        payload={"notify_id": p["notify_id"],
+                                 "watcher": self.name,
+                                 "cookie": p.get("cookie", "")},
                     )
+                )
 
     def _rewatch_on_map(self, _osdmap) -> None:
         """Re-register every watch whose primary moved (the linger-op

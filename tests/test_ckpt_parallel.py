@@ -1,0 +1,464 @@
+"""Mesh-native fleet-parallel checkpoint IO, deterministic tier
+(ISSUE 14 tentpole).
+
+The chunk-cut/slab agreement (layout.fleet_slab vs jax's own
+addressable_devices_indices_map), the exactly-one-writer chunk
+assignment, the per-rank dedup-merge protocol, the collective
+save/restore round-trip over an in-process 3-host fleet, the
+abort-on-dead-writer guarantee (HEAD never moves), follower->leader
+takeover, and the gc-vs-staged-save race — all with NO wall-clock
+sleeps: crashes are simulated by dropping heartbeat leases, and every
+wait rides the protocol's own watch/notify paths.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ckpt import gc as ckpt_gc
+from ceph_tpu.ckpt import layout
+from ceph_tpu.ckpt.store import CkptStore
+from ceph_tpu.ckpt.writer import CkptAborted, CkptWriter
+from ceph_tpu.coord import FleetDriver
+from ceph_tpu.coord import mesh as coord_mesh
+from tests.test_cluster_live import REP_POOL
+from tests.test_coord import HOSTS, make_fleet, run, start_cluster
+
+
+# -- slab math vs jax ground truth (pure) -------------------------------------
+
+def test_fleet_slab_matches_device_slices():
+    """layout.fleet_slab IS jax's GSPMD ceil-div convention: for every
+    (rows, fleet-size) combination the pure math must agree with
+    NamedSharding.addressable_devices_indices_map on a live mesh —
+    fleet_spec only shards axes the fleet divides (jax refuses uneven
+    NamedShardings), so the live comparison runs on divisible shapes;
+    the ceil-div edge cases stay covered as pure math."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(layout.FLEET_AXIS)
+    for n, hosts in [(192, 3), (6, 3), (16, 2), (16, 4), (8, 8)]:
+        mesh = coord_mesh.fleet_mesh(hosts)
+        for r in range(hosts):
+            idx = coord_mesh.rank_slab((n, 4), spec, mesh, r)
+            assert idx[0] == layout.fleet_slab(n, hosts, r), (n, hosts, r)
+    # exhaustive cover, in rank order — uneven splits included
+    for n, hosts in [(10, 3), (3, 8), (0, 2), (7, 8), (5, 2), (9, 4)]:
+        slabs = [layout.fleet_slab(n, hosts, r) for r in range(hosts)]
+        rows = [i for s in slabs for i in range(s.start, s.stop)]
+        assert rows == list(range(n)), (n, hosts)
+    with pytest.raises(ValueError):
+        layout.fleet_slab(8, 0, 0)
+    with pytest.raises(ValueError):
+        layout.fleet_slab(8, 2, 2)
+
+
+def test_writer_regions_disjoint_exhaustive_slab_aligned():
+    mesh = coord_mesh.fleet_mesh(3)
+    tree = {
+        "w": np.arange(192 * 16, dtype=np.float32).reshape(192, 16),
+        "b": np.arange(7, dtype=np.float32),        # replicated (7 % 3)
+        "v": np.arange(24, dtype=np.int32).reshape(6, 4),  # sharded 2/2/2
+    }
+    recs = layout.flatten_tree(coord_mesh.shard_tree(tree, mesh))
+    manifest = layout.build_manifest(
+        "m", "sid", recs, chunk_size=1 << 20, writers=3
+    )
+    regions = layout.writer_regions(manifest["arrays"], 3)
+    # disjoint + exhaustive over the whole stream, sorted
+    pos = 0
+    for start, end, _writer in regions:
+        assert start == pos and end > start
+        pos = end
+    assert pos == manifest["stream_bytes"]
+    # each fleet-sharded array contributes exactly its rank slabs
+    by_writer = {}
+    for start, end, writer in regions:
+        by_writer.setdefault(writer, []).append((start, end))
+    for a in manifest["arrays"]:
+        nrows = a["shape"][0] if a["shape"] else 0
+        if not (a["spec"] and layout.fleet_sharded(a["spec"][0], nrows, 3)):
+            continue
+        row = a["nbytes"] // nrows
+        for r in range(3):
+            sl = layout.fleet_slab(nrows, 3, r)
+            span = (a["offset"] + sl.start * row,
+                    a["offset"] + sl.stop * row)
+            assert span in by_writer[r], (a["path"], r)
+    # the replicated leaf pools into writer=None regions
+    assert None in by_writer
+
+
+def test_manifest_chunk_assignment_one_writer_per_chunk():
+    """writers=N chunk table: every chunk carries exactly one writer,
+    chunks of a fleet-sharded array never straddle a slab boundary, and
+    the writer of every slab chunk is the rank jax says owns those rows
+    (device_slices ground truth). Deterministic across rebuilds."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = coord_mesh.fleet_mesh(3)
+    tree = {
+        "w": np.arange(192 * 16, dtype=np.float32).reshape(192, 16),
+        "b": np.arange(7, dtype=np.float32),
+    }
+    sharded = coord_mesh.shard_tree(tree, mesh)
+    m = layout.build_manifest(
+        "m", "sid", layout.flatten_tree(sharded),
+        chunk_size=1000, writers=3,
+    )
+    chunks = m["chunks"]
+    assert m["writers"] == 3
+    # disjoint + exhaustive cuts
+    pos = 0
+    for c in chunks:
+        assert c["offset"] == pos
+        pos += c["length"]
+    assert pos == m["stream_bytes"]
+    assert all(0 <= c["writer"] < 3 for c in chunks)
+    for a in m["arrays"]:
+        nrows = a["shape"][0] if a["shape"] else 0
+        if not (a["spec"] and layout.fleet_sharded(a["spec"][0], nrows, 3)):
+            continue
+        row = a["nbytes"] // nrows
+        for r in range(3):
+            sl = coord_mesh.rank_slab(
+                a["shape"], P(layout.FLEET_AXIS), mesh, r
+            )[0]
+            lo = a["offset"] + sl.start * row
+            hi = a["offset"] + sl.stop * row
+            inside = [c for c in chunks
+                      if c["offset"] < hi and c["offset"] + c["length"] > lo]
+            assert inside, (a["path"], r)
+            for c in inside:  # slab-aligned AND written by that rank
+                assert lo <= c["offset"] and c["offset"] + c["length"] <= hi
+                assert c["writer"] == r
+    # every rank computes the SAME manifest locally — nothing but the
+    # save_id needs to travel before the chunks themselves
+    m2 = layout.build_manifest(
+        "m", "sid", layout.flatten_tree(sharded),
+        chunk_size=1000, writers=3,
+    )
+    assert json.dumps(m, sort_keys=True) == json.dumps(m2, sort_keys=True)
+    # the single-committer layout is untouched: no writer fields
+    m0 = layout.build_manifest(
+        "m", "sid", layout.flatten_tree(sharded), chunk_size=1000
+    )
+    assert "writers" not in m0
+    assert all("writer" not in c for c in m0["chunks"])
+
+
+# -- per-rank dedup merge (pure) ----------------------------------------------
+
+class _Cfg:
+    def get(self, key):
+        return {"ckpt_compression_algorithm": "",
+                "ckpt_chunk_target_bytes": 512,
+                "ckpt_incremental": False}.get(key, 0)
+
+
+def _rank_writer(tree, num_hosts, rank):
+    w = CkptWriter(None, "m", tree, save_id="sid0", config=_Cfg())
+    w.rank = rank
+    w._records = layout.flatten_tree(tree)
+    w.manifest = layout.build_manifest(
+        "m", "sid0", w._records, chunk_size=512, writers=num_hosts
+    )
+    return w
+
+
+def _rank_meta(w):
+    own = w.owned_chunks()
+    w._fingerprint([c for _, c in own])
+    return {
+        "save_id": w.save_id, "rank": w.rank,
+        "chunks": {str(i): {f: c[f] for f in w._META_FIELDS}
+                   for i, c in own},
+    }
+
+
+def test_merge_rank_meta_folds_fields_and_aborts_on_gap():
+    tree = {"w": np.arange(512, dtype=np.float32)}  # 2048 B -> 4 chunks
+    w0 = _rank_writer(tree, 2, 0)
+    w1 = _rank_writer(tree, 2, 1)
+    assert {i for i, _ in w0.owned_chunks()}.isdisjoint(
+        {i for i, _ in w1.owned_chunks()})
+    leader = _rank_writer(tree, 2, 0)
+    leader.merge_rank_meta([_rank_meta(w0), _rank_meta(w1)])
+    assert all(c["crc"] is not None and c["hash"] is not None
+               for c in leader.manifest["chunks"])
+    # rank-local fingerprints survive the merge bit-exactly
+    for i, c in w1.owned_chunks():
+        assert leader.manifest["chunks"][i]["hash"] == c["hash"]
+    # a dead writer = a gap in the chunk table = abort, never commit
+    leader2 = _rank_writer(tree, 2, 0)
+    with pytest.raises(CkptAborted, match="no[ \n]+writer record"):
+        leader2.merge_rank_meta([_rank_meta(w0)])
+
+
+# -- collective save / restore over an in-process fleet -----------------------
+
+async def _fleet_drivers(cluster, hosts=HOSTS):
+    out = []
+    for h in hosts:
+        rados, fleet = await make_fleet(cluster, h)
+        await fleet.join()
+        store = CkptStore(rados.io_ctx(REP_POOL), "model")
+        out.append((rados, FleetDriver(fleet, ckpt=store)))
+    return out
+
+
+def test_parallel_save_restore_roundtrip_and_dedup():
+    async def main():
+        cluster, admin = await start_cluster()
+        handles = await _fleet_drivers(cluster)
+        drivers = [d for _, d in handles]
+        assert await drivers[0].fleet.elect()
+
+        mesh = coord_mesh.fleet_mesh(3)
+        tree = {
+            "w": np.arange(192 * 16, dtype=np.float32).reshape(192, 16),
+            "b": np.arange(16, dtype=np.float32),
+        }
+        tree_bytes = sum(a.nbytes for a in tree.values())
+        sharded = coord_mesh.shard_tree(tree, mesh)
+
+        saves = [await d.save_async(sharded, timeout=60) for d in drivers]
+        sids = await asyncio.gather(*(s.wait() for s in saves))
+        assert len(set(sids)) == 1  # ONE collective save, all ranks
+        assert [s.leader for s in saves].count(True) == 1
+
+        # every host serialized only ≈ tree/N — the perf-counter-backed
+        # peak-host-bytes acceptance bound (<= 0.6x the full tree)
+        for _, d in handles:
+            prepared = d.ckpt.perf_dump()["save_prepared_bytes"]
+            assert 0 < prepared <= 0.6 * tree_bytes, prepared
+
+        # mesh-native restore: bit-exact, chunks -> slabs, no host-side
+        # full-array reassembly
+        restored = await drivers[1].restore_mesh()
+        np.testing.assert_array_equal(np.asarray(restored["w"]), tree["w"])
+        np.testing.assert_array_equal(np.asarray(restored["b"]), tree["b"])
+
+        # one rank's working set: its slab of w + the replicated b,
+        # fetched via ranged reads bounded by shard bytes (no full tree)
+        before = drivers[2].ckpt.perf_dump()["restore_host_bytes"]
+        shards = await drivers[2].restore_rank_shards()
+        block, idx = shards["w"]
+        assert idx[0] == layout.fleet_slab(192, 3, 2)
+        np.testing.assert_array_equal(block, tree["w"][idx[0]])
+        fetched = drivers[2].ckpt.perf_dump()["restore_host_bytes"] - before
+        shard_bytes = tree["w"][idx[0]].nbytes + tree["b"].nbytes
+        assert fetched <= 2 * shard_bytes, (fetched, shard_bytes)
+        assert fetched < tree_bytes
+
+        # second collective save mutates one leaf: the untouched slabs
+        # dedup rank-locally and the leader's merged manifest agrees
+        tree2 = dict(tree, b=tree["b"] + 1)
+        sharded2 = coord_mesh.shard_tree(tree2, mesh)
+        saves = [await d.save_async(sharded2, timeout=60) for d in drivers]
+        (sid2,) = set(await asyncio.gather(*(s.wait() for s in saves)))
+        manifest = await drivers[0].ckpt.reader().read_manifest(sid2)
+        reused = [c for c in manifest["chunks"] if c["reused"]]
+        assert reused and len(reused) < len(manifest["chunks"])
+        restored = await drivers[0].restore_mesh()
+        np.testing.assert_array_equal(np.asarray(restored["b"]), tree2["b"])
+
+        for rados, d in handles:
+            await d.fleet.leave()
+            await rados.shutdown()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_parallel_abort_on_dead_writer_head_intact_elastic_resave():
+    """kill -9 of a non-leader writer mid-save: the save aborts, HEAD
+    still points at the previous checkpoint bit-exactly, and the
+    survivors' next collective save commits over the shrunken fleet."""
+    async def main():
+        cluster, admin = await start_cluster()
+        handles = await _fleet_drivers(cluster)
+        drivers = [d for _, d in handles]
+        assert await drivers[0].fleet.elect()
+
+        mesh = coord_mesh.fleet_mesh(3)
+        tree = {"w": np.arange(192 * 16, dtype=np.float32).reshape(192, 16)}
+        sharded = coord_mesh.shard_tree(tree, mesh)
+        saves = [await d.save_async(sharded, timeout=60) for d in drivers]
+        (sid0,) = set(await asyncio.gather(*(s.wait() for s in saves)))
+
+        # next save: host-c is live at staging time (it is IN the writer
+        # set) but crashes before writing its share — its heartbeat
+        # lease vanishes and its rank record never appears
+        h0 = await drivers[0].save_async(sharded, timeout=60)
+        h1 = await drivers[1].save_async(sharded, timeout=60)
+        while True:
+            doc = await drivers[0]._read_staging()
+            if doc and doc["state"] == "staged" and doc["save_id"] != sid0:
+                break
+            await asyncio.sleep(0)
+        assert doc["hosts"] == list(HOSTS), doc
+        await drivers[2].fleet._member_lock.release()  # the crash, visible
+        errs = await asyncio.gather(h0.wait(), h1.wait(),
+                                    return_exceptions=True)
+        assert all(isinstance(e, CkptAborted) for e in errs), errs
+
+        # never a partial HEAD: previous checkpoint still bit-exact
+        head = await drivers[0].ckpt.head()
+        assert head["save_id"] == sid0
+        restored = await drivers[0].ckpt.restore()
+        np.testing.assert_array_equal(np.asarray(restored["w"]), tree["w"])
+        staging = await drivers[0]._read_staging()
+        assert staging["state"] == "aborted"
+
+        # elastic re-save: the SAME specs over the 2-host roster resolve
+        # to bigger slabs; restore_mesh reshards on load the same way
+        tree2 = {"w": tree["w"] + 1}
+        sharded2 = coord_mesh.shard_tree(tree2, coord_mesh.fleet_mesh(2))
+        h0 = await drivers[0].save_async(sharded2, timeout=60)
+        h1 = await drivers[1].save_async(sharded2, timeout=60)
+        (sid2,) = set(await asyncio.gather(h0.wait(), h1.wait()))
+        assert sid2 != sid0
+        restored = await drivers[1].restore_mesh()
+        np.testing.assert_array_equal(np.asarray(restored["w"]), tree2["w"])
+
+        for rados, d in handles[:2]:
+            await d.fleet.leave()
+        for rados, _ in handles:
+            await rados.shutdown()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_parallel_takeover_commits_staged_save_of_dead_leader():
+    """The leader dies AFTER every rank's share is durable but BEFORE
+    the commit: a follower inherits the seat mid-wait and finishes the
+    staged save — merge, manifest, the one atomic HEAD CAS. The dead
+    leader is played by hand so it can die at that exact step."""
+    async def main():
+        cluster, admin = await start_cluster()
+        handles = await _fleet_drivers(cluster, HOSTS[:2])
+        da, db = (d for _, d in handles)
+        fa = da.fleet
+        assert await fa.elect()
+
+        tree = {"w": np.arange(64, dtype=np.float32).reshape(16, 4)}
+        h1 = await db.save_async(tree, timeout=60)  # follower's share
+
+        sid, hosts = "feedc0de00000001", ["host-a", "host-b"]
+        wa = da.ckpt.writer(tree, save_id=sid)
+        await da._staging_cas({"save_id": sid, "state": "staged",
+                               "hosts": hosts, "parent": None})
+        wa.prepare_parallel(2, 0)
+        await wa.put_rank_meta(await wa.put_rank_chunks())
+        await fa.barrier(tag=f"save.{sid}", members=hosts, timeout=60)
+        # kill -9 between the barrier and the commit: leases vanish
+        await fa._member_lock.release()
+        await fa._leader_lock.release()
+
+        assert await h1.wait() == sid
+        assert h1.leader  # the follower took the seat over
+        head = await db.ckpt.head()
+        assert head["save_id"] == sid
+        restored = await db.restore()
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), tree["w"]
+        )
+
+        await db.fleet.leave()
+        for rados, _ in handles:
+            await rados.shutdown()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_parallel_takeover_leads_fresh_save_when_leader_died_unstagd():
+    """The leader dies BEFORE staging anything: the waiting follower
+    self-heals (fills the vacant seat from its staging-wait tick) and
+    leads its own save over the shrunken roster — no stranded waiters."""
+    async def main():
+        cluster, admin = await start_cluster()
+        handles = await _fleet_drivers(cluster, HOSTS[:2])
+        da, db = (d for _, d in handles)
+        assert await da.fleet.elect()
+
+        tree = {"w": np.arange(64, dtype=np.float32).reshape(16, 4)}
+        h1 = await db.save_async(tree, timeout=60)
+        # host-a dies silently: first its heartbeat, then its seat —
+        # by the time host-b CAN lead, host-a is no longer live
+        await da.fleet._member_lock.release()
+        await da.fleet._leader_lock.release()
+
+        sid = await h1.wait()
+        assert h1.leader
+        head = await db.ckpt.head()
+        assert head["save_id"] == sid
+        staging = await db._read_staging()
+        assert staging["state"] == "committed"
+        assert staging["hosts"] == ["host-b"]
+        restored = await db.restore()
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), tree["w"]
+        )
+
+        await db.fleet.leave()
+        for rados, _ in handles:
+            await rados.shutdown()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+# -- gc vs a staged save (the satellite race) ---------------------------------
+
+def test_gc_pins_rank_staged_chunks_until_settled():
+    """A fleet-parallel save between its staging CAS and the leader's
+    HEAD CAS has durable chunks with no manifest: the staging record
+    auto-pins that save_id, so a concurrent gc keeps every rank's
+    uncommitted output. Once the record flips to aborted the same
+    objects are debris and the next gc reclaims them."""
+    async def main():
+        cluster, admin = await start_cluster()
+        ioctx = admin.io_ctx(REP_POOL)
+        store = CkptStore(ioctx, "model")
+        sid0 = await (await store.save_async(
+            {"w": np.ones(8, dtype=np.float32)}
+        )).wait()
+
+        sid = "feed0000feed0000"
+        chunk = layout.chunk_object_name("model", sid, 0)
+        meta = layout.rank_meta_object("model", sid, 1)
+        await ioctx.write_full(chunk, b"x" * 64)
+        await ioctx.write_full(meta, b"{}")
+        doc = {"save_id": sid, "state": "staged",
+               "hosts": ["host-a", "host-b"], "parent": None}
+        await ioctx.write_full(
+            layout.staging_object("model"), json.dumps(doc).encode()
+        )
+
+        rep = await ckpt_gc.collect(ioctx, "model")
+        assert chunk in rep["kept"] and meta in rep["kept"]
+        assert sid in rep["retained"]
+        assert rep["head"] == sid0
+
+        # the save aborts: the same objects become unreferenced debris
+        await ioctx.write_full(
+            layout.staging_object("model"),
+            json.dumps(dict(doc, state="aborted")).encode(),
+        )
+        rep = await ckpt_gc.collect(ioctx, "model")
+        assert chunk in rep["removed"] and meta in rep["removed"]
+        assert rep["head"] == sid0  # the committed save is untouched
+
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())

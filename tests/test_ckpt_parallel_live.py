@@ -1,0 +1,159 @@
+"""Mesh-native fleet-parallel checkpoint IO, live tier (ISSUE 14
+acceptance).
+
+Three REAL writer processes (tools/fleet_tool.py psave) over TCP
+against an in-process cluster run a collective fleet-parallel save,
+then a second save where one NON-leader writer is SIGKILLed mid-put
+(its chunks out, its rank record not yet durable). The survivors'
+leases detect the death, the save ABORTS with the previous HEAD
+bit-exact — never a partial commit — and the two survivors re-run the
+collective over the shrunken fleet and commit.
+"""
+
+import asyncio
+import json
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ckpt.store import CkptStore
+from ceph_tpu.rados.client import Rados
+from tests.test_cluster_live import REP_POOL, Cluster
+
+pytestmark = pytest.mark.slow
+
+HOSTS, MB = 3, 8
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 300))
+
+
+def _bench_w() -> np.ndarray:
+    # mirrors tools/fleet_tool.py _bench_tree — the deterministic
+    # tree every psave worker builds from (HOSTS, MB)
+    rng = np.random.default_rng(0)
+    rows = HOSTS * max(1, (MB << 20) // HOSTS // 4096)
+    return rng.integers(0, 256, (rows, 4096), dtype=np.uint8)
+
+
+async def _spawn_psave(mon_host: str, host_id: str, role: str,
+                       fleet_name: str):
+    return await asyncio.create_subprocess_exec(
+        sys.executable, "tools/fleet_tool.py",
+        "--mon-host", mon_host, "--pool", str(REP_POOL),
+        "--host-id", host_id, "--role", role,
+        "--hosts", str(HOSTS), "--mb", str(MB),
+        "--ckpt-name", "model", "--lease", "2.0",
+        "--timeout", "120",
+        "psave", fleet_name,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.PIPE,
+    )
+
+
+def _events(raw: bytes) -> list[dict]:
+    return [json.loads(ln) for ln in raw.decode().splitlines() if ln]
+
+
+def test_parallel_save_kill_writer_aborts_then_survivors_commit():
+    async def main():
+        cluster = Cluster()
+        await cluster.start()
+        admin = Rados("client.fleetadmin", cluster.monmap,
+                      config=cluster.cfg)
+        await admin.connect()
+        await cluster.create_pools(admin)
+        mon_host = ",".join(
+            f"{h}:{p}" for h, p in cluster.monmap.addrs
+        )
+        store = CkptStore(admin.io_ctx(REP_POOL), "model")
+        w = _bench_w()
+        try:
+            # phase 1: a full collective save commits a baseline
+            procs = [
+                await _spawn_psave(mon_host, "host-a", "leader", "p1"),
+                await _spawn_psave(mon_host, "host-b", "survivor",
+                                   "p1"),
+                await _spawn_psave(mon_host, "host-c", "survivor",
+                                   "p1"),
+            ]
+            outs = await asyncio.gather(
+                *(p.communicate() for p in procs)
+            )
+            for p, (out, err) in zip(procs, outs):
+                assert p.returncode == 0, err.decode()
+            saves = [e for out, _ in outs for e in _events(out)
+                     if e["event"] == "psave"]
+            assert len(saves) == HOSTS
+            (sid0,) = {e["save_id"] for e in saves}
+            restored = await store.restore()
+            np.testing.assert_array_equal(np.asarray(restored["w"]), w)
+
+            # phase 2: host-c (a NON-leader writer) is SIGKILLed
+            # mid-put — parked after its chunk puts, before its rank
+            # record — and the collective ABORTS, HEAD untouched
+            leader = await _spawn_psave(mon_host, "host-a", "leader",
+                                        "p2")
+            surv = await _spawn_psave(mon_host, "host-b", "survivor",
+                                      "p2")
+            victim = await _spawn_psave(mon_host, "host-c", "victim",
+                                        "p2")
+            while True:
+                line = await asyncio.wait_for(
+                    victim.stdout.readline(), timeout=120
+                )
+                assert line, "victim exited before parking"
+                if json.loads(line).get("event") == "parked":
+                    break
+            victim.send_signal(signal.SIGKILL)
+            await victim.wait()
+            outs = await asyncio.gather(
+                *(p.communicate() for p in (leader, surv))
+            )
+            for p, (out, err) in zip((leader, surv), outs):
+                assert p.returncode == 0, err.decode()
+            aborts = [e for out, _ in outs for e in _events(out)
+                      if e["event"] == "aborted"]
+            assert len(aborts) == 2, outs
+
+            # no partial HEAD: previous checkpoint still bit-exact,
+            # the staging record settled to "aborted"
+            head = await store.head()
+            assert head["save_id"] == sid0
+            raw = await admin.io_ctx(REP_POOL).read(
+                "model.ckpt-staging"
+            )
+            staging = json.loads(raw.decode())
+            assert staging["state"] == "aborted"
+            restored = await store.restore()
+            np.testing.assert_array_equal(np.asarray(restored["w"]), w)
+
+            # phase 3: the two survivors re-run the collective over
+            # the shrunken fleet and commit the SAME tree
+            procs = [
+                await _spawn_psave(mon_host, "host-a", "leader", "p3"),
+                await _spawn_psave(mon_host, "host-b", "survivor",
+                                   "p3"),
+            ]
+            outs = await asyncio.gather(
+                *(p.communicate() for p in procs)
+            )
+            for p, (out, err) in zip(procs, outs):
+                assert p.returncode == 0, err.decode()
+            saves = [e for out, _ in outs for e in _events(out)
+                     if e["event"] == "psave"]
+            assert len(saves) == 2
+            (sid2,) = {e["save_id"] for e in saves}
+            assert sid2 != sid0
+            head = await store.head()
+            assert head["save_id"] == sid2
+            restored = await store.restore()
+            np.testing.assert_array_equal(np.asarray(restored["w"]), w)
+        finally:
+            await admin.shutdown()
+            await cluster.stop()
+
+    run(main())

@@ -259,6 +259,58 @@ def test_fleet_join_elect_barrier_status():
     run(main())
 
 
+def test_barrier_subgroups_tagged_members():
+    """Sub-group barriers (pipeline stages, per-save writer sets): an
+    explicit member subset rendezvouses under its own tag without the
+    rest of the fleet arriving, tagged barriers never consume the
+    untagged epoch sequence, and a subset member that left cannot
+    wedge the group (want is clipped to the live set)."""
+    async def main():
+        cluster, admin = await start_cluster()
+        handles = [await make_fleet(cluster, h) for h in HOSTS]
+        fa, fb, fc = (f for _, f in handles)
+        for f in (fa, fb, fc):
+            await f.join()
+
+        # only the subset must arrive — host-c never calls this barrier
+        pair = ["host-a", "host-b"]
+        assert await asyncio.gather(
+            fa.barrier(members=pair, tag="stage0", timeout=30),
+            fb.barrier(members=pair, tag="stage0", timeout=30),
+        ) == [0, 0]
+        # disjoint sub-groups under different tags don't interfere
+        duo = ["host-b", "host-c"]
+        assert await asyncio.gather(
+            fb.barrier(members=duo, tag="stage1", timeout=30),
+            fc.barrier(members=duo, tag="stage1", timeout=30),
+        ) == [0, 0]
+        # the untagged epoch sequence is untouched: still epoch 0
+        assert await asyncio.gather(
+            *(f.barrier(timeout=30) for f in (fa, fb, fc))
+        ) == [0, 0, 0]
+        # a tagged barrier can step its own epochs explicitly
+        assert await asyncio.gather(
+            fa.barrier(members=pair, tag="stage0", epoch=1, timeout=30),
+            fb.barrier(members=pair, tag="stage0", epoch=1, timeout=30),
+        ) == [1, 1]
+
+        # a listed member that is not live any more is ignored
+        await fc.leave()
+        assert await asyncio.gather(
+            fa.barrier(members=list(HOSTS), tag="s2", timeout=30),
+            fb.barrier(members=list(HOSTS), tag="s2", timeout=30),
+        ) == [0, 0]
+
+        for rados, f in handles[:2]:
+            await f.leave()
+        for rados, _ in handles:
+            await rados.shutdown()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
 def test_fleet_eviction_reelection_after_lease_expiry():
     async def main():
         cluster, admin = await start_cluster()

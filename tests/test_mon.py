@@ -162,7 +162,10 @@ def test_failure_reports_respect_min_reporters():
 
         r1.report_failure(5)
         r1.report_failure(5)  # same reporter twice: still one report
-        await asyncio.sleep(0.3)
+        # a command round-trip on the same ordered connection proves
+        # both reports were dispatched before we judge the outcome
+        await r1.command("status")
+        assert len(mons[leader]._failure_reports[5]) == 1
         assert not mons[leader].osdmap.is_down(5)
 
         r2.report_failure(5)  # second distinct reporter crosses the bar

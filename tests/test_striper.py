@@ -1,7 +1,6 @@
 """Striper (osdc/Striper.cc file_to_extents parity) + Throttle."""
 
 import threading
-import time
 
 import numpy as np
 import pytest
@@ -153,14 +152,18 @@ def test_throttle_blocking_and_failfast():
     assert not t.get_or_fail()
     assert t.get(timeout=0.01) is False
     done = []
+    entered = threading.Event()
 
     def waiter():
+        entered.set()
         t.get()
         done.append(True)
 
     th = threading.Thread(target=waiter)
     th.start()
-    time.sleep(0.05)
+    entered.wait(2)
+    # the budget is exhausted, so get() cannot return before put():
+    # done stays empty no matter how the threads interleave
     assert not done
     t.put()
     th.join(2)

@@ -22,6 +22,21 @@ Commands:
           [--rounds K]      latency percentiles across N hosts, and
           [--mb M]          per-rank sharded restore aggregate GB/s vs
                             one host restoring the whole tree
+    bench --parallel-save   fleet-parallel save bench: N REAL worker
+                            processes (separate GILs — serialization
+                            and crc run on N cores, as on a real pod)
+                            collectively save one mesh-sharded tree vs
+                            an N-host SINGLE-COMMITTER baseline (each
+                            non-leader's shard travels through the
+                            store to the leader, which serializes and
+                            puts every byte itself — what one-committer
+                            costs on a pod where no host holds remote
+                            shards); reports parallel_save_speedup and
+                            peak_host_bytes_frac (max per-host
+                            save_prepared_bytes / tree bytes)
+    psave <fleet>           one parallel-save bench host (spawned by
+                            `bench --parallel-save`; --mode single
+                            runs the legacy one-committer baseline)
 
 Output is JSON per command (worker: JSON lines), like tools/ceph.py."""
 
@@ -178,6 +193,211 @@ async def _worker(args) -> int:
         await rados.shutdown()
 
 
+def _bench_tree(hosts: int, mb: int):
+    """The deterministic bench tree — identical bytes in every mode."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    rows = hosts * max(1, (mb << 20) // hosts // 4096)
+    return {"w": rng.integers(0, 256, (rows, 4096), dtype=np.uint8)}
+
+
+async def _psave_single(args, io, fleet, driver, tree):
+    """The honest one-committer baseline on the SAME N-host fleet: a
+    real pod host only holds its own shards, so a single committer
+    must first GATHER every remote shard through the store (non-leader
+    slab put + leader ranged read), reassemble, and serialize + put
+    the WHOLE tree itself. Returns (save_id, seconds) spanning
+    rendezvous → committed HEAD on every host."""
+    import numpy as np
+
+    from ceph_tpu.ckpt import layout as ckpt_layout
+
+    t0 = time.perf_counter()
+    is_leader = await fleet.elect()
+    hosts = await fleet.live_members()
+    rank = hosts.index(args.host_id)
+    rows = tree["w"].shape[0]
+    if not is_leader:
+        sl = ckpt_layout.fleet_slab(rows, len(hosts), rank)
+        await io.write_full(
+            f"{args.ckpt_name}.gather.{rank:04d}",
+            tree["w"][sl].tobytes(),
+        )
+        await fleet.barrier(tag="gather", members=hosts,
+                            timeout=args.timeout)
+        await fleet.barrier(tag="gathered", members=hosts,
+                            timeout=args.timeout)
+        head = await driver.ckpt.head()
+        return head["save_id"], time.perf_counter() - t0
+    await fleet.barrier(tag="gather", members=hosts,
+                        timeout=args.timeout)
+    parts = []
+    for r in range(len(hosts)):
+        sl = ckpt_layout.fleet_slab(rows, len(hosts), r)
+        if r == rank:
+            parts.append(tree["w"][sl])
+            continue
+        raw = await io.read(f"{args.ckpt_name}.gather.{r:04d}")
+        parts.append(np.frombuffer(raw, dtype=tree["w"].dtype)
+                     .reshape(-1, *tree["w"].shape[1:]))
+    full = {"w": np.concatenate(parts, axis=0)}
+    ps = await driver.save(full)
+    assert ps is not None, "baseline leader must hold the seat"
+    (sid,) = await driver.drain()
+    await fleet.barrier(tag="gathered", members=hosts,
+                        timeout=args.timeout)
+    return sid, time.perf_counter() - t0
+
+
+async def _psave_worker(args) -> int:
+    """One parallel-save bench host: join, rendezvous, ONE timed save,
+    emit the numbers. `--mode single` is the one-committer baseline
+    (remote shards gathered through the store, whole-tree serialize +
+    every chunk from the leader); `--mode parallel` is this rank's
+    share of the collective save_async."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        f"--xla_force_host_platform_device_count={max(8, args.hosts)}",
+    )
+    from ceph_tpu.ckpt.store import CkptStore
+    from ceph_tpu.ckpt.writer import CkptAborted, CkptWriter
+    from ceph_tpu.coord import Fleet, FleetDriver
+    from ceph_tpu.coord import mesh as coord_mesh
+
+    if args.role == "victim":
+        # park mid-put — after this rank's chunks went out but BEFORE
+        # its rank record is durable — so the harness can SIGKILL a
+        # writer whose share looks in-flight to everyone else (the
+        # same park-and-die contract as `worker --role victim`)
+        async def _park(self, own):
+            _emit(event="parked", host=args.host_id,
+                  save_id=self.save_id)
+            while True:
+                await asyncio.sleep(0.25)
+        CkptWriter.put_rank_meta = _park
+
+    rados = await _connect(args)
+    io = rados.io_ctx(args.pool)
+    fleet = Fleet(io, args.fleet_name, args.host_id)
+    driver = FleetDriver(fleet, ckpt=CkptStore(io, args.ckpt_name))
+    try:
+        await fleet.join()
+        if args.role == "leader":
+            # deterministic seat for harnesses that must know whose
+            # death they are injecting (the victim stays a follower)
+            _emit(event="elected", host=args.host_id,
+                  leader=await fleet.elect())
+        tree = _bench_tree(args.hosts, args.mb)
+        total = tree["w"].nbytes
+        await fleet.barrier(timeout=args.timeout)  # registration
+        if args.mode == "single":
+            sid, secs = await _psave_single(args, io, fleet, driver,
+                                            tree)
+        else:
+            sharded = coord_mesh.shard_tree(
+                tree, coord_mesh.fleet_mesh(args.hosts)
+            )
+            await fleet.barrier(timeout=args.timeout)  # post device_put
+            t0 = time.perf_counter()
+            handle = await driver.save_async(sharded,
+                                             timeout=args.timeout)
+            try:
+                sid = await handle.wait()
+            except CkptAborted as e:
+                # a writer died before its share was durable: HEAD is
+                # untouched; report and exit clean so the harness can
+                # re-run the collective over the survivors
+                _emit(event="aborted", host=args.host_id,
+                      save_id=handle.save_id, error=str(e))
+                await fleet.leave()
+                return 0
+            secs = time.perf_counter() - t0
+        _emit(event="psave", host=args.host_id, mode=args.mode,
+              save_id=sid, seconds=round(secs, 4), bytes=total,
+              prepared_bytes=driver.ckpt.perf_dump()[
+                  "save_prepared_bytes"])
+        await fleet.leave()
+        return 0
+    finally:
+        await rados.shutdown()
+
+
+async def _bench_parallel(args) -> dict:
+    """`bench --parallel-save`: an N-host single-committer baseline
+    (remote shards gathered through the store, the leader serializing
+    and putting all the bytes), then N collective writer processes,
+    against the same in-process cluster over TCP. Separate processes =
+    separate GILs, so the per-host serialization/crc actually runs in
+    parallel — the honest analogue of N pod hosts."""
+    from tests.test_cluster_live import REP_POOL, Cluster
+    from ceph_tpu.rados.client import Rados
+
+    cluster = Cluster()
+    await cluster.start()
+    admin = Rados("client.fleetbench", cluster.monmap,
+                  config=cluster.cfg)
+    await admin.connect()
+    await cluster.create_pools(admin)
+    mon_host = ",".join(f"{h}:{p}" for h, p in cluster.monmap.addrs)
+    tool = os.path.abspath(__file__)
+
+    async def spawn(host_id, mode, fleet_name):
+        return await asyncio.create_subprocess_exec(
+            sys.executable, tool,
+            "--mon-host", mon_host, "--pool", str(REP_POOL),
+            "--host-id", host_id, "--mode", mode,
+            "--hosts", str(args.hosts), "--mb", str(args.mb),
+            "--ckpt-name", f"bench-{mode}", "--lease", "2.0",
+            "--timeout", str(args.timeout),
+            "psave", fleet_name,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+
+    async def harvest(procs) -> list[dict]:
+        outs = await asyncio.gather(*(p.communicate() for p in procs))
+        events = []
+        for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"psave worker failed rc={p.returncode}: "
+                    f"{err.decode()[-2000:]}"
+                )
+            events.extend(
+                json.loads(ln) for ln in out.decode().splitlines() if ln
+            )
+        return [e for e in events if e.get("event") == "psave"]
+
+    try:
+        single = await harvest(await asyncio.gather(*(
+            spawn(f"host-s{i:02d}", "single", "bench-s")
+            for i in range(args.hosts)
+        )))
+        par = await harvest(await asyncio.gather(*(
+            spawn(f"host-{i:02d}", "parallel", "bench-p")
+            for i in range(args.hosts)
+        )))
+        t_single = max(e["seconds"] for e in single)
+        t_par = max(e["seconds"] for e in par)
+        total = single[0]["bytes"]
+        return {
+            "bench": "fleet_parallel_save",
+            "hosts": args.hosts,
+            "bytes": total,
+            "single_save_s": round(t_single, 4),
+            "parallel_save_s": round(t_par, 4),
+            "parallel_save_speedup": round(
+                t_single / max(t_par, 1e-9), 2),
+            "peak_host_bytes_frac": round(
+                max(e["prepared_bytes"] for e in par) / total, 4),
+        }
+    finally:
+        await admin.shutdown()
+        await cluster.stop()
+
+
 async def _bench(args) -> dict:
     """Barrier latency + sharded-restore scaling against an in-process
     cluster (no external daemons), the `bench.py --fleet` engine."""
@@ -269,8 +489,11 @@ async def _amain(args) -> int:
     if args.command == "worker":
         return await _worker(args)
     if args.command == "bench":
-        print(json.dumps(await _bench(args), sort_keys=True))
+        bench = _bench_parallel if args.parallel_save else _bench
+        print(json.dumps(await bench(args), sort_keys=True))
         return 0
+    if args.command == "psave":
+        return await _psave_worker(args)
     raise SystemExit(f"unknown command {args.command!r}")
 
 
@@ -280,7 +503,7 @@ def main(argv=None) -> int:
     ap.add_argument("--pool", type=int, default=1)
     ap.add_argument("--name", dest="name_id", default="client.fleet")
     ap.add_argument("--host-id", default="")
-    ap.add_argument("--role", choices=("victim", "survivor"),
+    ap.add_argument("--role", choices=("victim", "survivor", "leader"),
                     default="survivor")
     ap.add_argument("--ckpt-name", default="model")
     ap.add_argument("--data-name", default="corpus")
@@ -295,12 +518,19 @@ def main(argv=None) -> int:
     ap.add_argument("--hosts", type=int, default=4)
     ap.add_argument("--rounds", type=int, default=20)
     ap.add_argument("--mb", type=int, default=16)
-    ap.add_argument("command", choices=("status", "worker", "bench"))
+    ap.add_argument("--parallel-save", action="store_true",
+                    help="bench: fleet-parallel save vs one committer")
+    ap.add_argument("--mode", choices=("single", "parallel"),
+                    default="parallel",
+                    help="psave: baseline committer or collective rank")
+    ap.add_argument("command",
+                    choices=("status", "worker", "bench", "psave"))
     ap.add_argument("fleet_name", nargs="?", default="train")
     args = ap.parse_args(argv)
-    if args.command == "worker" and not args.host_id:
-        ap.error("worker requires --host-id")
-    if args.command == "worker" and args.name_id == "client.fleet":
+    if args.command in ("worker", "psave") and not args.host_id:
+        ap.error(f"{args.command} requires --host-id")
+    if args.command in ("worker", "psave") \
+            and args.name_id == "client.fleet":
         # each worker process needs its own RADOS identity (fencing,
         # watch registrations) — derive it from the host id
         args.name_id = f"client.{args.host_id}"
