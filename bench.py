@@ -354,6 +354,68 @@ def _wire_local_line() -> None:
         pass
 
 
+def _read_scaling_line() -> None:
+    """Optional JSON line: the scale-out read A/B. Three multiprocess
+    daemon_bench runs — real OS processes per daemon and per client, so
+    a hot primary is a genuine CPU bottleneck — over a hot object set:
+
+      * rep pool, rados_read_policy=primary — every read of a hot
+        object lands on its one primary process;
+      * rep pool, policy=balance — the same reads spread across all
+        clean acting members (the tentpole claim: aggregate read GB/s
+        scales with replicas, expected >= 1.5x on a 3-replica pool);
+      * EC pool, policy=balance — full-object reads take the
+        direct-shard path (k parallel ranged shard reads, no primary
+        gather/decode) vs the same pool at policy=primary.
+
+    read_distribution (per-OSD op_r / read_balanced / read_shard_direct
+    deltas for the read leg) rides along so the spread itself is
+    visible, not just the ratio. The speedup needs real cores to scale
+    into: on a single-core host the processes timeshare and the ratio
+    degenerates toward 1x even though the spread happens — ncores rides
+    in the line so the reader can tell. Guarded (--read-scaling /
+    CEPH_TPU_BENCH_READ=1) and non-fatal."""
+    try:
+        import subprocess
+
+        def run_bench(pool: str, policy: str) -> dict:
+            argv = [sys.executable, "tools/daemon_bench.py",
+                    "--multiprocess", "--osds", "6", "--clients", "4",
+                    "--pool", pool, "--k", "2", "--m", "2",
+                    "--size", "262144", "--objects", "64",
+                    "--concurrency", "24", "--hot-set", "3",
+                    "--read-policy", policy]
+            out = subprocess.run(
+                argv, capture_output=True, timeout=900, check=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            return json.loads(out.stdout)
+
+        rep_primary = run_bench("rep", "primary")
+        rep_balance = run_bench("rep", "balance")
+        ec_primary = run_bench("ec", "primary")
+        ec_direct = run_bench("ec", "balance")
+        line = {
+            "metric": "balanced_read_throughput",
+            "value": round(rep_balance["read_gbps"], 4),
+            "unit": "GB/s",
+            "primary_read_gbps": round(rep_primary["read_gbps"], 4),
+            "balance_speedup": round(
+                rep_balance["read_gbps"] / rep_primary["read_gbps"], 3),
+            "ec_direct_read_gbps": round(ec_direct["read_gbps"], 4),
+            "ec_primary_read_gbps": round(ec_primary["read_gbps"], 4),
+            "ec_direct_speedup": round(
+                ec_direct["read_gbps"] / ec_primary["read_gbps"], 3),
+            "clients": rep_balance["clients"],
+            "ncores": rep_balance["ncores"],
+            "read_distribution": rep_balance["read_distribution"],
+            "ec_read_distribution": ec_direct["read_distribution"],
+        }
+        print(json.dumps(line))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def _ckpt_line() -> None:
     """Optional JSON line: checkpoint save/restore GB/s through the full
     stack (CkptStore -> RADOS client -> OSD daemons -> EC encode), via
@@ -647,6 +709,10 @@ def main() -> None:
         "CEPH_TPU_BENCH_WIRE"
     ):
         _wire_local_line()
+    if "--read-scaling" in sys.argv[1:] or os.environ.get(
+        "CEPH_TPU_BENCH_READ"
+    ):
+        _read_scaling_line()
     if "--ckpt" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_CKPT"):
         _ckpt_line()
     if "--data" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_DATA"):

@@ -29,7 +29,7 @@ import numpy as np
 from ceph_tpu.ckpt import layout
 from ceph_tpu.common.compressor import factory as compressor_factory
 from ceph_tpu.common.crc import ceph_crc32c
-from ceph_tpu.rados.client import ObjectNotFound
+from ceph_tpu.rados.client import IoCtx, ObjectNotFound
 from ceph_tpu.rados.striper import read_runs
 
 
@@ -43,6 +43,15 @@ class CkptReader:
         self.name = name
         self.config = config if config is not None else ioctx.objecter.config
         self.perf = perf
+        # chunk data reads ride their own handle carrying the caller's
+        # read policy: a restore is exactly the N-reader fan-in balanced
+        # reads exist for (every host hammering the same chunk objects'
+        # primaries), and EC chunk ranges go direct to the data shards.
+        # Metadata (head, manifest) stays on the caller's handle — tiny,
+        # and freshest at the primary.
+        self._data_ioctx = IoCtx(ioctx.objecter, ioctx.pool_id)
+        self._data_ioctx.qos_class = ioctx.qos_class
+        self._data_ioctx.read_policy = ioctx.read_policy
 
     @property
     def tracer(self):
@@ -83,7 +92,7 @@ class CkptReader:
         )
         token = self.tracer.use(span) if span is not None else None
         try:
-            payload = await self.ioctx.read(chunk["object"])
+            payload = await self._data_ioctx.read(chunk["object"])
         finally:
             if span is not None:
                 self.tracer.release(token)
@@ -229,7 +238,7 @@ class CkptReader:
                 # (offset/length pushdown; the same path the dataset
                 # iterator's coalesced record runs ride)
                 [part] = await read_runs(
-                    self.ioctx,
+                    self._data_ioctx,
                     [(chunk["object"], off_in, take)],
                     window,
                 )

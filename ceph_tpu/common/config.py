@@ -339,6 +339,26 @@ SCHEMA: dict[str, Option] = {
         _opt("mon_cluster_log_entries", TYPE_UINT, LEVEL_ADVANCED, 1000,
              "cluster-log lines the mon leader retains for "
              "`log last <n>` (LogMonitor summary role)", min=1),
+        # scale-out read path (the reference's Octopus balanced reads:
+        # osd_read_from_replica / CEPH_OSD_FLAG_BALANCE_READS)
+        _opt("rados_read_policy", TYPE_STR, LEVEL_ADVANCED, "primary",
+             "client read-target policy: 'primary' sends every read to "
+             "the PG primary (classic path); 'balance' spreads reads "
+             "round-robin over all clean acting members; 'localize' "
+             "prefers an acting member colocated on this host (its "
+             "LocalStack uds endpoint exists locally), falling back to "
+             "balance. A non-primary target only serves a read when its "
+             "copy is provably current — anything else bounces back to "
+             "the primary with a redirect, never wrong data",
+             see_also=("rados_ec_direct_reads",)),
+        _opt("rados_ec_direct_reads", TYPE_BOOL, LEVEL_ADVANCED, True,
+             "with a non-primary rados_read_policy on an EC pool whose "
+             "acting set is whole, compute the stripe layout client-side "
+             "and read the k data shards directly from their home OSDs "
+             "in parallel (no primary gather, no decode launch); any "
+             "shard error, stale shard, or degraded interval falls back "
+             "to the primary decode path",
+             see_also=("rados_read_policy",)),
         # checkpoint store (ceph_tpu.ckpt: Orbax/TensorStore-style
         # manifest + chunk layout over RADOS)
         _opt("ckpt_chunk_target_bytes", TYPE_UINT, LEVEL_ADVANCED,

@@ -54,9 +54,12 @@ class DataReader:
         self.config = config if config is not None else ioctx.objecter.config
         self.perf = perf
         # prefetch traffic rides its own mclock class; metadata (head,
-        # manifest) stays on the caller's handle
+        # manifest) stays on the caller's handle. The caller's read
+        # policy carries over: under balance/localize the bulk fetches
+        # spread across clean replicas / go direct to EC data shards
         self._data_ioctx = IoCtx(ioctx.objecter, ioctx.pool_id)
         self._data_ioctx.qos_class = QOS_DATA_PREFETCH
+        self._data_ioctx.read_policy = ioctx.read_policy
 
     @property
     def tracer(self):

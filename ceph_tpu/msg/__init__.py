@@ -22,6 +22,7 @@ from ceph_tpu.msg.frames import (
     Message,
     Tag,
     payload_of,
+    redirect_reply,
 )
 from ceph_tpu.msg.messenger import (
     AsyncThrottle,
@@ -42,4 +43,5 @@ __all__ = [
     "Policy",
     "Tag",
     "payload_of",
+    "redirect_reply",
 ]

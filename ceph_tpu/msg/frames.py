@@ -390,3 +390,21 @@ def payload_of(msg: Message):
     if msg.flags & FLAG_BIN_DATA:
         return decode_payload(msg.data)
     return json.loads(msg.data)
+
+
+def redirect_reply(tid: int, primary: int, epoch: int, why: str = "") -> dict:
+    """osd_op_reply payload bouncing a balanced/direct-shard read back to
+    the PG primary (MOSDOpReply redirect role): the target cannot prove
+    its copy is current — peering, backfill, a stale activation marker, a
+    version mismatch, or a local read error — so the client must retry at
+    the primary instead of risking wrong data. `primary` and `epoch` are
+    the sender's view; the client trusts them only as a hint and refreshes
+    its map when the epoch is ahead of its own."""
+    return {
+        "tid": tid,
+        "ok": False,
+        "redirect": True,
+        "primary": primary,
+        "epoch": epoch,
+        "why": why,
+    }

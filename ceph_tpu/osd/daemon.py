@@ -52,7 +52,14 @@ from ceph_tpu.common.config import Config
 from ceph_tpu.common.crc import ceph_crc32c
 from ceph_tpu.common.kv import KeyValueDB
 from ceph_tpu.common.watchdog import SharedWatchdog
-from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy, payload_of
+from ceph_tpu.msg import (
+    Dispatcher,
+    Message,
+    Messenger,
+    Policy,
+    payload_of,
+    redirect_reply,
+)
 from ceph_tpu.msg.frames import FEATURE_SUBOP_BATCH
 from ceph_tpu.mon.client import MonClient
 from ceph_tpu.osd.cls import ClsError, MethodContext, default_handler
@@ -268,6 +275,13 @@ class PG:
         #: copies/shards back
         self.self_backfill = False
         self.self_backfill_task: asyncio.Task | None = None
+        #: balanced-read activation marker on a NON-primary member: the
+        #: primary's pg_activate broadcast {les, acting, backfill} —
+        #: replica-side proof that peering for that interval finished and
+        #: our copy set was current when it did. None means never heard
+        #: (or invalidated by a map change): balanced reads redirect,
+        #: because a replica has no interval knowledge of its own
+        self.replica_marker: dict | None = None
 
     # -- the persisted log ----------------------------------------------------
 
@@ -469,6 +483,16 @@ class OSDService(Dispatcher):
             ("read_error_repaired",
              "primary read EIOs healed from replicas/EC survivors "
              "before the client saw them (rep_repair_primary_object)"),
+            ("read_balanced",
+             "client reads this OSD served as a NON-primary acting "
+             "member (rados_read_policy balance/localize)"),
+            ("read_redirected",
+             "balanced/direct-shard reads bounced back to the primary "
+             "(peering, backfill, stale marker, or local error — never "
+             "served from an unproven copy)"),
+            ("read_shard_direct",
+             "EC data-shard ranges served straight to clients with no "
+             "primary gather/decode"),
             ("scrub_errors", "inconsistencies found by scrub"),
             ("heartbeat_failures", "peer failures reported to the mon"),
             ("tier_hit", "cache-pool ops served from the cache"),
@@ -1274,6 +1298,12 @@ class OSDService(Dispatcher):
             if primary != self.id:
                 pg.active = False
                 pg.last_acting = None
+                mk = pg.replica_marker
+                if mk is not None and list(acting) != list(mk["acting"]):
+                    # acting moved past the marker's interval: stop
+                    # serving balanced reads now instead of waiting for
+                    # the history check to notice at read time
+                    pg.replica_marker = None
                 continue
             if pg.active and pg.last_acting == acting:
                 # same acting as when we activated — but an interval may
@@ -1316,6 +1346,11 @@ class OSDService(Dispatcher):
                     if (d := self.dlog.dout(5)) is not None:
                         d(f"pg {pool_id}.{ps} active, acting {acting}, "
                           f"backfilling {sorted(pg.backfill_targets)}")
+                    # tell the replicas peering finished so they may
+                    # serve balanced reads for this interval
+                    self._spawn(
+                        self._broadcast_activate(pg, list(acting))
+                    )
                     if pg.backfill_targets and (
                         pg.backfill_task is None
                         or pg.backfill_task.done()
@@ -2237,7 +2272,11 @@ class OSDService(Dispatcher):
                     if (d := self.dlog.dout(5)) is not None:
                         d(f"pg {pg.pool}.{pg.ps} backfill of osd.{osd} "
                           "complete")
-            if not progressed:
+            if progressed:
+                # the backfill set shrank: refresh the replicas' marker
+                # so the drained member becomes a balanced-read target
+                self._spawn(self._broadcast_activate(pg, acting))
+            else:
                 await asyncio.sleep(0.2)
 
     async def _backfill_member(
@@ -3082,7 +3121,34 @@ class OSDService(Dispatcher):
             ps = self.object_pg(pool_id, name)
             acting, primary = self.acting_of(pool_id, ps)
             tracked.mark_event("placed")
+            if p["op"] == "shard_read":
+                # EC direct-shard read: served by whichever acting
+                # member homes the requested data shard (possibly the
+                # primary itself); does its own state checks + redirect
+                await self._serve_shard_read(
+                    conn, p, pool_id, name, ps, acting, primary
+                )
+                return
             if primary != self.id:
+                if p.get("balanced"):
+                    if await self._serve_balanced_read(
+                        conn, p, pool_id, name, ps, acting, primary
+                    ):
+                        return
+                    # cannot prove our copy current: bounce to the
+                    # primary, never serve unproven data
+                    self.perf.inc("read_redirected")
+                    conn.send_message(
+                        Message(
+                            type="osd_op_reply", tid=p["tid"],
+                            epoch=self.osdmap.epoch,
+                            payload=redirect_reply(
+                                p["tid"], primary, self.osdmap.epoch,
+                                "replica cannot prove its copy current",
+                            ),
+                        )
+                    )
+                    return
                 conn.send_message(
                     Message(
                         type="osd_op_reply", tid=p["tid"],
@@ -4365,6 +4431,321 @@ class OSDService(Dispatcher):
         except StoreError:
             pass  # mid-recovery: the client's operate fallback covers it
         return out
+
+    # -- balanced replica reads & EC direct-shard reads ------------------------
+    # (the reference's Octopus balanced reads: osd_read_from_replica /
+    # CEPH_OSD_FLAG_BALANCE_READS lets a clean replica serve reads; here
+    # the license is an explicit activation marker from the primary,
+    # cross-checked against the mon's interval archive, and every state
+    # the marker cannot vouch for bounces back with a redirect reply)
+
+    async def _broadcast_activate(
+        self, pg: PG, acting: list[int]
+    ) -> None:
+        """Hand every clean acting member the activation marker that
+        licenses it to serve balanced reads this interval. Best-effort:
+        write correctness never depends on the marker, so a lost
+        broadcast only costs that member its share of read traffic."""
+        marker = {
+            "pgid": [pg.pool, pg.ps],
+            "les": pg.les,
+            "acting": list(acting),
+            "backfill": sorted(pg.backfill_targets),
+        }
+        for osd in acting:
+            if osd in (self.id, _NONE) or self.osdmap.is_down(osd):
+                continue
+            try:
+                await self._peer_call(
+                    osd, "pg_activate", dict(marker), timeout=2.0
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                pass  # member serves primary-only until the next pass
+
+    async def _h_pg_activate(self, conn, p) -> None:
+        """The primary finished peering (or drained a backfill target)
+        and vouches for this acting set: keep the newest marker. No
+        locks taken — validity is re-derived per read from the marker
+        plus the mon's interval archive, so racing markers from an old
+        reign lose to the history check even if they land last."""
+        pg = self._pg_of(p["pgid"])
+        mk = pg.replica_marker
+        if mk is None or p["les"] >= mk["les"]:
+            pg.replica_marker = {
+                "les": p["les"],
+                "acting": list(p["acting"]),
+                "backfill": list(p.get("backfill") or ()),
+            }
+        self._reply_peer(conn, p["tid"], {"ok": True})
+
+    async def _replica_read_ok(
+        self, pg: PG, acting: list[int], primary: int
+    ) -> bool:
+        """May this acting member serve a read it is not primary for?
+        Proof of currency = the primary's activation marker for exactly
+        this acting set, with us not a backfill target, cross-checked
+        against the mon's interval archive: an interval that STARTED
+        after the marker's activation epoch means membership flapped
+        since the primary vouched for us (even if the flap's epochs
+        never reached us — replicas coalesce map updates), so redirect.
+        The archive fetch is one bulk mon query memoized per map epoch
+        (_pg_history); steady-state balanced reads stay local."""
+        if primary == self.id:
+            return pg.active and not pg.self_backfill
+        mk = pg.replica_marker
+        if (
+            mk is None
+            or pg.self_backfill
+            or self.id in mk["backfill"]
+            or list(acting) != list(mk["acting"])
+        ):
+            return False
+        ivs = await self._pg_history(pg)
+        if ivs is None:
+            return False  # mon unreachable: cannot prove, do not serve
+        return not ivs or ivs[-1][0] <= mk["les"]
+
+    async def _serve_balanced_read(
+        self, conn, p, pool_id, name, ps, acting, primary
+    ) -> bool:
+        """Serve a read-only client op as a NON-primary acting member.
+        True = a reply went out (data or the same terminal errno the
+        primary would give); False sends the caller to the redirect
+        path. Served object data is version-checked against our own
+        inventory, which sub-op transactions advance atomically with
+        the data — with a valid marker every acked write is present, so
+        a balanced read can never return bytes a primary read wouldn't."""
+        pg = self._pg_of((pool_id, ps))
+        if self.codec(pool_id) is not None:
+            return False  # EC logical reads decode at the primary
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is not None and pool.tier_of >= 0:
+            return False  # cache-tier promotion is primary-side logic
+        if p.get("snapid") is not None or p.get("snapc") is not None:
+            return False  # snap resolution walks primary-side state
+        if not await self._replica_read_ok(pg, acting, primary):
+            return False
+        sp = self.tracer.child(
+            "balanced_read", tags={"object": f"{pool_id}/{name}"}
+        )
+        reply_raw = b""
+        try:
+            if p["op"] == "read":
+                entry = pg.latest_objects().get(name)
+                if entry is None or entry["kind"] == "delete":
+                    raise StoreError(
+                        "ENOENT", f"no such object {name!r}"
+                    )
+                try:
+                    data = self.store.read(pg.coll, name)
+                    attrs = self.store.getattrs(pg.coll, name)
+                except StoreError as e:
+                    if e.code == "EIO":
+                        self._report_read_error(pg, name, None)
+                    return False
+                if attrs.get("ver") != entry["obj_ver"]:
+                    return False  # copy lags: let the primary serve
+                reply_raw = data
+                result = {}
+            elif p["op"] == "stat":
+                result = self._primary_stat(pg, name)
+            elif p["op"] == "ops" and not is_mutating(p.get("ops") or ()):
+                ops, datas, off = p["ops"], [], 0
+                for ln in p.get("data_lens", []):
+                    datas.append(p["_raw"][off: off + ln])
+                    off += ln
+                op_results, reply_raw = await self._primary_ops(
+                    pg, acting, name, ops, datas, None
+                )
+                result = {"results": op_results}
+            else:
+                return False  # mutations/exotica belong to the primary
+            reply = {"tid": p["tid"], "ok": True, **result}
+        except (StoreError, ClsError, OpError) as e:
+            if isinstance(e, StoreFatalError) or e.code == "EROFS":
+                return False  # store fenced: we are about to go down
+            # marker-valid state means this terminal errno IS the
+            # cluster's answer (every acked create/delete reached us)
+            reply = {"tid": p["tid"], "ok": False, "error": str(e),
+                     "errno": e.code}
+            reply_raw = b""
+        except asyncio.CancelledError:
+            raise
+        # cephlint: disable=error-taxonomy (anything unexpected redirects to the primary)
+        except Exception:
+            return False
+        finally:
+            if sp is not None:
+                sp.finish()
+        self.perf.inc("read_balanced")
+        conn.send_message(
+            Message(type="osd_op_reply", tid=p["tid"],
+                    epoch=self.osdmap.epoch,
+                    payload=reply, raw=reply_raw)
+        )
+        return True
+
+    async def _serve_shard_read(
+        self, conn, p, pool_id, name, ps, acting, primary
+    ) -> None:
+        """EC direct-shard read: return the clipped bytes of OUR data
+        shard with the object version, so the client can check that all
+        k shards agree and assemble the stripe without a primary gather
+        or decode. Every failure mode — wrong home, unproven interval,
+        stale or rotten shard — redirects, and the client falls back to
+        the primary decode path."""
+        pg = self._pg_of((pool_id, ps))
+
+        def _send(payload: dict, raw: bytes = b"") -> None:
+            conn.send_message(
+                Message(type="osd_op_reply", tid=p["tid"],
+                        epoch=self.osdmap.epoch,
+                        payload=payload, raw=raw)
+            )
+
+        def _redirect(why: str) -> None:
+            self.perf.inc("read_redirected")
+            _send(redirect_reply(
+                p["tid"], primary, self.osdmap.epoch, why
+            ))
+
+        pos = p.get("shard")
+        ec = self.codec(pool_id)
+        pool = self.osdmap.pools.get(pool_id)
+        if (
+            ec is None
+            or not isinstance(pos, int)
+            or (pool is not None and pool.tier_of >= 0)
+            or pos >= len(acting)
+            or acting[pos] != self.id
+        ):
+            return _redirect("not this shard's clean home")
+        if not await self._replica_read_ok(pg, acting, primary):
+            return _redirect("unproven interval")
+        entry = pg.latest_objects().get(name)
+        if entry is None or entry["kind"] == "delete":
+            # the primary serves the authoritative ENOENT on fallback
+            return _redirect("no such object")
+        sname = shard_name(name, pos)
+        try:
+            data = self.store.read(pg.coll, sname)
+            attrs = self.store.getattrs(pg.coll, sname)
+        except StoreError as e:
+            if e.code == "EIO":
+                self._report_read_error(pg, name, pos)
+            return _redirect("shard unreadable")
+        if attrs.get("ver") != entry["obj_ver"]:
+            return _redirect("shard stale")
+        size = attrs.get("size")
+        if size is None:
+            return _redirect("shard missing size attr")
+        # this shard holds data chunk `dpos`: its logical bytes span
+        # [dpos*cs, dpos*cs+cs) pre-truncation, clamped by the object
+        # size attr (padding never leaves the OSD), then clipped to the
+        # client's requested run
+        cs = len(data)
+        dpos = int(p.get("dpos", 0))
+        lo, hi = dpos * cs, min((dpos + 1) * cs, int(size))
+        run = p.get("run")
+        if run is not None:
+            lo = max(lo, int(run[0]))
+            hi = min(hi, int(run[0]) + int(run[1]))
+        piece = data[lo - dpos * cs: hi - dpos * cs] if hi > lo else b""
+        sp = self.tracer.child(
+            "shard_read",
+            tags={"object": f"{pool_id}/{sname}", "dpos": dpos},
+        )
+        if sp is not None:
+            sp.finish()
+        self.perf.inc("read_shard_direct")
+        _send({"tid": p["tid"], "ok": True, "ver": entry["obj_ver"],
+               "cs": cs, "size": int(size), "lo": lo}, piece)
+
+    def _report_read_error(
+        self, pg: PG, name: str, shard: int | None
+    ) -> None:
+        """A balanced/shard read hit at-rest EIO on our copy: tell the
+        primary so it runs the write-back repair now instead of waiting
+        for the next scrub (the replica-reported leg of
+        rep_repair_primary_object), while we redirect the client."""
+        acting, primary = self.acting_of(pg.pool, pg.ps)
+        if (
+            primary in (-1, _NONE, self.id)
+            or self.osdmap.is_down(primary)
+        ):
+            return
+
+        async def report() -> None:
+            try:
+                await self._peer_call(
+                    primary, "read_error_report",
+                    {"pgid": [pg.pool, pg.ps], "name": name,
+                     "shard": shard, "reporter": self.id},
+                    timeout=5.0,
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                pass  # scrub remains the backstop
+
+        self._spawn(report())
+
+    async def _h_read_error_report(self, conn, p) -> None:
+        # repair takes the fetch/rebuild/push path: run it off the
+        # dispatch loop through the per-PG sub-op queue
+        self._enqueue_subop(p, self._do_read_error_report, conn)
+
+    async def _do_read_error_report(self, conn, p) -> None:
+        """Primary side of a replica-reported read error: rebuild the
+        reporter's copy/shard from the survivors and push it back — the
+        same write-back _recover_read_error runs for our own EIOs,
+        driven by a replica's instead."""
+        pg = self._pg_of(p["pgid"])
+        name, reporter = p["name"], p["reporter"]
+        shard = p.get("shard")
+        acting, primary = self.acting_of(pg.pool, pg.ps)
+        if (
+            primary != self.id
+            or not pg.active
+            or reporter not in acting
+            or (shard is not None
+                and (shard >= len(acting) or acting[shard] != reporter))
+        ):
+            self._reply_peer(conn, p["tid"], {"ok": False})
+            return
+        entry = pg.latest_objects().get(name)
+        if entry is None or entry["kind"] == "delete":
+            # deleted since the report: nothing left to heal
+            self._reply_peer(conn, p["tid"], {"ok": True})
+            return
+        got = await self._object_for_push(pg, entry, shard, acting)
+        if got is None:
+            self._reply_peer(conn, p["tid"], {"ok": False})
+            return
+        data, attrs = got
+        try:
+            # force: the reporter's copy is rotten AT the current
+            # version, so the push must overwrite an equal-version row
+            await self._peer_call(
+                reporter, "obj_push",
+                {"pgid": [pg.pool, pg.ps], "shard": shard,
+                 "entry": entry, "has_data": True, "force": True,
+                 "attrs": _attrs_to(attrs)},
+                timeout=5.0, raw=data,
+            )
+        except (asyncio.TimeoutError, RuntimeError):
+            self._reply_peer(conn, p["tid"], {"ok": False})
+            return
+        self.perf.inc("read_error_repaired")
+        if (d := self.dlog.dout(0)) is not None:
+            d(f"osd.{self.id}: osd.{reporter} reported a read error on "
+              f"{pg.coll}/{shard_name(name, shard)}; pushed a rebuilt "
+              f"copy (ver {entry['obj_ver']})")
+        self._cluster_log(
+            "WRN",
+            f"osd.{self.id}: read error on "
+            f"{pg.coll}/{shard_name(name, shard)} reported by "
+            f"osd.{reporter} healed by primary push",
+        )
+        self._reply_peer(conn, p["tid"], {"ok": True})
 
     async def _primary_call(
         self, pg: PG, acting: list[int], name: str, p: dict

@@ -326,6 +326,26 @@ class OSDMap:
                 acting_primary = up_primary
         return up, up_primary, acting, acting_primary
 
+    def read_candidates(self, acting: list[int]) -> list[int]:
+        """Clean-acting balanced-read targets: the live members of the
+        acting set (the client side of CEPH_OSD_FLAG_BALANCE_READS
+        target selection). Positional EC holes and down members are
+        never candidates; backfill targets and peering state are only
+        knowable OSD-side, so the serving OSD re-validates and
+        redirects when it cannot prove its copy current."""
+        return [
+            o for o in acting
+            if o != CRUSH_ITEM_NONE and not self.is_down(o)
+        ]
+
+    def whole_acting(self, acting: list[int]) -> bool:
+        """True when every positional slot of the acting set holds a
+        live OSD — the precondition for EC direct-shard reads (any hole
+        means some shard would need a decode, i.e. the primary path)."""
+        return bool(acting) and all(
+            o != CRUSH_ITEM_NONE and not self.is_down(o) for o in acting
+        )
+
     # -- batched pipeline (the ParallelPGMapper analogue) ----------------------
 
     def _compile(self):

@@ -16,12 +16,14 @@ from __future__ import annotations
 import asyncio
 import itertools
 import json
+import os
 
 from ceph_tpu.common.config import Config
 from ceph_tpu.common.hash import ceph_str_hash_rjenkins
 from ceph_tpu.common.watchdog import SharedWatchdog
 from ceph_tpu.msg import Dispatcher, Message, Messenger, Policy, payload_of
 from ceph_tpu.mon.client import MonClient
+from ceph_tpu.osd.ops import is_mutating
 from ceph_tpu.osd.osdmap import CRUSH_ITEM_NONE
 
 
@@ -81,10 +83,20 @@ class Objecter(Dispatcher):
         self._watchdog = SharedWatchdog()
         #: futures resolved on the next osdmap epoch advance
         self._epoch_waiters: list[asyncio.Future] = []
-        #: per-epoch (pool, ps) -> primary memo (the daemon's acting_of
-        #: idiom client-side: CRUSH runs once per PG per map, not per op)
-        self._target_cache: dict[tuple[int, int], int] = {}
+        #: per-epoch (pool, ps) -> (acting, primary) memo (the daemon's
+        #: acting_of idiom client-side: CRUSH runs once per PG per map,
+        #: not per op — balanced reads and EC shard fan-out need the
+        #: whole acting set, not just the primary)
+        self._target_cache: dict[tuple[int, int], tuple[list[int], int]] = {}
         self._target_cache_epoch = -1
+        #: balanced-read round robin over clean acting members
+        self._rr = itertools.count(0)
+        #: localize: uds hint path -> exists-on-this-host (stat once per
+        #: distinct endpoint, not per read)
+        self._local_addr_cache: dict[str, bool] = {}
+        #: pool -> EC codec for client-side stripe-layout math (None for
+        #: replicated pools / unbuildable profiles)
+        self._client_codecs: dict[int, object] = {}
         self.mon.on_map_change(self._note_map_advance)
         self.mon.on_map_change(self._rewatch_on_map)
 
@@ -283,8 +295,13 @@ class Objecter(Dispatcher):
             return pool.read_tier
         return pool_id
 
-    def _calc_target(self, pool_id: int, name: str) -> int:
-        """pool -> ps -> up/acting -> primary (Objecter::_calc_target)."""
+    def _calc_acting(
+        self, pool_id: int, name: str
+    ) -> tuple[int, int, list[int], int]:
+        """pool -> ps -> (effective pool, ps, acting, primary), memoized
+        per map epoch (Objecter::_calc_target, extended to the whole
+        acting set for balanced-read target selection and EC direct-shard
+        fan-out)."""
         pool_id = self._effective_pool(pool_id)
         pool = self.osdmap.pools.get(pool_id)
         if pool is None:
@@ -294,15 +311,36 @@ class Objecter(Dispatcher):
         if epoch != self._target_cache_epoch:
             self._target_cache.clear()
             self._target_cache_epoch = epoch
-        primary = self._target_cache.get((pool_id, ps))
-        if primary is None:
-            _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(
+        hit = self._target_cache.get((pool_id, ps))
+        if hit is None:
+            _up, _upp, acting, primary = self.osdmap.pg_to_up_acting_osds(
                 pool_id, ps
             )
-            self._target_cache[(pool_id, ps)] = primary
+            hit = (list(acting), primary)
+            self._target_cache[(pool_id, ps)] = hit
+        return pool_id, ps, hit[0], hit[1]
+
+    def _calc_target(self, pool_id: int, name: str) -> int:
+        """pool -> ps -> up/acting -> primary (Objecter::_calc_target)."""
+        eff_pool, ps, _acting, primary = self._calc_acting(pool_id, name)
         if primary in (-1, CRUSH_ITEM_NONE):
-            raise RadosError(f"pg {pool_id}.{ps} has no primary")
+            raise RadosError(f"pg {eff_pool}.{ps} has no primary")
         return primary
+
+    def _osd_is_local(self, osd: int) -> bool:
+        """localize: an OSD whose LocalStack uds endpoint exists on this
+        host is colocated — reads sent there ride the shared-memory
+        transport instead of TCP. One stat per distinct endpoint."""
+        la = self.osdmap.osd_local_addrs.get(osd)
+        if not la:
+            return False
+        hit = self._local_addr_cache.get(la)
+        if hit is None:
+            hit = la.startswith("uds://") and os.path.exists(
+                la.split("://", 1)[1]
+            )
+            self._local_addr_cache[la] = hit
+        return hit
 
     def _note_map_advance(self, _osdmap) -> None:
         waiters, self._epoch_waiters = self._epoch_waiters, []
@@ -343,6 +381,7 @@ class Objecter(Dispatcher):
         data: bytes | None = None,
         timeout: float = 30.0,
         extra: dict | None = None,
+        read_policy: str | None = None,
     ) -> dict:
         deadline = asyncio.get_event_loop().time() + timeout
         last_error = "timed out"
@@ -381,7 +420,7 @@ class Objecter(Dispatcher):
         try:
             return await self._op_submit_inner(
                 pool_id, name, op, data, deadline, last_error, tid,
-                trace_id, span, wire_ctx, extra,
+                trace_id, span, wire_ctx, extra, read_policy,
             )
         except BaseException as e:
             if span is not None:
@@ -422,23 +461,66 @@ class Objecter(Dispatcher):
     #: connection of the most recent op send (trace reporting target)
     _last_conn = None
 
+    def _may_balance(self, op, extra, read_policy) -> bool:
+        """Only plain read-only ops are balanced: mutations, snap reads
+        (primary-side clone resolution), and exotica always target the
+        primary."""
+        if read_policy not in ("balance", "localize"):
+            return False
+        ex = extra or {}
+        if ex.get("snapc") is not None or ex.get("snapid") is not None:
+            return False
+        if op in ("read", "stat"):
+            return True
+        return op == "ops" and not is_mutating(ex.get("ops") or ())
+
     async def _op_submit_inner(
         self, pool_id, name, op, data, deadline, last_error, tid,
-        trace_id, span, wire_ctx, extra,
+        trace_id, span, wire_ctx, extra, read_policy=None,
     ) -> dict:
+        may_balance = self._may_balance(op, extra, read_policy)
+        # a redirect/timeout from a balanced target degrades THIS op to
+        # the primary path for the rest of its retry loop (never bounce
+        # between replicas while the interval is in doubt)
+        forced_primary = False
         while asyncio.get_event_loop().time() < deadline:
+            balanced = False
             try:
-                eff_pool = self._effective_pool(pool_id)
-                primary = self._calc_target(pool_id, name)
-                addr = self.osdmap.osd_addrs.get(primary)
+                eff_pool, ps, acting, primary = self._calc_acting(
+                    pool_id, name
+                )
+                if primary in (-1, CRUSH_ITEM_NONE):
+                    raise RadosError(f"pg {eff_pool}.{ps} has no primary")
+                target = primary
+                if (
+                    may_balance
+                    and not forced_primary
+                    and not self.osdmap.pools[eff_pool].is_erasure()
+                ):
+                    # EC logical reads stay at the primary (the decode
+                    # path); the EC fast path is ec_direct_read
+                    cands = self.osdmap.read_candidates(acting)
+                    if read_policy == "localize":
+                        local = [
+                            o for o in cands if self._osd_is_local(o)
+                        ]
+                        cands = local or cands
+                    if len(cands) > 1:
+                        target = cands[next(self._rr) % len(cands)]
+                    elif cands:
+                        target = cands[0]
+                    balanced = target != primary
+                addr = self.osdmap.osd_addrs.get(target)
                 if addr is None:
-                    raise RadosError(f"no address for osd.{primary}")
+                    raise RadosError(f"no address for osd.{target}")
             except RadosError as e:
                 last_error = str(e)
                 await self._refresh_map()
                 continue
             payload = {"tid": tid, "pool": eff_pool, "name": name,
                        "op": op}
+            if balanced:
+                payload["balanced"] = True
             if trace_id is not None:
                 payload["trace_id"] = trace_id
             if extra:
@@ -448,11 +530,14 @@ class Objecter(Dispatcher):
             try:
                 conn = self.messenger.connect(
                     tuple(addr), Policy.lossless_client(),
-                    local_addr=self.osdmap.osd_local_addrs.get(primary),
+                    local_addr=self.osdmap.osd_local_addrs.get(target),
                 )
                 self._last_conn = conn
                 if span is not None:
-                    span.log(f"sent to osd.{primary}")
+                    span.log(
+                        f"sent to osd.{target}"
+                        + (" (balanced)" if balanced else "")
+                    )
                 conn.send_message(
                     Message(type="osd_op", tid=tid,
                             epoch=self.osdmap.epoch,
@@ -462,9 +547,12 @@ class Objecter(Dispatcher):
                 )
                 reply = await self._watchdog.wait(fut, 3.0)
             except asyncio.TimeoutError:
-                # primary silent (died?): refresh the map and re-target
+                # target silent (died?): refresh the map and re-target;
+                # a silent balanced replica additionally degrades the op
+                # to the primary path (kill -9 mid-read lands here)
                 if span is not None:
-                    span.log(f"resend: osd.{primary} silent")
+                    span.log(f"resend: osd.{target} silent")
+                forced_primary = forced_primary or balanced
                 await self._refresh_map()
                 continue
             finally:
@@ -481,10 +569,19 @@ class Objecter(Dispatcher):
                     span.log("op_reply")
                     reply["trace"] = span.trace_id
                 return reply
+            if reply.get("redirect"):
+                # the balanced target cannot prove its copy current
+                # (peering/backfill/stale marker): finish at the primary
+                if span is not None:
+                    span.log(f"redirect: osd.{target} -> primary")
+                forced_primary = True
+                if reply.get("epoch", 0) > self.osdmap.epoch:
+                    await self._refresh_map()
+                continue
             if reply.get("wrong_primary"):
                 # our map was stale; catch up past the OSD's epoch
                 if span is not None:
-                    span.log(f"retarget: osd.{primary} not primary")
+                    span.log(f"retarget: osd.{target} not primary")
                 await self._refresh_map()
                 continue
             errno = reply.get("errno")
@@ -505,6 +602,129 @@ class Objecter(Dispatcher):
             f"{op} {pool_id}/{name!r} failed: {last_error}"
         )
 
+    # -- EC direct-shard reads -------------------------------------------------
+
+    def _client_codec(self, pool_id: int):
+        """Client-side EC codec for stripe-layout math (k, chunk_index),
+        built lazily from the pool's profile — the same registry the OSD
+        uses, so the computed layout always matches the shards on disk."""
+        if pool_id not in self._client_codecs:
+            codec = None
+            try:
+                pool = self.osdmap.pools[pool_id]
+                profile = dict(
+                    self.osdmap.erasure_code_profiles[
+                        pool.erasure_code_profile
+                    ]
+                )
+                plugin = profile.pop("plugin", "tpu")
+                from ceph_tpu.ec.registry import factory
+
+                codec = factory(plugin, profile)
+            except asyncio.CancelledError:
+                raise
+            # cephlint: disable=error-taxonomy (no codec = no direct reads; the primary path serves)
+            except Exception:
+                codec = None
+            self._client_codecs[pool_id] = codec
+        return self._client_codecs[pool_id]
+
+    async def ec_direct_read(
+        self, pool_id: int, name: str, off: int = 0,
+        length: int | None = None,
+    ) -> bytes | None:
+        """Read an EC object by fetching its k data shards straight from
+        their acting homes in parallel — no primary gather, no decode
+        launch (ECBackend::objects_read_async's not-degraded fast path,
+        moved client-side). Returns None whenever the whole acting set
+        cannot provably serve — any hole, redirect, timeout, or version
+        skew between shards — and the caller falls back to the primary
+        read path, which also owns the authoritative ENOENT."""
+        if self._effective_pool(pool_id) != pool_id:
+            return None  # cache-tier overlay: primary-side logic
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None or not pool.is_erasure():
+            return None
+        ec = self._client_codec(pool_id)
+        if ec is None:
+            return None
+        if length is None and off != 0:
+            return None  # open-ended ranged read: size unknown here
+        try:
+            _pool, ps, acting, primary = self._calc_acting(pool_id, name)
+        except RadosError:
+            return None
+        if not self.osdmap.whole_acting(acting):
+            return None  # degraded interval: the primary decodes
+        k = ec.get_data_chunk_count()
+        positions = [ec.chunk_index(i) for i in range(k)]
+        if any(pos >= len(acting) for pos in positions):
+            return None
+        run = None if length is None else [off, length]
+        span = self.tracer.child(
+            "ec_direct_read",
+            tags={"pool": pool_id, "object": name, "shards": k},
+        )
+        try:
+            reps = await asyncio.gather(
+                *(
+                    self._shard_read_one(
+                        pool_id, name, acting[positions[i]],
+                        positions[i], i, run,
+                    )
+                    for i in range(k)
+                )
+            )
+            if any(r is None or not r.get("ok") for r in reps):
+                return None
+            # every shard must answer at ONE object version and size:
+            # skew means a write landed between our shard reads (or a
+            # shard lagged) — never assemble a torn stripe
+            if (
+                len({r["ver"] for r in reps}) != 1
+                or len({r["size"] for r in reps}) != 1
+            ):
+                return None
+            # replies arrive in data-chunk order (gather preserves it);
+            # each piece is the shard's clip of the requested logical
+            # run, so plain concatenation IS the stripe assembly
+            return b"".join(r["_raw"] for r in reps)
+        finally:
+            if span is not None:
+                span.finish()
+
+    async def _shard_read_one(
+        self, pool_id: int, name: str, osd: int, pos: int, dpos: int,
+        run: list | None,
+    ) -> dict | None:
+        """One ranged shard read straight to its acting home. Every
+        failure shape collapses to None: the caller treats any imperfect
+        fan-out as a fallback to the primary path."""
+        addr = self.osdmap.osd_addrs.get(osd)
+        if addr is None:
+            return None
+        tid = next(self._tids)
+        payload = {"tid": tid, "pool": pool_id, "name": name,
+                   "op": "shard_read", "shard": pos, "dpos": dpos}
+        if run is not None:
+            payload["run"] = run
+        fut = asyncio.get_event_loop().create_future()
+        self._waiters[tid] = fut
+        try:
+            conn = self.messenger.connect(
+                tuple(addr), Policy.lossless_client(),
+                local_addr=self.osdmap.osd_local_addrs.get(osd),
+            )
+            conn.send_message(
+                Message(type="osd_op", tid=tid,
+                        epoch=self.osdmap.epoch, payload=payload)
+            )
+            return await self._watchdog.wait(fut, 2.0)
+        except asyncio.TimeoutError:
+            return None  # shard home silent: fall back, don't retry here
+        finally:
+            self._waiters.pop(tid, None)
+
 
 class IoCtx:
     """Per-pool handle (librados ioctx)."""
@@ -521,12 +741,24 @@ class IoCtx:
         #: (op_queue.QOS_DATA_PREFETCH and friends); None = per-client
         #: default class (the peer name)
         self.qos_class: str | None = None
+        #: per-handle override of rados_read_policy ('primary' |
+        #: 'balance' | 'localize'); None = follow the config knob
+        self.read_policy: str | None = None
 
     def _qos(self, extra: dict | None) -> dict | None:
         if self.qos_class:
             extra = dict(extra) if extra else {}
             extra["qos"] = self.qos_class
         return extra
+
+    def _read_policy(self) -> str | None:
+        """Effective non-primary read policy for this handle, or None
+        when reads pin to the primary (the default — the reference only
+        spreads reads when osd_read_from_replica says so)."""
+        pol = self.read_policy
+        if pol is None:
+            pol = self.objecter.config.get("rados_read_policy")
+        return pol if pol in ("balance", "localize") else None
 
     # -- selfmanaged snapshots ------------------------------------------------
 
@@ -552,11 +784,13 @@ class IoCtx:
 
     async def operate(
         self, name: str, ops: list[dict], datas: list[bytes] = (),
+        read_policy: str | None = None,
     ) -> list[dict]:
         """Execute an op vector atomically at the primary
         (rados_write_op/read_op operate). Data-consuming ops take their
         payload from `datas` in op order; read results come back in each
-        op's result dict ("data" for reads)."""
+        op's result dict ("data" for reads). A read-only vector may be
+        served by any clean replica when `read_policy` says so."""
         extra = {"ops": ops, "data_lens": [len(d) for d in datas]}
         if self.snapc is not None:
             extra["snapc"] = self.snapc
@@ -566,6 +800,7 @@ class IoCtx:
             self.pool_id, name, "ops",
             data=b"".join(datas),
             extra=self._qos(extra),
+            read_policy=read_policy,
         )
         results = rep.get("results", [])
         raw, off = rep["_raw"], 0
@@ -604,10 +839,26 @@ class IoCtx:
         snapid: int | None = None,
     ) -> bytes:
         snap = snapid if snapid is not None else self.read_snap
+        pol = self._read_policy()
+        if (
+            pol is not None
+            and snap is None
+            and (length is not None or off == 0)
+            and self.objecter.config.get("rados_ec_direct_reads")
+        ):
+            # EC fast path: ranged shard reads straight to the k data
+            # shards, no primary gather/decode; None = fall through to
+            # the ordinary (primary or balanced-replica) path
+            data = await self.objecter.ec_direct_read(
+                self.pool_id, name, off, length
+            )
+            if data is not None:
+                return data
         if off == 0 and length is None:
             extra = {"snapid": snap} if snap is not None else None
             rep = await self.objecter.op_submit(
-                self.pool_id, name, "read", extra=self._qos(extra)
+                self.pool_id, name, "read", extra=self._qos(extra),
+                read_policy=pol,
             )
             return rep["_raw"]
         op = {"op": "read", "off": off}
@@ -617,7 +868,7 @@ class IoCtx:
         if snapid is not None:
             self.read_snap = snapid
         try:
-            res = await self.operate(name, [op])
+            res = await self.operate(name, [op], read_policy=pol)
         finally:
             self.read_snap = saved
         return res[0]["data"]
@@ -654,9 +905,14 @@ class IoCtx:
         await self.objecter.op_submit(self.pool_id, name, "cache_evict")
 
     async def stat(self, name: str) -> dict:
-        st = await self.objecter.op_submit(self.pool_id, name, "stat")
+        pol = self._read_policy()
+        st = await self.objecter.op_submit(
+            self.pool_id, name, "stat", read_policy=pol
+        )
         if "size" not in st:
-            res = await self.operate(name, [{"op": "stat"}])
+            res = await self.operate(
+                name, [{"op": "stat"}], read_policy=pol
+            )
             st["size"] = res[0]["size"]
         return st
 

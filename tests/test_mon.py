@@ -46,26 +46,35 @@ async def start_cluster(n=3, dbs=None, cfg=None):
 
 
 async def wait_for_leader(mons, timeout=20.0):
-    loop = asyncio.get_event_loop()
-    end = loop.time() + timeout
-    while loop.time() < end:
+    def stable():
         live = [m for m in mons if not m._stopped]
         leaders = [m for m in live if m.is_leader]
-        if len(leaders) == 1 and all(
+        return len(leaders) == 1 and all(
             m.state in ("leader", "peon") for m in live
-        ):
-            return leaders[0]
-        await asyncio.sleep(0.02)
-    raise TimeoutError("no stable leader")
+        )
+
+    await wait_until(stable, timeout)
+    return next(m for m in mons if not m._stopped and m.is_leader)
 
 
 async def wait_until(pred, timeout=20.0):
+    """Event-driven wait: every mon state transition (election win,
+    lease, paxos commit) rides a dispatched message, so park on the
+    messenger's dispatch hook and re-check per wakeup; the short cap
+    covers purely timer-driven transitions (election timeouts)."""
+    from ceph_tpu.msg.messenger import next_dispatch_event
+
     loop = asyncio.get_event_loop()
     end = loop.time() + timeout
     while not pred():
-        if loop.time() > end:
+        remaining = end - loop.time()
+        if remaining <= 0:
             raise TimeoutError
-        await asyncio.sleep(0.02)
+        fut = next_dispatch_event()
+        try:
+            await asyncio.wait_for(fut, min(0.25, remaining))
+        except asyncio.TimeoutError:
+            pass
 
 
 def test_three_mon_quorum_commits_and_converges():

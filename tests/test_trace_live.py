@@ -17,11 +17,32 @@ import numpy as np
 import pytest
 
 from ceph_tpu.rados.client import Rados
-from tests.test_cluster_live import EC_POOL, REP_POOL, Cluster
+from tests.test_cluster_live import (
+    EC_POOL,
+    REP_POOL,
+    Cluster,
+    wait_until,
+)
 
 
 def run(coro):
     return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+async def dispatch_quiesce(idle=0.05, timeout=5.0):
+    """Wait until NO message dispatches for `idle` seconds — the
+    event-driven way to let in-flight best-effort traffic (trace
+    reports) land, or to prove none is coming, without a blind sleep."""
+    from ceph_tpu.msg.messenger import next_dispatch_event
+
+    loop = asyncio.get_event_loop()
+    end = loop.time() + timeout
+    while loop.time() < end:
+        fut = next_dispatch_event()
+        try:
+            await asyncio.wait_for(fut, idle)
+        except asyncio.TimeoutError:
+            return
 
 
 def traced_cluster_cfg(**overrides):
@@ -71,9 +92,16 @@ def test_traced_write_spans_client_to_blockstore():
         ]
         assert roots, "client root span missing"
         trace_id = roots[-1]["trace_id"]
-        await asyncio.sleep(0.3)  # let the trace_report land
-
         primary = rados.objecter._calc_target(REP_POOL, "traced-obj")
+        # the client ships its spans collector-style (trace_report over
+        # the messenger): wait for the root to land at the primary
+        posd = cluster.osds[primary]
+        await wait_until(
+            lambda: any(
+                s["trace_id"] == trace_id and s["name"] == "op_submit"
+                for s in list(posd.tracer._ring)
+            )
+        )
         dump = await rados.objecter.osd_admin(primary, "dump_tracing")
         assert dump["num_traces"] >= 1
         trace = next(
@@ -104,7 +132,7 @@ def test_traced_write_spans_client_to_blockstore():
         # an UNSAMPLED op leaves nothing behind
         cluster.cfg.set("tracer_sample_rate", 0.0)
         await io.write_full("untraced", b"u" * 2000)
-        await asyncio.sleep(0.1)
+        await dispatch_quiesce()  # any report in flight would dispatch
         dump2 = await rados.objecter.osd_admin(primary, "dump_tracing")
         assert not any(
             s["tags"].get("object", "").endswith("untraced")

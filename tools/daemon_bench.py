@@ -37,6 +37,20 @@ def parse_args():
     ap.add_argument("--m", type=int, default=2)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (tests/dev)")
+    ap.add_argument("--pool", default="ec", choices=("ec", "rep"),
+                    help="pool flavor: ec (k+m profile) or rep "
+                         "(3-replica, the balanced-read A/B substrate)")
+    ap.add_argument("--read-policy", default="primary",
+                    choices=("primary", "balance", "localize"),
+                    help="client read policy for the read leg "
+                         "(rados_read_policy); balance/localize spread "
+                         "reads over clean acting members and take the "
+                         "EC direct-shard path")
+    ap.add_argument("--hot-set", type=int, default=0,
+                    help="read leg hits only the first N objects, "
+                         "round-robin (the hot-object shape balanced "
+                         "reads exist for); 0 = read back everything "
+                         "once")
     # wire fast-path knobs (A/B runs; env CEPH_TPU_MS_* overrides win)
     ap.add_argument("--envelope-format", default=None,
                     choices=("binary", "json"),
@@ -68,6 +82,18 @@ def parse_args():
     ap.add_argument("--worker-id", type=int, default=0,
                     help=argparse.SUPPRESS)
     return ap.parse_args()
+
+
+def read_counts(d: dict) -> dict:
+    """The read-serving slice of one OSD's perf dump: who actually
+    carried the read leg (primary ops vs balanced replica serves vs EC
+    direct-shard ranges), plus bounces."""
+    return {
+        "op_r": d.get("op_r", 0),
+        "read_balanced": d.get("read_balanced", 0),
+        "read_shard_direct": d.get("read_shard_direct", 0),
+        "read_redirected": d.get("read_redirected", 0),
+    }
 
 
 async def main(args) -> dict:
@@ -109,18 +135,26 @@ async def main(args) -> dict:
 
     rados = Rados("client.bench", monmap, config=cfg)
     await rados.connect()
-    await rados.mon_command(
-        "osd erasure-code-profile set",
-        {"name": "bench",
-         "profile": {"plugin": "tpu", "k": str(args.k),
-                     "m": str(args.m)}},
-    )
-    await rados.mon_command(
-        "osd pool create",
-        {"pool_id": 1, "crush_rule": 0,
-         "erasure_code_profile": "bench", "pg_num": 16},
-    )
+    if args.pool == "rep":
+        await rados.mon_command(
+            "osd pool create",
+            {"pool_id": 1, "crush_rule": 1, "size": 3, "pg_num": 16},
+        )
+    else:
+        await rados.mon_command(
+            "osd erasure-code-profile set",
+            {"name": "bench",
+             "profile": {"plugin": "tpu", "k": str(args.k),
+                         "m": str(args.m)}},
+        )
+        await rados.mon_command(
+            "osd pool create",
+            {"pool_id": 1, "crush_rule": 0,
+             "erasure_code_profile": "bench", "pg_num": 16},
+        )
     io = rados.io_ctx(1)
+    if args.read_policy != "primary":
+        io.read_policy = args.read_policy
     payload = bytes(range(256)) * (args.size // 256)
 
     # warm: peering + first-compile of the planar kernel at this shape
@@ -176,13 +210,35 @@ async def main(args) -> dict:
         o.encode_service.objects - before[i][1] for i, o in osds.items()
     )
 
-    # read-back leg
+    # read-back leg; with --hot-set the whole leg hammers a few objects
+    # (one primary each) — the shape where the read policy matters
+    reads0 = {i: read_counts(o.perf.dump()) for i, o in osds.items()}
     t0 = time.perf_counter()
-    await asyncio.gather(*(
-        io.read(f"o-{w}-{j}")
-        for w in range(args.concurrency) for j in range(per)
-    ))
+    if args.hot_set:
+        hot = [f"o-0-{j % per}" for j in range(args.hot_set)]
+
+        async def stream_hot(w: int):
+            for j in range(per):
+                await io.read(hot[(w + j) % len(hot)])
+
+        await asyncio.gather(
+            *(stream_hot(w) for w in range(args.concurrency))
+        )
+        read_bytes = per * args.concurrency * len(payload)
+    else:
+        await asyncio.gather(*(
+            io.read(f"o-{w}-{j}")
+            for w in range(args.concurrency) for j in range(per)
+        ))
+        read_bytes = total_bytes
     read_elapsed = time.perf_counter() - t0
+    read_dist = {
+        i: {
+            k: v - reads0[i][k]
+            for k, v in read_counts(o.perf.dump()).items()
+        }
+        for i, o in osds.items()
+    }
 
     # what the client's OSD sessions actually negotiated (the uds->shm
     # upgrade is per connection; "local" means at least one made it)
@@ -201,7 +257,9 @@ async def main(args) -> dict:
         "mode": "single-process",
         "ncores": os.cpu_count(),
         "write_gbps": total_bytes / elapsed / 1e9,
-        "read_gbps": total_bytes / read_elapsed / 1e9,
+        "read_gbps": read_bytes / read_elapsed / 1e9,
+        "read_policy": args.read_policy,
+        "read_distribution": read_dist,
         "objects": objects,
         "launches": launches,
         "coalescing": objects / max(1, launches),
@@ -236,6 +294,8 @@ async def client_worker(args) -> dict:
     )
     await rados.connect()
     io = rados.io_ctx(1)
+    if args.read_policy != "primary":
+        io.read_policy = args.read_policy
     payload = bytes(range(256)) * (args.size // 256)
     names = [
         f"o-{args.worker_id}-{j}" for j in range(args.objects)
@@ -251,16 +311,31 @@ async def client_worker(args) -> dict:
     await asyncio.gather(*(stream(c) for c in chunks))
     w1 = time.time()
 
+    # hot-set reads hit worker 0's objects so EVERY client process
+    # contends on the same few primaries under policy=primary
+    if args.hot_set:
+        rnames = [
+            f"o-0-{j % args.objects}" for j in range(args.hot_set)
+        ]
+        reads = [
+            rnames[(args.worker_id + j) % len(rnames)]
+            for j in range(args.objects)
+        ]
+    else:
+        reads = names
+
     async def stream_r(chunk):
         for name in chunk:
             await io.read(name)
 
+    rchunks = [reads[i::lanes] for i in range(lanes)]
     r0 = time.time()
-    await asyncio.gather(*(stream_r(c) for c in chunks))
+    await asyncio.gather(*(stream_r(c) for c in rchunks))
     r1 = time.time()
     await rados.shutdown()
     return {
         "bytes": len(payload) * len(names),
+        "read_bytes": len(payload) * len(reads),
         "write_window": [w0, w1],
         "read_window": [r0, r1],
     }
@@ -285,23 +360,40 @@ async def main_multiprocess(args) -> dict:
         rados = v.client()
         await rados.connect()
         await v.wait_healthy(rados=rados, timeout=120)
-        await rados.mon_command(
-            "osd erasure-code-profile set",
-            {"name": "bench",
-             "profile": {"plugin": "tpu", "k": str(args.k),
-                         "m": str(args.m)}},
-        )
-        await rados.mon_command(
-            "osd pool create",
-            {"pool_id": 1, "crush_rule": 0,
-             "erasure_code_profile": "bench", "pg_num": 32},
-        )
+        if args.pool == "rep":
+            await rados.mon_command(
+                "osd pool create",
+                {"pool_id": 1, "crush_rule": 1, "size": 3,
+                 "pg_num": 32},
+            )
+        else:
+            await rados.mon_command(
+                "osd erasure-code-profile set",
+                {"name": "bench",
+                 "profile": {"plugin": "tpu", "k": str(args.k),
+                             "m": str(args.m)}},
+            )
+            await rados.mon_command(
+                "osd pool create",
+                {"pool_id": 1, "crush_rule": 0,
+                 "erasure_code_profile": "bench", "pg_num": 32},
+            )
         io = rados.io_ctx(1)
         payload = bytes(range(256)) * (args.size // 256)
         # warm: peering + per-OSD first-compile at this shape
         for i in range(2 * args.osds):
             await io.write_full(f"warm-{i}", payload)
-        await rados.shutdown()
+
+        async def fleet_reads() -> dict:
+            out = {}
+            for osd in range(args.osds):
+                dump = await rados.objecter.osd_admin(osd, "perf dump")
+                out[osd] = read_counts(dump.get(f"osd.{osd}", {}))
+            return out
+
+        # write legs never touch the read counters, so the pre-spawn
+        # snapshot isolates the workers' read legs exactly
+        reads0 = await fleet_reads()
 
         per_client = max(1, args.objects // args.clients)
         lanes = max(1, args.concurrency // args.clients)
@@ -314,7 +406,9 @@ async def main_multiprocess(args) -> dict:
                  "--worker-id", str(w),
                  "--objects", str(per_client),
                  "--size", str(args.size),
-                 "--concurrency", str(lanes)],
+                 "--concurrency", str(lanes),
+                 "--read-policy", args.read_policy,
+                 "--hot-set", str(args.hot_set)],
                 stdout=subprocess.PIPE, env=env,
             )
             for w in range(args.clients)
@@ -327,7 +421,15 @@ async def main_multiprocess(args) -> dict:
                     f"(rc={p.returncode})"
                 )
         outs = [json.loads(o) for o in raw_outs]
+        reads1 = await fleet_reads()
+        read_dist = {
+            osd: {k: reads1[osd][k] - reads0[osd][k]
+                  for k in reads1[osd]}
+            for osd in reads1
+        }
+        await rados.shutdown()
         total = sum(o["bytes"] for o in outs)
+        read_total = sum(o.get("read_bytes", o["bytes"]) for o in outs)
         w_span = max(o["write_window"][1] for o in outs) - min(
             o["write_window"][0] for o in outs
         )
@@ -338,7 +440,9 @@ async def main_multiprocess(args) -> dict:
             "mode": "multiprocess",
             "ncores": os.cpu_count(),
             "write_gbps": total / w_span / 1e9,
-            "read_gbps": total / r_span / 1e9,
+            "read_gbps": read_total / r_span / 1e9,
+            "read_policy": args.read_policy,
+            "read_distribution": read_dist,
             "object_size": args.size,
             "objects": per_client * args.clients,
             "k": args.k,
