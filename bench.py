@@ -626,6 +626,57 @@ def _balance_line() -> None:
         pass
 
 
+def _telemetry_line() -> None:
+    """Optional JSON line: the telemetry tax. Two daemon_bench runs —
+    without and with an active mgr (every OSD pushing perf-counter
+    delta reports on mgr_report_interval) — report the write-throughput
+    overhead of always-on telemetry (target < 2%), plus the scrape-cost
+    A/B the push store exists for: rendering /metrics from the mgr's
+    time-series store vs the old per-scrape `perf dump` pull fan-out
+    at the same 6-OSD fleet. Guarded (--telemetry /
+    CEPH_TPU_BENCH_TELEMETRY=1) and non-fatal."""
+    try:
+        import subprocess
+
+        def run_bench(with_mgr: bool) -> dict:
+            argv = [sys.executable, "tools/daemon_bench.py", "--cpu",
+                    "--osds", "6", "--size", "65536", "--objects", "48",
+                    "--concurrency", "12"]
+            if with_mgr:
+                argv.append("--mgr")
+            out = subprocess.run(
+                argv, capture_output=True, timeout=600, check=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            return json.loads(out.stdout)
+
+        quiet = run_bench(False)
+        telem = run_bench(True)
+        mgr = telem["mgr"]
+        overhead = 100 * (
+            quiet["write_gbps"] - telem["write_gbps"]
+        ) / quiet["write_gbps"]
+        print(json.dumps({
+            "metric": "telemetry_overhead",
+            "value": round(overhead, 2),
+            "unit": "%",
+            "quiet_write_gbps": round(quiet["write_gbps"], 4),
+            "telemetry_write_gbps": round(telem["write_gbps"], 4),
+            "within_target": bool(overhead < 2.0),
+            "daemons_reporting": mgr["daemons_reporting"],
+            # the scrape A/B: push store vs per-scrape pull fan-out
+            "scrape_push_ms": mgr["scrape_push_ms"],
+            "scrape_pull_ms": mgr["scrape_pull_ms"],
+            "scrape_speedup": round(
+                mgr["scrape_pull_ms"] / max(1e-9, mgr["scrape_push_ms"]),
+                2),
+            "push_series": mgr["push_series"],
+            "pull_series": mgr["pull_series"],
+        }))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def _lint_line() -> None:
     """Optional JSON line: cephlint summary counts (files, checks run,
     findings, suppressions, baseline size) so the BENCH trajectory also
@@ -725,6 +776,10 @@ def main() -> None:
         "CEPH_TPU_BENCH_BALANCE"
     ):
         _balance_line()
+    if "--telemetry" in sys.argv[1:] or os.environ.get(
+        "CEPH_TPU_BENCH_TELEMETRY"
+    ):
+        _telemetry_line()
     if "--lint" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_LINT"):
         _lint_line()
 

@@ -99,6 +99,12 @@ class OpTracker:
             op.done = time.time()
             self._history.append(op)
 
+    @property
+    def num_in_flight(self) -> int:
+        """Tracked ops currently executing — the cheap count the mgr
+        report tick ships (dump_ops_in_flight formats every op)."""
+        return len(self._in_flight)
+
     def track(self, description: str, span=None) -> "_TrackCtx":
         """Context manager tracking one op."""
         return _TrackCtx(self, description, span)

@@ -265,6 +265,18 @@ SCHEMA: dict[str, Option] = {
         _opt("mgr_beacon_grace", TYPE_FLOAT, LEVEL_ADVANCED, 3.0,
              "silence after which the active mgr is considered dead "
              "and a standby promotes"),
+        _opt("mgr_report_interval", TYPE_FLOAT, LEVEL_ADVANCED, 1.0,
+             "seconds between perf-counter delta reports from each "
+             "daemon to the active mgr (MgrClient report cadence)"),
+        _opt("mgr_metrics_window", TYPE_UINT, LEVEL_ADVANCED, 120,
+             "samples retained per counter in the mgr's per-daemon "
+             "ring time-series (bounds memory; rates/percentiles are "
+             "computed over this window)"),
+        _opt("mgr_slo_rules", TYPE_STR, LEVEL_ADVANCED, "",
+             "semicolon-separated SLO rules evaluated by the mgr "
+             "metrics module, e.g. 'op_latency.p99 < 2s @ 30; "
+             "read_redirected/read_balanced < 0.05'; violations "
+             "surface as MGR_SLO_VIOLATION health checks"),
         _opt("mds_beacon_interval", TYPE_FLOAT, LEVEL_ADVANCED, 0.5,
              "seconds between MDS beacons to the mon"),
         _opt("mds_max_active", TYPE_UINT, LEVEL_BASIC, 1,

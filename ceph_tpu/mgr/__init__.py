@@ -8,13 +8,20 @@ mon, run the upmap optimization on the batched TPU mapper
 (OSDMap.calc_pg_upmaps — whole-pool placement in a handful of device
 launches), and commit the resulting pg_upmap_items through the mon's
 command path so every daemon and client re-targets on the next epoch.
+
+`MetricsModule` (PR 18) is the telemetry substrate: daemons push
+perf-counter delta reports to the active mgr, which rings them into
+bounded per-daemon time-series, serves Prometheus/`ceph top` from the
+store, and evaluates declarative SLO rules into health checks.
 """
 
 from ceph_tpu.mgr.autoscaler import PgAutoscaler
 from ceph_tpu.mgr.balancer import BalancerModule
 from ceph_tpu.mgr.daemon import MgrService
+from ceph_tpu.mgr.metrics import MetricsModule, parse_slo_rules
 from ceph_tpu.mgr.prometheus import PrometheusExporter
 
 __all__ = [
-    "BalancerModule", "MgrService", "PgAutoscaler", "PrometheusExporter",
+    "BalancerModule", "MetricsModule", "MgrService", "PgAutoscaler",
+    "PrometheusExporter", "parse_slo_rules",
 ]

@@ -9,8 +9,16 @@ when the active goes silent past mgr_beacon_grace a standby's next
 beacon promotes it. Only the active runs module work; a demoted/revived
 mgr re-admits as standby.
 
+Since PR 18 the mgr also binds its own messenger endpoint: daemons push
+perf-counter delta reports to the ACTIVE mgr (MgrClient::_send_report /
+DaemonServer::handle_report) on the mgr_report_interval tick. The
+beacon advertises the endpoint, the mon publishes it in the MgrMap's
+``addrs``, and the MetricsModule rings the samples, evaluates SLO rules
+and feeds MGR_SLO_VIOLATION checks back to the mon's health report.
+
 Reference: src/mon/MgrMonitor.cc (map + failover), src/mgr/MgrStandby.cc
-(active/standby daemon states), src/pybind/mgr (the hosted module tier).
+(active/standby daemon states), src/mgr/DaemonServer.cc (report
+ingestion), src/pybind/mgr (the hosted module tier).
 """
 
 from __future__ import annotations
@@ -18,7 +26,49 @@ from __future__ import annotations
 import asyncio
 
 from ceph_tpu.common.config import Config
+from ceph_tpu.common.log import LogRegistry
+from ceph_tpu.mgr.metrics import MetricsModule
+from ceph_tpu.msg.frames import Message, payload_of
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.rados.client import Objecter
+
+
+class _ReportDispatcher(Dispatcher):
+    """The mgr endpoint's inbound surface: daemon perf reports plus the
+    small `ceph top` command protocol (DaemonServer's MCommand role)."""
+
+    def __init__(self, mgr: "MgrService"):
+        self.mgr = mgr
+
+    async def ms_dispatch(self, conn, msg: Message) -> None:
+        if msg.type == "mgr_report":
+            # a standby must not accumulate series: its store would be
+            # stale baselines the moment it promoted — drop, quietly
+            if not self.mgr.active:
+                if (d := self.mgr.dlog.dout(20)) is not None:
+                    d(f"{self.mgr.name} standby: dropping report "
+                      f"from {conn.peer_name}")
+                return
+            self.mgr.metrics.ingest(payload_of(msg))
+            return
+        if msg.type == "mgr_command":
+            p = payload_of(msg)
+            try:
+                if not self.mgr.active:
+                    raise RuntimeError(f"{self.mgr.name} is standby")
+                cmd = p.get("cmd")
+                if cmd == "top":
+                    result = self.mgr.metrics.top_document()
+                elif cmd == "slo":
+                    result = self.mgr.metrics.slo_document()
+                else:
+                    raise RuntimeError(f"unknown mgr command {cmd!r}")
+                reply = {"ok": True, "result": result}
+            except Exception as e:
+                reply = {"ok": False, "error": str(e)}
+            conn.send_message(Message(
+                type="mgr_command_reply", tid=msg.tid, payload=reply
+            ))
 
 
 class MgrService:
@@ -31,15 +81,26 @@ class MgrService:
         self.objecter = Objecter(
             name, monmap, config=self.config, keyring=keyring
         )
+        self.logs = LogRegistry(self.config)
+        self.dlog = self.logs.get_logger("mgr")
         self.active = False
         self._stopped = False
         self._tasks: list[asyncio.Task] = []
         #: lazily built when active: module name -> instance
         self.modules: dict[str, object] = {}
+        #: the push-report store + SLO engine; exists while standby too
+        #: (so early reports are dropped deliberately, not AttributeError)
+        self.metrics = MetricsModule(self.config, logger=self.dlog)
+        #: our own endpoint: daemons push mgr_report frames here; the
+        #: address is advertised through the beacon -> MgrMap
+        self.msgr = Messenger(name, config=self.config, keyring=keyring)
+        self.msgr.dispatcher = _ReportDispatcher(self)
 
     async def start(self) -> None:
+        await self.msgr.bind()
         await self.objecter.start()
         self._tasks.append(asyncio.create_task(self._beacon_loop()))
+        self._tasks.append(asyncio.create_task(self._slo_loop()))
 
     async def stop(self) -> None:
         self._stopped = True
@@ -52,6 +113,7 @@ class MgrService:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass
+        await self.msgr.shutdown()
         await self.objecter.close()
 
     # -- lifecycle -------------------------------------------------------------
@@ -61,7 +123,10 @@ class MgrService:
         while not self._stopped:
             try:
                 rep = await self.objecter.mon.command(
-                    "mgr beacon", {"name": self.name}, timeout=5.0
+                    "mgr beacon",
+                    {"name": self.name,
+                     "addr": list(self.msgr.my_addr)},
+                    timeout=5.0,
                 )
                 was = self.active
                 self.active = (
@@ -84,6 +149,10 @@ class MgrService:
         from ceph_tpu.mgr.dashboard import DashboardModule
         from ceph_tpu.mgr.prometheus import PrometheusExporter
 
+        # failover baseline reset: whatever partial series a previous
+        # active stint (or stray pre-promotion report) left behind must
+        # not mix with the fresh full reports daemons send a new active
+        self.metrics.reset()
         balancer = BalancerModule(
             self.objecter.mon,
             tracer=getattr(self.objecter, "tracer", None),
@@ -96,11 +165,35 @@ class MgrService:
         self.modules = {
             "balancer": balancer,
             "pg_autoscaler": PgAutoscaler(self.objecter),
+            "metrics": self.metrics,
             "prometheus": PrometheusExporter(
-                self.objecter, local_perf=self.perf_collection
+                self.objecter, local_perf=self.perf_collection,
+                metrics=self.metrics,
             ),
             "dashboard": DashboardModule(self.objecter),
         }
+
+    async def _slo_loop(self) -> None:
+        """The active mgr's health feed: evaluate the SLO rules every
+        report tick and ship the (possibly empty) check set to the mon,
+        which merges it into `_health()`. An empty report CLEARS a
+        previous violation — silence only clears via the mon's
+        staleness horizon (mgr died)."""
+        while not self._stopped:
+            await asyncio.sleep(self.config.get("mgr_report_interval"))
+            if not self.active:
+                continue
+            self.metrics.prune()
+            checks = self.metrics.health_checks()
+            try:
+                await self.objecter.mon.command(
+                    "mgr health report",
+                    {"name": self.name, "checks": checks},
+                    timeout=5.0,
+                )
+            # cephlint: disable=error-taxonomy (mon churn: next tick re-reports)
+            except Exception:
+                pass  # mon churn: next tick re-reports
 
     # -- module surface --------------------------------------------------------
 

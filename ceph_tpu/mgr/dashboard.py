@@ -9,6 +9,7 @@ ACTIVE MgrService hosts:
                        capacity, fsmap/mgrmap) as JSON
     GET /api/df        `ceph df` usage report
     GET /api/health    health checks
+    GET /api/slo       the metrics module's SLO rule verdicts
     GET /metrics       the prometheus exporter's scrape text
 
 Standbys refuse with 503 — the failover behavior operators probe.
@@ -25,16 +26,20 @@ class DashboardModule:
         self.objecter = objecter
 
     async def status(self) -> dict:
+        # four independent mon round-trips: fan them out concurrently —
+        # the document costs the slowest hop, not the sum of the four
         mon = self.objecter.mon
-        status = await mon.command("status")
-        df = await mon.command("df")
-        fsmap = (await mon.command("fs map"))["fsmap"]
-        mgrmap = (await mon.command("mgr map"))["mgrmap"]
+        status, df, fsmap, mgrmap = await asyncio.gather(
+            mon.command("status"),
+            mon.command("df"),
+            mon.command("fs map"),
+            mon.command("mgr map"),
+        )
         return {
             "cluster": status,
             "df": df,
-            "fsmap": fsmap,
-            "mgrmap": mgrmap,
+            "fsmap": fsmap["fsmap"],
+            "mgrmap": mgrmap["mgrmap"],
         }
 
 
@@ -106,6 +111,9 @@ class DashboardServer:
             if target.startswith("/api/health"):
                 h = await self.mgr.objecter.mon.command("health")
                 return 200, "application/json", json.dumps(h).encode()
+            if target.startswith("/api/slo"):
+                doc = self.mgr.modules["metrics"].slo_document()
+                return 200, "application/json", json.dumps(doc).encode()
             if target.startswith("/metrics"):
                 text = await self.mgr.prometheus_scrape()
                 return 200, "text/plain; version=0.0.4", text.encode()

@@ -17,6 +17,8 @@ distribution.
 
 from __future__ import annotations
 
+import asyncio
+
 
 def _sanitize(name: str) -> str:
     return "".join(c if c.isalnum() else "_" for c in name)
@@ -59,11 +61,16 @@ def render_perf_value(emit, key: str, value, labels: dict) -> None:
 class PrometheusExporter:
     PREFIX = "ceph_tpu"
 
-    def __init__(self, objecter, local_perf=None):
+    def __init__(self, objecter, local_perf=None, metrics=None):
         self.objecter = objecter
         #: optional PerfCountersCollection of mgr-LOCAL blocks (balancer
         #: moves/launches/spread): scraped in-process, no admin hop
         self.local_perf = local_perf
+        #: optional MetricsModule: when daemons push reports, /metrics
+        #: is served from the time-series store with NO per-daemon admin
+        #: hop on the scrape path (the reference mgr's DaemonStateIndex
+        #: role); without it we fall back to pulling perf dumps
+        self.metrics = metrics
 
     async def collect(self) -> str:
         osdmap = self.objecter.osdmap
@@ -139,24 +146,63 @@ class PrometheusExporter:
 
         # per-daemon perf counters (TIME_AVG/HISTOGRAM expanded into
         # their native Prometheus representations)
-        for osd in range(osdmap.max_osd):
-            if osdmap.is_down(osd):
-                continue
-            try:
-                dump = await self.objecter.osd_admin(
-                    osd, "perf dump", timeout=10.0
+        def emit_daemon(logger: str, counters: dict) -> None:
+            for key, value in sorted(counters.items()):
+                render_perf_value(
+                    lambda n, v, lab, t, type_name=None: gauge(
+                        f"daemon_{n}", v, lab, t,
+                        type_name=(None if type_name is None
+                                   else f"daemon_{type_name}"),
+                    ),
+                    key, value, {"daemon": logger},
                 )
-            # cephlint: disable=error-taxonomy (daemon restarting: skip its counters this scrape)
-            except Exception:
-                continue
-            for logger, counters in sorted(dump.items()):
-                for key, value in sorted(counters.items()):
-                    render_perf_value(
-                        lambda n, v, lab, t, type_name=None: gauge(
-                            f"daemon_{n}", v, lab, t,
-                            type_name=(None if type_name is None
-                                       else f"daemon_{type_name}"),
-                        ),
-                        key, value, {"daemon": logger},
+
+        served_from_store = False
+        if self.metrics is not None:
+            blocks = list(self.metrics.latest_blocks())
+            if blocks:
+                served_from_store = True
+                for _daemon, block, counters in blocks:
+                    emit_daemon(block, counters)
+                # windowed rates the pull model could never render:
+                # first-class per-counter ops/sec series from the ring
+                for block, key, rate in self.metrics.series_rates():
+                    gauge(
+                        "daemon_counter_rate", rate,
+                        {"daemon": block, "counter": key},
                     )
+                # SLO verdicts: slo_ok 1/0 + relative margin per rule
+                for res in self.metrics.evaluate_slos():
+                    gauge(
+                        "slo_ok", int(bool(res["ok"])),
+                        {"rule": res["rule"]},
+                    )
+                    if res["margin"] is not None:
+                        gauge(
+                            "slo_margin", res["margin"],
+                            {"rule": res["rule"]},
+                        )
+        if not served_from_store:
+            # pull fallback (no reports yet / library use): the admin
+            # hops fan out concurrently — scrape latency is the max of
+            # the per-daemon round trips, not their sum
+            async def pull(osd: int):
+                try:
+                    return await self.objecter.osd_admin(
+                        osd, "perf dump", timeout=10.0
+                    )
+                # cephlint: disable=error-taxonomy (daemon restarting: skip its counters this scrape)
+                except Exception:
+                    return None
+
+            live = [
+                osd for osd in range(osdmap.max_osd)
+                if not osdmap.is_down(osd)
+            ]
+            dumps = await asyncio.gather(*(pull(osd) for osd in live))
+            for dump in dumps:
+                if dump is None:
+                    continue
+                for logger, counters in sorted(dump.items()):
+                    emit_daemon(logger, counters)
         return "\n".join(lines) + "\n"

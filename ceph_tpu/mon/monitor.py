@@ -163,8 +163,18 @@ class Monitor(Dispatcher):
         #: manager + standbys, paxos-replicated via the "mgrmap" service;
         #: gives the module tier (balancer/autoscaler/prometheus) a
         #: daemon lifecycle instead of running as client library code
-        self.mgrmap: dict = {"epoch": 0, "active": None, "standbys": []}
+        self.mgrmap: dict = {
+            "epoch": 0, "active": None, "standbys": [], "addrs": {},
+        }
         self._mgr_beacons: dict[str, float] = {}
+        #: mgr name -> report-endpoint addr from its beacon
+        #: (leader-volatile; published through mgrmap proposes so OSDs
+        #: learn where to push their perf reports)
+        self._mgr_addrs: dict[str, list] = {}
+        #: (stamp, checks) the ACTIVE mgr last fed us (MgrStatMonitor's
+        #: health segment: SLO violations etc.); leader-volatile, merged
+        #: into _health() while fresh
+        self._mgr_health: tuple[float, dict] | None = None
         self._mds_beacons: dict[str, float] = {}
         self._replay_committed()
         #: peer_name -> (connection, from_epoch) map subscribers
@@ -1569,6 +1579,17 @@ class Monitor(Dispatcher):
             return {}
         if cmd == "health":
             return self._health()
+        if cmd == "mgr health report":
+            # the ACTIVE mgr feeds module-computed checks (SLO
+            # violations) into _health(); an empty checks dict clears.
+            # Leader-volatile like _pg_stats: a new leader gets the
+            # next tick's report
+            if args.get("name") == self.mgrmap.get("active"):
+                self._mgr_health = (
+                    asyncio.get_event_loop().time(),
+                    dict(args.get("checks") or {}),
+                )
+            return {}
         if cmd == "dump_tracing":
             # mon-side completed spans (command dispatch hops), the same
             # drain surface the OSD admin socket exposes
@@ -1591,8 +1612,11 @@ class Monitor(Dispatcher):
         stand by, a standby's beacon promotes it once the active's
         silence exceeds mgr_beacon_grace."""
         name = args["name"]
+        addr = args.get("addr")
         now = asyncio.get_event_loop().time()
         self._mgr_beacons[name] = now
+        if addr is not None:
+            self._mgr_addrs[name] = list(addr)
         mm = self.mgrmap
         if mm["active"] is not None:
             self._mgr_beacons.setdefault(mm["active"], now)
@@ -1617,7 +1641,26 @@ class Monitor(Dispatcher):
                 "active": name,
                 "standbys": [s for s in mm["standbys"] if s != name],
             }
+        elif (
+            addr is not None
+            and (mm.get("addrs") or {}).get(name) != list(addr)
+        ):
+            # known mgr rebound its report endpoint (restart under the
+            # same name): republish the map so daemons re-target
+            propose = {"active": mm["active"],
+                       "standbys": list(mm["standbys"])}
         if propose is not None:
+            # _apply_value replaces the map wholesale, so every propose
+            # must carry the addrs of all members it names forward
+            members = set(propose["standbys"])
+            if propose["active"] is not None:
+                members.add(propose["active"])
+            published = mm.get("addrs") or {}
+            propose["addrs"] = {
+                n: self._mgr_addrs.get(n, published.get(n))
+                for n in members
+                if self._mgr_addrs.get(n, published.get(n)) is not None
+            }
             await self.propose("mgrmap", json.dumps(propose).encode())
         return {"mgrmap": self.mgrmap}
 
@@ -1807,6 +1850,13 @@ class Monitor(Dispatcher):
                     "summary": f"{agg[key]} {noun}",
                     "count": agg[key],
                 }
+        # mgr-fed checks (MGR_SLO_VIOLATION etc.): merged while fresh —
+        # the active mgr re-reports every mgr_report_interval, so a
+        # stale entry means the mgr died and its verdicts with it
+        if self._mgr_health is not None:
+            t, mgr_checks = self._mgr_health
+            if now - t <= 30 and self.mgrmap.get("active") is not None:
+                checks.update(mgr_checks)
         if any(
             c["severity"] == "HEALTH_ERR" for c in checks.values()
         ):

@@ -500,8 +500,17 @@ class OSDService(Dispatcher):
             ("tier_miss", "cache misses with no base object either"),
             ("tier_flush", "dirty objects flushed to the base pool"),
             ("tier_evict", "clean objects evicted from the cache"),
+            ("op_in_bytes", "client payload bytes written as primary"),
+            ("op_out_bytes", "client payload bytes read as primary"),
         ):
             self.perf.add_u64_counter(key, desc)
+        # sampled by the mgr report tick (and perf dump): ops queued on
+        # the shards + pipelined in-flight tasks — the overload signal
+        # mgr SLO rules like `osd_queue_depth.avg < N` watch
+        self.perf.add_u64(
+            "osd_queue_depth",
+            "client ops queued on the op shards or executing",
+        )
         # write-path leg timings (the l_* time_avg family the reference
         # keeps in l_osd_op_w_process_lat etc.): where a client op's
         # wall time goes, for `perf dump` + the latency investigations
@@ -603,6 +612,9 @@ class OSDService(Dispatcher):
                 self.inflight: dict[str, set] = {}
 
         self._op_shards = [_OpShard() for _ in range(4)]
+        #: pool id -> client ops served as primary (cumulative); rides
+        #: the mgr report's status section for `ceph top` per-pool rows
+        self._pool_ops: dict[int, int] = {}
         self._tasks: list[asyncio.Task] = []
         self._ephemeral: set[asyncio.Task] = set()
         self._next_reboot = 0.0
@@ -672,6 +684,7 @@ class OSDService(Dispatcher):
         self._tasks.append(asyncio.create_task(self._peering_loop()))
         self._tasks.append(asyncio.create_task(self._resub_loop()))
         self._tasks.append(asyncio.create_task(self._pg_stats_loop()))
+        self._tasks.append(asyncio.create_task(self._mgr_report_loop()))
         if self.messenger.keyring is not None:
             # cephx: fetch the rotating service-key window so client
             # tickets verify locally, and keep it fresh through
@@ -1468,6 +1481,88 @@ class OSDService(Dispatcher):
             # cephlint: disable=error-taxonomy (mon churn: next interval re-reports)
             except Exception:
                 pass  # mon churn: next interval re-reports
+
+    def _update_queue_depth(self) -> int:
+        """Refresh the osd_queue_depth gauge: ops waiting on the shard
+        queues plus pipelined tasks already executing."""
+        depth = 0
+        for shard in self._op_shards:
+            depth += len(shard.queue)
+            depth += sum(len(s) for s in shard.inflight.values())
+        self.perf.set("osd_queue_depth", depth)
+        return depth
+
+    async def _mgr_report_loop(self) -> None:
+        """Push perf-counter reports to the ACTIVE mgr every
+        mgr_report_interval (MgrClient::_send_report): the mgr never
+        pulls `perf dump`s on its scrape path. Reports are
+        delta-compacted — only counters that changed since the last
+        send ride the wire — but values stay CUMULATIVE, so a dropped
+        report just widens the next sample's span instead of corrupting
+        rates. The active mgr's address rides the MgrMap the mon builds
+        from mgr beacons; on failover we re-prime with a full report so
+        the new mgr's empty store gets complete baselines."""
+        last_sent: dict[tuple[str, str], object] = {}
+        target: tuple[str, tuple] | None = None
+        refreshed = float("-inf")
+        seq = 0
+        while not self._stopped:
+            interval = self.config.get("mgr_report_interval")
+            await asyncio.sleep(interval)
+            loop = asyncio.get_event_loop()
+            # refresh the MgrMap on the stale horizon ONLY — a cluster
+            # with no mgr at all must not pay a mon round-trip per tick
+            if loop.time() - refreshed > max(4 * interval, 2.0):
+                try:
+                    rep = await self.mon.command("mgr map", timeout=5.0)
+                # cephlint: disable=error-taxonomy (mon churn: next tick retries)
+                except Exception:
+                    continue
+                refreshed = loop.time()
+                mm = rep.get("mgrmap") or {}
+                active = mm.get("active")
+                addr = (mm.get("addrs") or {}).get(active)
+                if not active or not addr:
+                    target = None
+                else:
+                    fresh = (active, tuple(addr))
+                    if target != fresh:
+                        target = fresh
+                        last_sent = {}
+            if target is None:
+                continue
+            queue_depth = self._update_queue_depth()
+            full = not last_sent
+            counters: dict[str, dict] = {}
+            for block, kv in self.perf_collection.dump().items():
+                for key, val in kv.items():
+                    if full or last_sent.get((block, key)) != val:
+                        counters.setdefault(block, {})[key] = val
+                        last_sent[(block, key)] = val
+            seq += 1
+            report = {
+                "daemon": self.name,
+                "seq": seq,
+                "full": full,
+                "counters": counters,
+                "status": {
+                    "queue_depth": queue_depth,
+                    "inflight_ops": self.op_tracker.num_in_flight,
+                    "pool_ops": {
+                        str(pid): n for pid, n in self._pool_ops.items()
+                    },
+                },
+            }
+            try:
+                conn = self.messenger.connect(
+                    target[1], Policy.lossy_client()
+                )
+                conn.send_message(
+                    Message(type="mgr_report", payload=report)
+                )
+            # cephlint: disable=error-taxonomy (mgr down/failover: rediscover next tick)
+            except Exception:
+                target = None  # force a mgr map refresh next tick
 
     async def _trim_removed_snaps(self) -> None:
         """SnapTrimmer: drop clones whose snap was deleted from the pool
@@ -3277,12 +3372,18 @@ class OSDService(Dispatcher):
                         if partial is not None:
                             pg.extents.release(partial["token"])
                     self.perf.inc("op_w")
+                    self.perf.inc("op_in_bytes", sum(
+                        len(d_) for d_ in datas if d_
+                    ))
                 else:
                     op_results, reply_raw = await self._primary_ops(
                         pg, acting, name, ops, datas, None,
                         snapid=p.get("snapid"),
                     )
                     self.perf.inc("op_r")
+                    self.perf.inc(
+                        "op_out_bytes", len(reply_raw) if reply_raw else 0
+                    )
                 result = {"results": op_results}
             elif p["op"] == "read":
                 rname = name
@@ -3293,6 +3394,9 @@ class OSDService(Dispatcher):
                 reply_raw = await self._primary_read(pg, acting, rname)
                 result = {}
                 self.perf.inc("op_r")
+                self.perf.inc(
+                    "op_out_bytes", len(reply_raw) if reply_raw else 0
+                )
             elif p["op"] == "stat":
                 result = self._primary_stat(pg, name)
             elif p["op"] == "call":
@@ -3312,6 +3416,7 @@ class OSDService(Dispatcher):
             else:
                 raise RuntimeError(f"unknown op {p['op']!r}")
             reply = {"tid": p["tid"], "ok": True, **result}
+            self._pool_ops[pool_id] = self._pool_ops.get(pool_id, 0) + 1
         except (StoreError, ClsError, OpError) as e:
             if isinstance(e, StoreFatalError) or e.code == "EROFS":
                 # fail-stop: our store just fenced (we are about to go

@@ -137,3 +137,85 @@ def test_health_checks_live():
             await cluster.stop()
 
     run(main())
+
+
+def test_slo_violation_surfaces_in_ceph_health():
+    """The mgr's SLO engine feeds the MON health model: an impossible
+    write-rate SLO fires MGR_SLO_VIOLATION (HEALTH_WARN, rule text in
+    the detail) while load runs, and clears once the cluster idles and
+    the violation slides out of the window."""
+
+    async def main():
+        cfg = health_config()
+        cfg.set("mgr_report_interval", 0.2)
+        # nobody can stay under 0.5 writes/sec during a write burst
+        cfg.set("mgr_slo_rules", "op_w.rate < 0.5 @ 2")
+        cluster = Cluster(cfg=cfg)
+        await cluster.start()
+        try:
+            rados = Rados("client.slo", cluster.monmap, config=cfg)
+            await rados.connect()
+            await cluster.create_pools(rados)
+
+            from ceph_tpu.mgr import MgrService
+
+            mgr = MgrService("mgr.slo", cluster.monmap, config=cfg)
+            await mgr.start()
+            await wait_until(lambda: mgr.active, timeout=30)
+
+            async def health():
+                return await rados.mon_command("health")
+
+            io = rados.io_ctx(REP_POOL)
+
+            async def violated():
+                # keep the rate up while polling: each probe writes
+                await io.write_full("slo-load", b"v" * 512)
+                h = await health()
+                return (
+                    h
+                    if "MGR_SLO_VIOLATION" in h["checks"]
+                    else None
+                )
+
+            deadline = asyncio.get_event_loop().time() + 60
+            h = None
+            while h is None:
+                assert asyncio.get_event_loop().time() < deadline, (
+                    "SLO violation never reached ceph health"
+                )
+                h = await violated()
+            check = h["checks"]["MGR_SLO_VIOLATION"]
+            assert h["status"] in ("HEALTH_WARN", "HEALTH_ERR")
+            assert check["severity"] == "HEALTH_WARN"
+            assert any(
+                "op_w.rate < 0.5 @ 2" in line
+                for line in check["detail"]
+            ), check
+            # the engine names the worst offender by daemon id
+            assert any("osd." in line for line in check["detail"])
+
+            # /api/slo agrees with the health check
+            doc = mgr.metrics.slo_document()
+            assert doc["violated"] >= 1
+            assert doc["rules"][0]["rule"] == "op_w.rate < 0.5 @ 2"
+
+            # stop the load: the 2s window slides past the burst and
+            # the mgr's next health report withdraws the check
+            async def cleared():
+                h = await health()
+                return "MGR_SLO_VIOLATION" not in h["checks"]
+
+            deadline = asyncio.get_event_loop().time() + 60
+            while not await cleared():
+                assert asyncio.get_event_loop().time() < deadline, (
+                    "MGR_SLO_VIOLATION never cleared after idle"
+                )
+                await asyncio.sleep(0.25)  # cephlint: disable=clock-discipline (waiting out the SLO window requires real elapsed time)
+
+            await mgr.stop()
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(main())

@@ -9,8 +9,14 @@
 """
 
 import asyncio
+import json
+import os
+import sys
+
+import pytest
 
 from ceph_tpu.mgr import MgrService
+from ceph_tpu.msg.messenger import next_dispatch_event
 from ceph_tpu.rados.client import Rados
 from tests.test_cluster_live import (
     REP_POOL,
@@ -24,16 +30,32 @@ def run(coro):
     return asyncio.run(asyncio.wait_for(coro, 240))
 
 
-async def wait_health(admin, pred, timeout=30.0):
+async def wait_async(pred, timeout=30.0):
+    """wait_until for ASYNC predicates (mon commands): park on the
+    dispatch hook between checks instead of a wall-clock poll — every
+    state transition these tests wait for rides a dispatched message."""
     loop = asyncio.get_event_loop()
     end = loop.time() + timeout
     while True:
+        r = await pred()
+        if r:
+            return r
+        remaining = end - loop.time()
+        if remaining <= 0:
+            raise TimeoutError(r)
+        fut = next_dispatch_event()
+        try:
+            await asyncio.wait_for(fut, min(0.25, remaining))
+        except asyncio.TimeoutError:
+            pass
+
+
+async def wait_health(admin, pred, timeout=30.0):
+    async def check():
         h = await admin.mon_command("health")
-        if pred(h):
-            return h
-        if loop.time() > end:
-            raise TimeoutError(h)
-        await asyncio.sleep(0.2)
+        return h if pred(h) else None
+
+    return await wait_async(check, timeout)
 
 
 def test_mon_down_raises_health_warn():
@@ -96,7 +118,8 @@ def test_mgr_failover_keeps_prometheus_serving():
         text = await a.prometheus_scrape()
         assert "ceph" in text or "osd" in text
         assert set(a.modules) == {
-            "balancer", "pg_autoscaler", "prometheus", "dashboard"
+            "balancer", "pg_autoscaler", "metrics", "prometheus",
+            "dashboard",
         }
 
         # kill the active: the standby's beacons promote it
@@ -149,11 +172,7 @@ def test_dashboard_http_surface():
                 df["used_bytes"] > 0 and len(df["osds"]) == 6
             )
 
-        loop = asyncio.get_event_loop()
-        end = loop.time() + 90
-        while not await df_ready():
-            assert loop.time() < end, await admin.mon_command("df")
-            await asyncio.sleep(0.3)
+        await wait_async(df_ready, timeout=90)
 
         import json as _json
 
@@ -188,6 +207,89 @@ def test_dashboard_http_surface():
 
         await a.stop()
         await b.stop()
+        await admin.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_ceph_top_json_matches_client_op_counts():
+    """End-to-end telemetry acceptance: OSDs push reports to the active
+    mgr, and `ceph_top --json` (the real CLI, a subprocess over real
+    TCP) shows per-OSD totals consistent with the ops this client
+    issued, plus per-pool totals and live queue/in-flight columns."""
+
+    async def main():
+        cfg = live_config()
+        cfg.set("mgr_report_interval", 0.25)
+        cluster = Cluster(cfg=cfg)
+        await cluster.start()
+        admin = Rados("client.tt", cluster.monmap, config=cluster.cfg)
+        await admin.connect()
+        await cluster.create_pools(admin)
+
+        mgr = MgrService("mgr.top", cluster.monmap, config=cluster.cfg)
+        await mgr.start()
+        await wait_until(lambda: mgr.active, timeout=30)
+
+        N_W, N_R = 40, 25
+        io = admin.io_ctx(REP_POOL)
+        for i in range(N_W):
+            await io.write_full(f"top{i}", b"z" * 1024)
+        for i in range(N_R):
+            assert await io.read(f"top{i % N_W}") == b"z" * 1024
+
+        def store_totals():
+            doc = mgr.metrics.top_document()
+            tw = sum(
+                r["totals"].get("op_w", 0) for r in doc["daemons"]
+            )
+            tr = sum(
+                r["totals"].get("op_r", 0) for r in doc["daemons"]
+            )
+            return (
+                len(doc["daemons"]) == 6 and tw >= N_W and tr >= N_R
+            )
+
+        # every OSD's report must land and cover the workload
+        await wait_until(store_totals, timeout=60)
+
+        # now the actual CLI, over the wire: mon -> mgr map -> mgr top
+        host, port = cluster.monmap.addrs[0]
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__
+        )))
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable,
+            os.path.join(repo, "tools", "ceph_top.py"),
+            "--mon-host", f"{host}:{port}", "--json",
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+            cwd=repo,
+        )
+        out, err = await asyncio.wait_for(proc.communicate(), 60)
+        assert proc.returncode == 0, err.decode()
+        doc = json.loads(out)
+
+        rows = doc["daemons"]
+        assert len(rows) == 6
+        total_w = sum(r["totals"].get("op_w", 0) for r in rows)
+        total_r = sum(r["totals"].get("op_r", 0) for r in rows)
+        # every client op is served exactly once by some primary;
+        # allow a little slack for client-side retries under load
+        assert N_W <= total_w <= N_W + 5, rows
+        assert N_R <= total_r <= N_R + 5, rows
+        # the pool rollup counts both directions
+        pool_rows = {p["pool"]: p for p in doc["pools"]}
+        assert pool_rows[REP_POOL]["ops_total"] >= N_W + N_R
+        # the cluster is idle now: nothing queued or executing
+        for r in rows:
+            assert r["inflight"] == 0, r
+        # queue-depth column exists and is sane on every row
+        assert all(r["queue_depth"] >= 0 for r in rows)
+
+        await mgr.stop()
         await admin.shutdown()
         await cluster.stop()
 

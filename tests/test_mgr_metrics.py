@@ -1,0 +1,243 @@
+"""MetricsModule unit tier (PR 18): delta/rate math against
+hand-computed oracles, ring eviction at the window bound, counter-reset
+re-priming, histogram percentiles, the SLO rule grammar and its
+violation -> health-check round trip, and the mgr-failover baseline
+reset. Pure in-process — no cluster, no clocks (every call passes an
+explicit `now`)."""
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.mgr.metrics import (
+    POOL_BLOCK,
+    STATUS_BLOCK,
+    MetricsModule,
+    parse_slo_rules,
+)
+
+
+def mk(window: int = 120, rules: str = "", interval: float = 1.0):
+    cfg = Config()
+    cfg.set("mgr_metrics_window", window)
+    cfg.set("mgr_report_interval", interval)
+    if rules:
+        cfg.set("mgr_slo_rules", rules)
+    return MetricsModule(cfg)
+
+
+def report(daemon, seq, counters, status=None, full=False):
+    return {
+        "daemon": daemon,
+        "seq": seq,
+        "full": full,
+        "counters": counters,
+        "status": status or {},
+    }
+
+
+def test_rate_oracle_full_and_windowed():
+    m = mk()
+    m.ingest(report("osd.0", 1, {"osd.0": {"op_w": 0}}, full=True),
+             now=0.0)
+    m.ingest(report("osd.0", 2, {"osd.0": {"op_w": 100}}), now=1.0)
+    m.ingest(report("osd.0", 3, {"osd.0": {"op_w": 300}}), now=2.0)
+    # whole ring: (300 - 0) / (2 - 0)
+    assert m.aggregate("osd.0", "op_w", "rate", None, now=2.0) == 150.0
+    # 1s window keeps only the t=1,2 samples: (300 - 100) / 1
+    assert m.aggregate("osd.0", "op_w", "rate", 1.0, now=2.0) == 200.0
+    # a single-sample window can't produce a rate
+    assert m.aggregate("osd.0", "op_w", "rate", 0.5, now=2.0) is None
+
+
+def test_gauge_and_time_avg_aggregations():
+    m = mk()
+    for i, (qd, la) in enumerate(
+        [(2, {"avgcount": 10, "sum": 1.0}),
+         (4, {"avgcount": 15, "sum": 2.0}),
+         (6, {"avgcount": 20, "sum": 3.0})]
+    ):
+        m.ingest(report("osd.1", i + 1, {
+            "osd.1": {"osd_queue_depth": qd, "l_op_total": la},
+        }), now=float(i))
+    # gauge avg = mean of samples; max = max sample
+    assert m.aggregate("osd.1", "osd_queue_depth", "avg", None, 2.0) == 4.0
+    assert m.aggregate("osd.1", "osd_queue_depth", "max", None, 2.0) == 6.0
+    # TIME_AVG avg = windowed sum delta / count delta = 2.0 / 10
+    assert m.aggregate("osd.1", "l_op_total", "avg", None, 2.0) == (
+        pytest.approx(0.2)
+    )
+    # TIME_AVG rate = completions/sec = (20 - 10) / 2
+    assert m.aggregate("osd.1", "l_op_total", "rate", None, 2.0) == 5.0
+
+
+def test_ring_eviction_at_window_bound():
+    m = mk(window=4)
+    for i in range(10):
+        m.ingest(report("osd.0", i + 1, {"osd.0": {"c": i * 10}}),
+                 now=float(i))
+    ring = m.daemons["osd.0"].rings[("osd.0", "c")]
+    assert len(ring) == 4          # bounded by mgr_metrics_window
+    assert ring[0] == (6.0, 60)    # oldest retained sample
+    # the rate spans only what the ring kept: (90 - 60) / (9 - 6)
+    assert m.aggregate("osd.0", "c", "rate", None, now=9.0) == (
+        pytest.approx(10.0)
+    )
+
+
+def test_counter_reset_reprimes_no_negative_rate():
+    m = mk()
+    m.ingest(report("osd.0", 1, {"osd.0": {"op_w": 100}}), now=0.0)
+    m.ingest(report("osd.0", 2, {"osd.0": {"op_w": 150}}), now=1.0)
+    # daemon restarted: cumulative goes backwards -> ring re-primes
+    m.ingest(report("osd.0", 1, {"osd.0": {"op_w": 5}}), now=2.0)
+    assert m.aggregate("osd.0", "op_w", "rate", None, now=2.0) is None
+    m.ingest(report("osd.0", 2, {"osd.0": {"op_w": 25}}), now=3.0)
+    rate = m.aggregate("osd.0", "op_w", "rate", None, now=3.0)
+    assert rate == pytest.approx(20.0)
+    assert rate > 0
+
+
+def test_unknown_daemon_report_primes_baseline():
+    # mgr failover: a delta (non-full) report from a daemon this mgr
+    # has never seen starts a fresh baseline rather than crashing or
+    # inventing rates from the void
+    m = mk()
+    m.ingest(report("osd.7", 41, {"osd.7": {"op_w": 10_000}}), now=0.0)
+    assert "osd.7" in m.daemons
+    assert m.aggregate("osd.7", "op_w", "rate", None, now=0.0) is None
+    m.ingest(report("osd.7", 42, {"osd.7": {"op_w": 10_100}}), now=1.0)
+    assert m.aggregate("osd.7", "op_w", "rate", None, now=1.0) == 100.0
+
+
+def test_failover_baseline_reset():
+    m = mk()
+    m.ingest(report("osd.0", 1, {"osd.0": {"op_w": 5}}), now=0.0)
+    m.reset()
+    assert m.daemons == {}
+
+
+def test_histogram_percentiles_oracle():
+    m = mk()
+    m.ingest(report("osd.0", 1, {"tracer": {"lat": {}}}), now=0.0)
+    m.ingest(report("osd.0", 2, {
+        "tracer": {"lat": {"16": 90, "1024": 10}},
+    }), now=1.0)
+    # 100 new samples: 90 in [16,32), 10 in [1024,2048)
+    p50 = m.aggregate("osd.0", "lat", "p50", None, now=1.0)
+    assert p50 == pytest.approx(16 + (50 / 90) * 16)
+    p95 = m.aggregate("osd.0", "lat", "p95", None, now=1.0)
+    assert p95 == pytest.approx(1024 + 0.5 * 1024)
+    p99 = m.aggregate("osd.0", "lat", "p99", None, now=1.0)
+    assert p99 == pytest.approx(1024 + 0.9 * 1024)
+
+
+def test_slo_rule_grammar():
+    rules = parse_slo_rules(
+        "ckpt_save_block_latency.p99 < 2s @ 30; "
+        "read_redirected/read_balanced < 0.05; "
+        "osd_queue_depth.avg<64;"
+        "utter garbage;"
+        "x.p42 < 1"  # unknown aggregation: skipped too
+    )
+    assert [r.counter for r in rules] == [
+        "ckpt_save_block_latency", "read_redirected", "osd_queue_depth",
+    ]
+    r0, r1, r2 = rules
+    assert (r0.agg, r0.op, r0.threshold, r0.window) == (
+        "p99", "<", 2.0, 30.0
+    )
+    assert r1.denominator == "read_balanced" and r1.threshold == 0.05
+    assert r2.agg == "avg" and r2.window is None
+    # unit scaling targets seconds-based counters
+    assert parse_slo_rules("a.avg < 5ms")[0].threshold == (
+        pytest.approx(0.005)
+    )
+    assert parse_slo_rules("a.avg <= 250us")[0].threshold == (
+        pytest.approx(250e-6)
+    )
+    assert parse_slo_rules("") == []
+    errors = []
+    parse_slo_rules("nope nope", on_error=errors.append)
+    assert errors and "nope" in errors[0]
+
+
+def test_slo_violation_to_health_check_round_trip():
+    m = mk(rules="op_w.rate < 10 @ 2")
+    m.ingest(report("osd.0", 1, {"osd.0": {"op_w": 0}}), now=0.0)
+    m.ingest(report("osd.0", 2, {"osd.0": {"op_w": 100}}), now=1.0)
+    res = m.evaluate_slos(now=1.0)
+    assert len(res) == 1 and not res[0]["ok"]
+    assert res[0]["daemon"] == "osd.0"
+    assert res[0]["value"] == pytest.approx(100.0)
+    assert res[0]["margin"] < 0
+    checks = m.health_checks(now=1.0)
+    check = checks["MGR_SLO_VIOLATION"]
+    assert check["severity"] == "HEALTH_WARN"
+    assert check["count"] == 1
+    assert any(
+        "op_w.rate < 10 @ 2" in line and "osd.0" in line
+        for line in check["detail"]
+    )
+    # load stops: the 2s window slides past the burst and the check
+    # clears (the counter holds its cumulative value)
+    m.ingest(report("osd.0", 3, {"osd.0": {"op_w": 100}}), now=5.0)
+    m.ingest(report("osd.0", 4, {"osd.0": {"op_w": 100}}), now=6.0)
+    assert m.health_checks(now=6.0) == {}
+    assert m.evaluate_slos(now=6.0)[0]["ok"]
+
+
+def test_slo_ratio_rule():
+    m = mk(rules="read_redirected/read_balanced < 0.05")
+    m.ingest(report("osd.0", 1, {
+        "osd.0": {"read_redirected": 0, "read_balanced": 0},
+    }), now=0.0)
+    m.ingest(report("osd.0", 2, {
+        "osd.0": {"read_redirected": 5, "read_balanced": 200},
+    }), now=1.0)
+    res = m.evaluate_slos(now=1.0)
+    assert res[0]["ok"] and res[0]["value"] == pytest.approx(0.025)
+    # redirects spike past 5%: violated
+    m.ingest(report("osd.0", 3, {
+        "osd.0": {"read_redirected": 105, "read_balanced": 400},
+    }), now=2.0)
+    res = m.evaluate_slos(now=2.0)
+    assert not res[0]["ok"]
+    assert res[0]["value"] == pytest.approx(105 / 400)
+
+
+def test_top_document_rows_and_age_out():
+    m = mk(interval=1.0)
+    status = {
+        "queue_depth": 7, "inflight_ops": 2, "pool_ops": {"1": 50},
+    }
+    # osd.0 goes silent at t=0 -> aged out of the view by t=10
+    m.ingest(report("osd.0", 1, {"osd.0": {"op_w": 0, "op_r": 0,
+                                           "op_rw": 0}}), now=0.0)
+    for i, w in enumerate((0, 40, 80)):
+        m.ingest(report("osd.1", i + 1, {
+            "osd.1": {"op_w": w, "op_r": 0, "op_rw": 0,
+                      "op_in_bytes": w * 1000, "op_out_bytes": 0},
+        }, status=status), now=8.0 + i)
+    doc = m.top_document(now=10.0)
+    assert [r["daemon"] for r in doc["daemons"]] == ["osd.1"]
+    row = doc["daemons"][0]
+    assert row["ops"] == pytest.approx(40.0)          # 80 ops / 2s
+    assert row["write_bps"] == pytest.approx(40_000.0)
+    assert row["inflight"] == 2
+    assert row["queue_depth"] == pytest.approx(7.0)
+    assert row["totals"]["op_w"] == 80
+    assert doc["pools"] == [
+        {"pool": 1, "ops": 0.0, "ops_total": 50},
+    ]
+    # the status section rings too (queue_depth SLO rules read it)
+    assert (STATUS_BLOCK, "queue_depth") in m.daemons["osd.1"].rings
+    assert (POOL_BLOCK, "1") in m.daemons["osd.1"].rings
+
+
+def test_prune_drops_long_silent_daemons():
+    m = mk(interval=1.0)
+    m.ingest(report("osd.0", 1, {"osd.0": {"op_w": 1}}), now=0.0)
+    m.prune(now=10.0)
+    assert "osd.0" in m.daemons     # silent but under the horizon
+    m.prune(now=100.0)
+    assert m.daemons == {}

@@ -66,6 +66,12 @@ def parse_args():
                          "LocalStack for the co-located daemons (auto is "
                          "the production default — remote peers still "
                          "fall back to TCP per connection)")
+    ap.add_argument("--mgr", action="store_true",
+                    help="run an active MgrService during the bench: "
+                         "every OSD pushes telemetry reports on "
+                         "mgr_report_interval, and the result carries "
+                         "push-store vs pull-fallback scrape times "
+                         "(the telemetry-overhead A/B substrate)")
     ap.add_argument("--multiprocess", action="store_true",
                     help="every daemon a real OS process (vstart) + "
                          "--clients client worker processes")
@@ -132,6 +138,19 @@ async def main(args) -> dict:
         o = OSDService(i, monmap, config=cfg)
         await o.start()
         osds[i] = o
+
+    mgr = None
+    if args.mgr:
+        from ceph_tpu.mgr import MgrService
+
+        cfg.set("mgr_report_interval", 0.5)
+        mgr = MgrService("mgr.bench", monmap, config=cfg)
+        await mgr.start()
+        deadline = time.monotonic() + 30
+        while not mgr.active:
+            if time.monotonic() > deadline:
+                raise RuntimeError("mgr never went active")
+            await asyncio.sleep(0.05)
 
     rados = Rados("client.bench", monmap, config=cfg)
     await rados.connect()
@@ -248,12 +267,41 @@ async def main(args) -> dict:
     stack_used = (
         "local" if client_stacks & {"uds", "shm"} else "tcp"
     )
+
+    mgr_stats = None
+    if mgr is not None:
+        from ceph_tpu.mgr.prometheus import PrometheusExporter
+
+        # let every OSD's next push report land in the store
+        deadline = time.monotonic() + 20
+        while len(mgr.metrics.daemons) < args.osds:
+            if time.monotonic() > deadline:
+                break
+            await asyncio.sleep(0.1)
+        t0 = time.perf_counter()
+        push_text = await mgr.prometheus_scrape()
+        push_ms = (time.perf_counter() - t0) * 1e3
+        # the pre-push exporter path: per-scrape `perf dump` admin
+        # round-trips to every OSD (what the store replaces)
+        puller = PrometheusExporter(rados.objecter)
+        t0 = time.perf_counter()
+        pull_text = await puller.collect()
+        pull_ms = (time.perf_counter() - t0) * 1e3
+        mgr_stats = {
+            "daemons_reporting": len(mgr.metrics.daemons),
+            "scrape_push_ms": round(push_ms, 3),
+            "scrape_pull_ms": round(pull_ms, 3),
+            "push_series": push_text.count("\n"),
+            "pull_series": pull_text.count("\n"),
+        }
+        await mgr.stop()
+
     await rados.shutdown()
     for o in osds.values():
         await o.stop()
     for m in mons:
         await m.stop()
-    return {
+    result = {
         "mode": "single-process",
         "ncores": os.cpu_count(),
         "write_gbps": total_bytes / elapsed / 1e9,
@@ -279,6 +327,9 @@ async def main(args) -> dict:
         "cork_max_frames": int(cfg.get("ms_cork_max_frames")),
         "subop_batch": bool(cfg.get("ms_subop_batch")),
     }
+    if mgr_stats is not None:
+        result["mgr"] = mgr_stats
+    return result
 
 
 async def client_worker(args) -> dict:
