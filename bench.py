@@ -706,6 +706,37 @@ def _lint_line() -> None:
         pass
 
 
+def _recovery_line() -> None:
+    """Optional JSON line: the batched recovery engine A/B — degraded
+    objects healed/s with sub-op-frame batching vs the one-object-at-a-
+    time baseline (osd_recovery_batch_max=1), plus client p99 during
+    the recovery storm under the mclock recovery class. Guarded
+    (--recovery / CEPH_TPU_BENCH_RECOVERY=1) and non-fatal."""
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            [sys.executable, "tools/daemon_bench.py", "--recovery",
+             "--cpu",
+             "--recovery-objects",
+             os.environ.get("CEPH_TPU_BENCH_RECOVERY_OBJECTS", "400")],
+            capture_output=True, text=True, timeout=600, check=True,
+        )
+        r = json.loads(out.stdout.strip().splitlines()[-1])
+        print(json.dumps({
+            "metric": "recovery_heal_rate",
+            "value": r["batched"]["healed_obj_per_s"],
+            "unit": "objects/s",
+            "vs_serial": r["speedup"],
+            "serial_obj_per_s": r["serial"]["healed_obj_per_s"],
+            "batch_max": r["batched"]["batch_max"],
+            "client_p99_s": r["batched"]["client_p99_s"],
+            "client_p99_s_serial": r["serial"]["client_p99_s"],
+        }))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def main() -> None:
     import jax
 
@@ -780,6 +811,10 @@ def main() -> None:
         "CEPH_TPU_BENCH_TELEMETRY"
     ):
         _telemetry_line()
+    if "--recovery" in sys.argv[1:] or os.environ.get(
+        "CEPH_TPU_BENCH_RECOVERY"
+    ):
+        _recovery_line()
     if "--lint" in sys.argv[1:] or os.environ.get("CEPH_TPU_BENCH_LINT"):
         _lint_line()
 

@@ -149,6 +149,22 @@ SCHEMA: dict[str, Option] = {
              "max injected delay (seconds)"),
         _opt("ms_inject_internal_delays", TYPE_FLOAT, LEVEL_DEV, 0.0,
              "inject internal delays to induce races (seconds)"),
+        # wire chaos schedules (common/faults.py): scripted per-peer
+        # fault streams, seeded so a run replays bit-identically
+        _opt("ms_inject_chaos_schedule", TYPE_STR, LEVEL_DEV, "",
+             "';'-separated wire-fault rules applied per outgoing "
+             "frame run: drop:SRC>DST[:prob], "
+             "delay:SRC>DST[:prob[:max_s]], dup:SRC>DST[:prob], "
+             "partition:A|B (both ways) or partition:A>B (one-way "
+             "— DST still reaches SRC). SRC/DST are comma-separated "
+             "entity-name globs (osd.1, osd.*, *). Empty disarms; "
+             "armed or not, the hook is one cached attribute check "
+             "per corked run",
+             see_also=("ms_inject_chaos_seed",)),
+        _opt("ms_inject_chaos_seed", TYPE_UINT, LEVEL_DEV, 0,
+             "seed for the chaos schedule's per-(src,dst) decision "
+             "streams: same seed + schedule -> the same fault "
+             "sequence per peer pair, independent of global timing"),
         _opt("heartbeat_inject_failure", TYPE_UINT, LEVEL_DEV, 0,
              "inject heartbeat failures for N seconds"),
         _opt("objecter_inject_no_watch_ping", TYPE_BOOL, LEVEL_DEV, False,
@@ -181,6 +197,11 @@ SCHEMA: dict[str, Option] = {
              "advertised store capacity per OSD (the role of the real "
              "disk size BlueStore reads; configurable so tests can fill "
              "a tiny OSD to the full ratios)"),
+        _opt("osd_statfs_cache_sec", TYPE_FLOAT, LEVEL_ADVANCED, 0.5,
+             "seconds a statfs scan stays cached (the used-bytes scan "
+             "is O(kv rows)); 0 recomputes every call, which tier-1 "
+             "full/nearfull tests use instead of sleeping the TTL out",
+             min=0.0),
         _opt("mon_osd_nearfull_ratio", TYPE_FLOAT, LEVEL_BASIC, 0.85,
              "usage ratio above which an OSD is NEARFULL "
              "(OSDMonitor.cc:365)"),
@@ -253,6 +274,12 @@ SCHEMA: dict[str, Option] = {
              "this take a full backfill instead of log recovery"),
         _opt("osd_max_backfills", TYPE_UINT, LEVEL_ADVANCED, 1,
              "concurrent backfills one OSD will source (reservations)"),
+        _opt("osd_recovery_batch_max", TYPE_UINT, LEVEL_ADVANCED, 16,
+             "objects pulled/pushed per recovery batch: the batch's "
+             "sub-ops coalesce into subop_batch frames and its "
+             "concurrent EC shard rebuilds coalesce into one batched "
+             "decode launch; 1 restores one-object-at-a-time healing",
+             min=1, see_also=("osd_max_backfills",)),
         _opt("osd_mon_report_interval", TYPE_FLOAT, LEVEL_ADVANCED, 2.0,
              "seconds between PG stats reports to the mon (health "
              "checks aggregate these)"),
@@ -277,6 +304,9 @@ SCHEMA: dict[str, Option] = {
              "metrics module, e.g. 'op_latency.p99 < 2s @ 30; "
              "read_redirected/read_balanced < 0.05'; violations "
              "surface as MGR_SLO_VIOLATION health checks"),
+        _opt("mgr_recovery_slow_warn", TYPE_FLOAT, LEVEL_ADVANCED, 1.0,
+             "objects/s below which the mgr raises RECOVERY_SLOW while "
+             "any OSD reports degraded objects; 0 disables the check"),
         _opt("mds_beacon_interval", TYPE_FLOAT, LEVEL_ADVANCED, 0.5,
              "seconds between MDS beacons to the mon"),
         _opt("mds_max_active", TYPE_UINT, LEVEL_BASIC, 1,
@@ -435,6 +465,19 @@ SCHEMA: dict[str, Option] = {
              "this proportional share against weight-1 foreground "
              "clients, so prefetch cannot starve ckpt/RBD traffic",
              min=0.01),
+        _opt("osd_mclock_recovery_weight", TYPE_FLOAT, LEVEL_ADVANCED,
+             0.25,
+             "mclock weight of the recovery class "
+             "(op_queue.QOS_RECOVERY): recovery pulls/rebuild reads/"
+             "batched pushes get this proportional share against "
+             "weight-1 client classes — a recovery storm cannot starve "
+             "client ops", min=0.01,
+             see_also=("osd_mclock_recovery_reservation",)),
+        _opt("osd_mclock_recovery_reservation", TYPE_FLOAT,
+             LEVEL_ADVANCED, 10.0,
+             "mclock reservation floor (ops/s) for the recovery class: "
+             "sustained client load squeezes healing down to this "
+             "minimum but never to zero (dmclock phase-1)", min=0.0),
         # coordination (ceph_tpu.coord: cls_lock leases, leader election,
         # fleet roster/barriers for multi-host training)
         _opt("cls_clock_offset", TYPE_FLOAT, LEVEL_DEV, 0.0,

@@ -146,6 +146,29 @@ def data_prefetch_profile(weight: float = 0.25) -> ClientInfo:
     return ClientInfo(reservation=0.0, weight=max(0.01, weight), limit=0.0)
 
 
+#: mclock class for recovery/backfill sub-ops (pulls, rebuild reads,
+#: batched pushes): the reference's background_recovery class. Unlike
+#: QOS_DATA_PREFETCH it carries a RESERVATION — degraded objects are a
+#: durability debt, so a client storm may squeeze recovery down to the
+#: floor but never to zero (dmclock phase-1 guarantees the minimum)
+QOS_RECOVERY = "recovery"
+
+
+def recovery_profile(
+    weight: float = 0.25, reservation: float = 10.0
+) -> ClientInfo:
+    """Recovery profile: fractional weight so a recovery storm cannot
+    starve weight-1 client classes, plus a reservation floor (ops/s on
+    the queue's virtual clock) so sustained client load cannot stall
+    healing to zero — the two-sided contract `osd_mclock_recovery_weight`
+    / `osd_mclock_recovery_reservation` expose."""
+    return ClientInfo(
+        reservation=max(0.0, reservation),
+        weight=max(0.01, weight),
+        limit=0.0,
+    )
+
+
 class MClockQueue:
     """dmclock tag scheduling on a caller-driven virtual clock."""
 

@@ -460,28 +460,84 @@ class MetricsModule:
             })
         return out
 
+    def recovery_status(self, now: float | None = None) -> dict:
+        """Cluster durability debt + healing rate from the metrics
+        store: degraded-object counts come from each OSD's status block,
+        the objects/s rate from the recovery_pushes/recovery_pulls
+        counter series — the feed for PG_DEGRADED / RECOVERY_SLOW and
+        the `ceph top` recovery row."""
+        now = self._now() if now is None else now
+        win = max(4 * self.interval, 2.0)
+        degraded = 0
+        rate = 0.0
+        detail: list[str] = []
+        for name, d in self.fresh_daemons(now):
+            for key in ("recovery_pushes", "recovery_pulls"):
+                r = self.aggregate(name, key, "rate", win, now)
+                if r:
+                    rate += r
+            n = int(d.status.get("degraded_objects") or 0)
+            if n:
+                degraded += n
+                detail.append(f"{name}: {n} object copies degraded")
+        return {
+            "degraded_objects": degraded,
+            "rate": round(rate, 3),
+            "detail": detail,
+        }
+
     def health_checks(self, now: float | None = None) -> dict:
-        """The MGR_SLO_VIOLATION check the active mgr feeds to the mon
-        (empty dict when every rule holds — the mon clears on empty)."""
+        """The health checks the active mgr feeds to the mon (empty
+        dict when everything holds — the mon clears on empty):
+        MGR_SLO_VIOLATION from the SLO rules, PG_DEGRADED /
+        RECOVERY_SLOW from the recovery feed."""
+        checks: dict = {}
+        rec = self.recovery_status(now)
+        if rec["degraded_objects"]:
+            checks["PG_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": (
+                    f"{rec['degraded_objects']} object copies degraded,"
+                    f" recovering at {rec['rate']:g} obj/s"
+                ),
+                "count": rec["degraded_objects"],
+                "detail": rec["detail"],
+            }
+            slow = float(
+                self.config.get("mgr_recovery_slow_warn") or 0.0
+            )
+            if slow > 0 and rec["rate"] < slow:
+                checks["RECOVERY_SLOW"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": (
+                        f"recovery at {rec['rate']:g} obj/s, below the"
+                        f" {slow:g} obj/s floor with"
+                        f" {rec['degraded_objects']} copies degraded"
+                    ),
+                    "count": 1,
+                    "detail": [
+                        f"recovery rate {rec['rate']:g} obj/s <"
+                        f" mgr_recovery_slow_warn {slow:g}"
+                    ],
+                }
         violated = [r for r in self.evaluate_slos(now) if not r["ok"]]
         if not violated:
-            return {}
+            return checks
         detail = [
             f"rule '{r['rule']}' violated by {r['daemon']}: "
             f"measured {r['value']:.6g} (threshold {r['op']} "
             f"{r['threshold']:g})"
             for r in violated
         ]
-        return {
-            "MGR_SLO_VIOLATION": {
-                "severity": "HEALTH_WARN",
-                "summary": (
-                    f"{len(violated)} SLO rule(s) violated"
-                ),
-                "count": len(violated),
-                "detail": detail,
-            }
+        checks["MGR_SLO_VIOLATION"] = {
+            "severity": "HEALTH_WARN",
+            "summary": (
+                f"{len(violated)} SLO rule(s) violated"
+            ),
+            "count": len(violated),
+            "detail": detail,
         }
+        return checks
 
     def slo_document(self, now: float | None = None) -> dict:
         now = self._now() if now is None else now
@@ -590,4 +646,5 @@ class MetricsModule:
                 for pid, row in sorted(pools.items(), key=lambda x: int(x[0]))
             ],
             "slo": slo,
+            "recovery": self.recovery_status(now),
         }

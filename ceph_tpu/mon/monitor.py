@@ -1789,7 +1789,7 @@ class Monitor(Dispatcher):
             }
         now = asyncio.get_event_loop().time()
         agg = {"degraded": 0, "undersized": 0, "backfilling": 0,
-               "peering": 0, "inconsistent": 0}
+               "peering": 0, "inconsistent": 0, "degraded_objects": 0}
         nearfull, backfillfull, full = [], [], []
         near_r = self.config.get("mon_osd_nearfull_ratio")
         bf_r = self.config.get("mon_osd_backfillfull_ratio")
@@ -1850,6 +1850,13 @@ class Monitor(Dispatcher):
                     "summary": f"{agg[key]} {noun}",
                     "count": agg[key],
                 }
+        if "PG_DEGRADED" in checks and agg["degraded_objects"]:
+            # object-granular debt from the primaries' pg stats; the
+            # active mgr's richer check (with the healing rate) wins
+            # via the merge below while it is fresh
+            checks["PG_DEGRADED"]["summary"] += (
+                f" ({agg['degraded_objects']} object copies degraded)"
+            )
         # mgr-fed checks (MGR_SLO_VIOLATION etc.): merged while fresh —
         # the active mgr re-reports every mgr_report_interval, so a
         # stale entry means the mgr died and its verdicts with it

@@ -392,15 +392,21 @@ def payload_of(msg: Message):
     return json.loads(msg.data)
 
 
-def redirect_reply(tid: int, primary: int, epoch: int, why: str = "") -> dict:
+def redirect_reply(
+    tid: int, primary: int, epoch: int, why: str = "",
+    backfill=None,
+) -> dict:
     """osd_op_reply payload bouncing a balanced/direct-shard read back to
     the PG primary (MOSDOpReply redirect role): the target cannot prove
     its copy is current — peering, backfill, a stale activation marker, a
     version mismatch, or a local read error — so the client must retry at
     the primary instead of risking wrong data. `primary` and `epoch` are
     the sender's view; the client trusts them only as a hint and refreshes
-    its map when the epoch is ahead of its own."""
-    return {
+    its map when the epoch is ahead of its own. `backfill` (when the
+    sender's activation marker names backfill targets) tells the client
+    which acting members to skip for FUTURE balanced reads of this PG —
+    without it every round-robin pass pays this bounce again."""
+    out = {
         "tid": tid,
         "ok": False,
         "redirect": True,
@@ -408,3 +414,6 @@ def redirect_reply(tid: int, primary: int, epoch: int, why: str = "") -> dict:
         "epoch": epoch,
         "why": why,
     }
+    if backfill:
+        out["backfill"] = sorted(backfill)
+    return out

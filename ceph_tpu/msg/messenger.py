@@ -394,6 +394,9 @@ class Connection:
                 stream = await self._dial()
                 await self._client_handshake(stream)
                 stream = await self._maybe_upgrade_local(stream)
+                # the chaos schedule keys on peer identity, known only
+                # now — handshake frames ride uninjected by design
+                stream.chaos_peer = self.peer_name
                 self._stream = stream
                 backoff = 0.01
                 # Start reading BEFORE replaying so ACKs for replayed
@@ -836,6 +839,9 @@ class Messenger:
         #: low seqs as duplicates of the dead one's
         self.instance_nonce = int.from_bytes(os.urandom(8), "little")
         self.injected_failures = 0
+        #: chaos-schedule faults applied (drops + delays + dups); the
+        #: per-kind split rides the perf counters below
+        self.chaos_injected = 0
         #: total frame bytes written (the wire-inflation diagnostic)
         self.bytes_sent = 0
         #: MESSAGE frames that went out compressed (ms_compress_mode)
@@ -860,6 +866,11 @@ class Messenger:
             ("env_json", "op payloads encoded as JSON (fallback)"),
             ("bytes_zero_copy",
              "frame bytes received via the shm ring (no kernel copy)"),
+            ("chaos_drop",
+             "frame runs severed by the ms_inject_chaos schedule "
+             "(drops + partitions)"),
+            ("chaos_delay", "frame runs stalled by the chaos schedule"),
+            ("chaos_dup", "frame runs duplicated by the chaos schedule"),
         ):
             self.perf.add_u64_counter(key, desc)
         self.perf.add_histogram(
@@ -889,6 +900,9 @@ class Messenger:
         self._inject_every = int(
             self.config.get("ms_inject_socket_failures") or 0
         )
+        #: compiled chaos schedule (common/faults.WireFaults) or None —
+        #: the armed/disarmed switch the send path checks per corked run
+        self._chaos = self._build_chaos()
         self._local_stack = bool(self.config.get("ms_local_stack"))
         self._shm_ring_bytes = int(
             self.config.get("ms_shm_ring_bytes") or 0
@@ -899,6 +913,8 @@ class Messenger:
             # pre-stack wire behavior
             self.local_features &= ~FEATURE_LOCAL_STACK
         self.config.observe("ms_local_stack", self._note_knobs)
+        self.config.observe("ms_inject_chaos_schedule", self._note_knobs)
+        self.config.observe("ms_inject_chaos_seed", self._note_knobs)
         self.config.observe("ms_shm_ring_bytes", self._note_knobs)
         self.config.observe("ms_cork_max_frames", self._note_knobs)
         self.config.observe("ms_envelope_format", self._note_knobs)
@@ -945,12 +961,29 @@ class Messenger:
         self._inject_every = int(
             self.config.get("ms_inject_socket_failures") or 0
         )
+        self._chaos = self._build_chaos()
         self._local_stack = bool(self.config.get("ms_local_stack"))
         self._shm_ring_bytes = int(
             self.config.get("ms_shm_ring_bytes") or 0
         )
         if not self._local_stack:
             self.local_features &= ~FEATURE_LOCAL_STACK
+
+    def _build_chaos(self):
+        """Compile ms_inject_chaos_schedule into a WireFaults engine, or
+        None when the schedule is empty (the disarmed fast path). A bad
+        schedule disarms loudly rather than silently injecting nothing."""
+        text = self.config.get("ms_inject_chaos_schedule") or ""
+        if not text.strip():
+            return None
+        from ceph_tpu.common.faults import WireFaults
+
+        try:
+            return WireFaults(
+                text, int(self.config.get("ms_inject_chaos_seed") or 0)
+            )
+        except ValueError as e:
+            raise ValueError(f"ms_inject_chaos_schedule: {e}") from e
 
     def _ring_bytes_effective(self) -> int:
         """ms_shm_ring_bytes clamped to a workable window; 0 disables the
@@ -1132,6 +1165,10 @@ class Messenger:
             # on this new session or they are silently lost
             ukey = (conn.peer_name, conn.peer_nonce)
             conn._unacked = self._peer_unacked.setdefault(ukey, [])
+            # arm the chaos schedule now that the peer is identified
+            # (replies we send on this accepted session are this
+            # messenger's own src->dst fault stream)
+            stream.chaos_peer = conn.peer_name
             conn._stream = stream
             conn._ready.set()
             self._accepted.add(conn)

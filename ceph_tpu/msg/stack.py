@@ -119,6 +119,11 @@ class InjectingStream:
         self.reader = reader
         self.writer = writer
         self._m = messenger
+        #: peer entity name, set by the Connection once the handshake
+        #: lands — the chaos schedule (common/faults) keys fault streams
+        #: by (our name, peer name), so handshake frames are never
+        #: injected and the pre-handshake stream needs no identity
+        self.chaos_peer: str | None = None
         # request/response sub-ops die under Nagle + delayed-ACK
         # (~200 ms per round trip); the reference sets TCP_NODELAY on
         # every messenger socket too (AsyncConnection). AF_UNIX sockets
@@ -156,6 +161,39 @@ class InjectingStream:
             self.writer.close()
             raise ConnectionResetError("injected socket failure")
 
+    async def _chaos_action(self) -> str | None:
+        """Consult the seeded chaos schedule for this outgoing frame
+        run. Disarmed (the overwhelmingly common state) costs one
+        attribute check. Delays are served here; a drop/partition
+        severs the session exactly like an injected socket failure
+        (lossless peers replay on reconnect, lossy peers lose the
+        frames — honest TCP semantics); "dup" asks send_frames to
+        write the run twice."""
+        m = self._m
+        ch = m._chaos
+        if ch is None:
+            return None
+        peer = self.chaos_peer
+        if not peer:
+            return None
+        pf = ch.pair(m.name, peer)
+        if pf is None:
+            return None
+        act = pf.next_action()
+        if act is None:
+            return None
+        m.chaos_injected += 1
+        m.perf.inc(f"chaos_{act[0]}")
+        if act[0] == "delay":
+            await asyncio.sleep(act[1])
+            return None
+        if act[0] == "dup":
+            return "dup"
+        self.writer.close()
+        raise ConnectionResetError(
+            f"chaos: {m.name}->{peer} frame dropped"
+        )
+
     async def send(self, frame: Frame, session_key: bytes | None) -> None:
         await self.send_frames([frame], session_key)
 
@@ -167,6 +205,7 @@ class InjectingStream:
         buffer parts are gathered and joined once, so a run of N frames
         costs one syscall and one flow-control wait instead of N."""
         await self._maybe_inject()
+        chaos = await self._chaos_action()
         parts: list = []
         for f in frames:
             parts.extend(f.encode_parts(session_key))
@@ -181,6 +220,10 @@ class InjectingStream:
             perf.inc("corked_msgs", coalesced)
             perf.inc("bytes_coalesced", len(data))
         self.writer.write(data)
+        if chaos == "dup":
+            # wire-level duplication: same bytes (same seqs) again —
+            # the receiver's per-session dedup must absorb them
+            self.writer.write(data)
         racecheck.note_io("msg.send")
         await self.writer.drain()
 

@@ -51,6 +51,7 @@ import zlib
 from ceph_tpu.common.config import Config
 from ceph_tpu.common.crc import ceph_crc32c
 from ceph_tpu.common.kv import KeyValueDB
+from ceph_tpu.common.op_queue import QOS_RECOVERY
 from ceph_tpu.common.watchdog import SharedWatchdog
 from ceph_tpu.msg import (
     Dispatcher,
@@ -579,17 +580,26 @@ class OSDService(Dispatcher):
         # (wpq | mclock), the reference's op-queue switch
         from ceph_tpu.common.op_queue import (
             QOS_DATA_PREFETCH,
+            QOS_RECOVERY,
             MClockOpQueue,
             WeightedPriorityQueue,
             data_prefetch_profile,
+            recovery_profile,
         )
 
         queue_kind = self.config.get("osd_op_queue")
         try:
             data_weight = float(self.config.get("osd_mclock_data_weight"))
+            rec_weight = float(
+                self.config.get("osd_mclock_recovery_weight")
+            )
+            rec_res = float(
+                self.config.get("osd_mclock_recovery_reservation")
+            )
         # cephlint: disable=error-taxonomy (config races boot: fall back to the shipped default weight)
         except Exception:
             data_weight = 0.25
+            rec_weight, rec_res = 0.25, 10.0
 
         def _make_queue():
             if queue_kind != "mclock":
@@ -599,6 +609,12 @@ class OSDService(Dispatcher):
             # it can't starve foreground (weight-1) client classes
             q.set_profile(
                 QOS_DATA_PREFETCH, data_prefetch_profile(data_weight)
+            )
+            # recovery sub-ops (pulls, rebuild reads, batched pushes):
+            # fractional weight caps the storm, the reservation floor
+            # keeps healing from stalling to zero under client load
+            q.set_profile(
+                QOS_RECOVERY, recovery_profile(rec_weight, rec_res)
             )
             return q
 
@@ -615,6 +631,10 @@ class OSDService(Dispatcher):
         #: pool id -> client ops served as primary (cumulative); rides
         #: the mgr report's status section for `ceph top` per-pool rows
         self._pool_ops: dict[int, int] = {}
+        #: object copies missing from our primary PGs (recomputed by
+        #: _pg_stats_loop); rides both the mon pg-stats report and the
+        #: mgr status block, feeding PG_DEGRADED / RECOVERY_SLOW
+        self._degraded_objects = 0
         self._tasks: list[asyncio.Task] = []
         self._ephemeral: set[asyncio.Task] = set()
         self._next_reboot = 0.0
@@ -725,7 +745,8 @@ class OSDService(Dispatcher):
         KV footprint. Cached briefly — the scan is O(rows)."""
         loop = asyncio.get_event_loop()
         cached = getattr(self, "_statfs_cache", None)
-        if cached is not None and loop.time() - cached[0] < 0.5:
+        ttl = float(self.config.get("osd_statfs_cache_sec"))
+        if cached is not None and loop.time() - cached[0] < ttl:
             return cached[1]
         total = self.config.get("osd_statfs_total_bytes")
         used = self.store.used_bytes()
@@ -1445,7 +1466,7 @@ class OSDService(Dispatcher):
             )
             stats = {"num_pgs": 0, "degraded": 0, "undersized": 0,
                      "backfilling": 0, "peering": 0, "inconsistent": 0,
-                     "statfs": self.statfs()}
+                     "degraded_objects": 0, "statfs": self.statfs()}
             for (pool_id, ps), pg in list(self.pgs.items()):
                 pool = self.osdmap.pools.get(pool_id)
                 if pool is None:
@@ -1470,9 +1491,22 @@ class OSDService(Dispatcher):
                     stats["degraded"] += 1
                 if pg.backfill_targets or pg.self_backfill:
                     stats["backfilling"] += 1
+                # object-granular durability debt: one unit per live
+                # object copy/shard a degraded member is missing — the
+                # reference's "N/M objects degraded" numerator
+                short = (pool.size - len(complete)) + (
+                    1 if pg.self_backfill else 0
+                )
+                if short > 0:
+                    nlive = sum(
+                        1 for e in pg.latest_objects().values()
+                        if e["kind"] != "delete"
+                    )
+                    stats["degraded_objects"] += nlive * short
                 stats["inconsistent"] += self._scrub_incons.get(
                     (pool_id, ps), 0
                 )
+            self._degraded_objects = stats["degraded_objects"]
             try:
                 await self.mon.command(
                     "pg stats report",
@@ -1548,6 +1582,7 @@ class OSDService(Dispatcher):
                 "status": {
                     "queue_depth": queue_depth,
                     "inflight_ops": self.op_tracker.num_in_flight,
+                    "degraded_objects": self._degraded_objects,
                     "pool_ops": {
                         str(pid): n for pid, n in self._pool_ops.items()
                     },
@@ -1890,11 +1925,30 @@ class OSDService(Dispatcher):
         """Adopt a more advanced holder's log tail (GetLog + pull). Aborts
         at the first entry whose data is unreachable: appending later
         entries past a gap would advance last_update and silently orphan
-        the skipped one forever."""
+        the skipped one forever.
+
+        The pulls run as ONE bounded-concurrency batch up front (the
+        batched recovery engine): the sub-op reads coalesce into
+        subop_batch frames and concurrent EC rebuilds share decode
+        launches, then the log entries apply strictly in order against
+        the pulled results — the gap-abort contract is unchanged."""
         my_shard = self._my_shard(pg, acting)
         newest: dict[str, dict] = {}
         for e in entries:
             newest[e["name"]] = e
+        need = [
+            e for e in entries
+            if e["kind"] != "delete"
+            and newest[e["name"]]["version"] == e["version"]
+        ]
+        results = await self._recovery_gather(
+            self._pull_object(pg, e["name"], my_shard, acting, e)
+            for e in need
+        )
+        pulled = {
+            (e["name"], e["version"]): got
+            for e, got in zip(need, results)
+        }
         for e in entries:
             txn = Transaction()
             if e["kind"] == "delete":
@@ -1902,18 +1956,38 @@ class OSDService(Dispatcher):
             elif newest[e["name"]]["version"] != e["version"]:
                 pass  # superseded within this pull: newest entry has it
             else:
-                want = shard_name(e["name"], my_shard)
-                got = await self._pull_object(
-                    pg, e["name"], my_shard, acting, e
-                )
+                got = pulled.get((e["name"], e["version"]))
                 if got is None:
                     return False  # retry the whole tail next pass
                 data, attrs = got
-                self._write_fetched(txn, pg.coll, want, data, attrs)
+                self._write_fetched(
+                    txn, pg.coll, shard_name(e["name"], my_shard),
+                    data, attrs,
+                )
             pg.append_log(txn, e)
             self.store.queue_transaction(txn)
             self.perf.inc("recovery_pulls")
         return True
+
+    async def _recovery_gather(self, coros) -> list:
+        """Run recovery fetches concurrently, bounded by
+        `osd_recovery_batch_max` (the reference's osd_recovery_max_active
+        window): results come back in submission order, a failed fetch
+        becomes None (recovery call sites already treat None as
+        retry-next-pass). Concurrency is what lets the per-peer sub-op
+        coalescer fold the reads into batch frames and the EncodeService
+        fold the EC rebuilds into shared decode launches."""
+        limit = max(1, int(self.config.get("osd_recovery_batch_max")))
+        sem = asyncio.Semaphore(limit)
+
+        async def run(c):
+            async with sem:
+                try:
+                    return await c
+                except (asyncio.TimeoutError, RuntimeError):
+                    return None
+
+        return await asyncio.gather(*(run(c) for c in coros))
 
     def _local_logical_names(self, pg: PG) -> dict[str, str]:
         """logical object name -> store name for our copies/shards."""
@@ -1974,6 +2048,7 @@ class OSDService(Dispatcher):
                 return
             my = self._my_shard(pg, acting)
             missing = 0
+            work: list[tuple[str, str, dict]] = []
             for name, e in sorted(pg.latest_objects().items()):
                 if e["kind"] == "delete":
                     continue
@@ -1986,7 +2061,14 @@ class OSDService(Dispatcher):
                         continue
                 except StoreError:
                     pass
-                got = await self._pull_object(pg, name, my, acting, e)
+                work.append((name, sname, e))
+            # one bounded-concurrency batch per sweep: the pulls
+            # coalesce into subop_batch frames / shared decode launches
+            results = await self._recovery_gather(
+                self._pull_object(pg, name, my, acting, e)
+                for name, _sname, e in work
+            )
+            for (name, sname, e), got in zip(work, results):
                 cur = pg.latest_objects().get(name)
                 if got is None or cur is None:
                     missing += 1
@@ -2111,10 +2193,15 @@ class OSDService(Dispatcher):
                     return data, attrs
                 continue
             try:
+                # recovery-tagged + batchable: concurrent pulls to the
+                # same peer fold into one subop_batch frame, and the
+                # receiver admits the read under the mclock recovery
+                # class instead of the client default
                 rep = await self._peer_call(
                     osd, "obj_read",
-                    {"coll": pg.coll, "name": sname, "ver": ver},
-                    timeout=2.0,
+                    {"coll": pg.coll, "name": sname, "ver": ver,
+                     "qos": QOS_RECOVERY},
+                    timeout=2.0, batchable=True,
                 )
             except (asyncio.TimeoutError, RuntimeError):
                 continue
@@ -2180,8 +2267,9 @@ class OSDService(Dispatcher):
                         probe = await self._peer_call(
                             osd, "obj_read",
                             {"coll": pg.coll, "name": sname,
-                             "ver": ver, "runs": []},
-                            timeout=2.0,
+                             "ver": ver, "runs": [],
+                             "qos": QOS_RECOVERY},
+                            timeout=2.0, batchable=True,
                         )
                     except (asyncio.TimeoutError, RuntimeError):
                         return None
@@ -2198,8 +2286,9 @@ class OSDService(Dispatcher):
                         osd, "obj_read",
                         {"coll": pg.coll, "name": sname, "ver": ver,
                          "runs": [[o * unit, c * unit]
-                                  for o, c in runs]},
-                        timeout=2.0,
+                                  for o, c in runs],
+                         "qos": QOS_RECOVERY},
+                        timeout=2.0, batchable=True,
                     )
                 except (asyncio.TimeoutError, RuntimeError):
                     return None
@@ -2232,26 +2321,46 @@ class OSDService(Dispatcher):
                 return got
         chunks: dict[int, bytes] = {}
         attrs = None
-        for pos in range(len(acting)):
-            if pos == shard:
-                continue
+        k = ec.get_data_chunk_count()
+
+        async def fetch(pos: int):
             cands = [
                 o for o in self._holders_for(acting, pos) if o != exclude
             ]
-            got = await self._fetch_copy(
+            return await self._fetch_copy(
                 pg, shard_name(name, pos), ver, cands
             )
+
+        # fetch the first k source positions concurrently (every rebuild
+        # of this stripe geometry picks the SAME lowest positions, so
+        # concurrent rebuilds share a (present, targets) signature and
+        # coalesce below), topping up serially only past failures
+        positions = [p for p in range(len(acting)) if p != shard]
+        first = positions[:k]
+        for pos, got in zip(first, await asyncio.gather(
+            *(fetch(p) for p in first)
+        )):
             if got is not None:
                 chunks[pos] = got[0]
                 attrs = attrs or got[1]
-            if len(chunks) >= ec.get_data_chunk_count():
+        for pos in positions[k:]:
+            if len(chunks) >= k:
                 break
-        if len(chunks) < ec.get_data_chunk_count():
+            got = await fetch(pos)
+            if got is not None:
+                chunks[pos] = got[0]
+                attrs = attrs or got[1]
+        if len(chunks) < k:
             return None
-        # serial recovery path: decode directly — routing through the
-        # batch service would pay the window per object with no chance
-        # of coalescing (one outstanding decode at a time)
-        return ec.decode({shard}, chunks)[shard], attrs
+        # decode through the batch service: concurrent rebuilds (a
+        # batched recovery pass pulls many objects at once) sharing a
+        # source signature fuse into ONE decode launch across objects
+        try:
+            out = await self.encode_service.decode(ec, {shard}, chunks)
+        # cephlint: disable=error-taxonomy (decode failed: caller treats the object as unrecoverable this pass)
+        except Exception:
+            return None
+        return out[shard], attrs
 
     async def _pull_object(
         self, pg: PG, name: str, shard: int | None, acting: list[int], entry
@@ -2308,60 +2417,117 @@ class OSDService(Dispatcher):
             since = info["last_update"]
             if since >= pg.last_update:
                 continue
-            for e in pg.log_entries(since):
+
+            async def resolve(e, _shard=shard):
                 latest = inventory.get(e["name"])
-                raw = b""
-                if latest is None or latest["version"] != e["version"]:
-                    # superseded entry: the newest one will carry the data
-                    payload = {"entry": e, "has_data": False}
-                elif e["kind"] == "delete":
-                    payload = {"entry": e, "has_data": False}
-                else:
-                    got = await self._object_for_push(
-                        pg, e, shard, acting
-                    )
-                    if got is None:
-                        complete = False  # sources unavailable right now
-                        continue
-                    raw, attrs = got
-                    payload = {
-                        "entry": e,
-                        "has_data": True,
-                        "attrs": _attrs_to(attrs),
-                    }
-                try:
-                    await self._peer_call(
-                        osd, "obj_push",
-                        {"pgid": [pg.pool, pg.ps],
-                         "shard": shard, **payload},
-                        timeout=5.0, raw=raw,
-                    )
-                    self.perf.inc("recovery_pushes")
-                except (asyncio.TimeoutError, RuntimeError):
-                    complete = False
-                    break  # next pass retries this member
+                if (
+                    latest is None
+                    or latest["version"] != e["version"]
+                    or e["kind"] == "delete"
+                ):
+                    # superseded entry: the newest one carries the data
+                    return {"entry": e, "has_data": False}, b""
+                got = await self._object_for_push(
+                    pg, e, _shard, acting
+                )
+                if got is None:
+                    return None  # sources unavailable right now
+                raw, attrs = got
+                return {
+                    "entry": e,
+                    "has_data": True,
+                    "attrs": _attrs_to(attrs),
+                }, raw
+
+            _acked, ok = await self._push_batches(
+                pg, osd, shard, list(pg.log_entries(since)), resolve
+            )
+            if not ok:
+                complete = False  # next pass retries this member
         pg.backfill_targets = targets
         return complete
 
+    async def _push_batches(
+        self, pg: PG, osd: int, shard: int | None, entries: list,
+        resolve, skip_unresolved: bool = True,
+    ) -> tuple[list, bool]:
+        """Ship recovery pushes to `osd` as ordered obj_push_batch
+        frames of up to `osd_recovery_batch_max` items: payloads resolve
+        concurrently (fetches/rebuilds coalesce), then one frame and one
+        ack move the whole batch instead of a round trip per object.
+        Returns (entries acked, everything resolved AND acked). An
+        unresolvable payload is skipped (`skip_unresolved`) or aborts
+        the remaining batches — either way the result reads incomplete.
+        Batches to a member go strictly one at a time: the receiver's
+        admission queue must never reorder two in-flight batches, or
+        log versions would land out of order and leave holes."""
+        limit = max(1, int(self.config.get("osd_recovery_batch_max")))
+        acked: list = []
+        ok = True
+        for i in range(0, len(entries), limit):
+            group = entries[i:i + limit]
+            payloads = await self._recovery_gather(
+                resolve(e) for e in group
+            )
+            items: list[dict] = []
+            raws: list[bytes] = []
+            for got in payloads:
+                if got is None:
+                    ok = False
+                    if not skip_unresolved:
+                        return acked, False
+                    continue
+                payload, raw = got
+                payload = dict(payload)
+                payload["raw_len"] = len(raw)
+                items.append(payload)
+                raws.append(raw)
+            if not items:
+                continue
+            try:
+                rep = await self._peer_call(
+                    osd, "obj_push_batch",
+                    {"pgid": [pg.pool, pg.ps], "shard": shard,
+                     "items": items, "qos": QOS_RECOVERY},
+                    timeout=10.0, raw=b"".join(raws),
+                )
+            except (asyncio.TimeoutError, RuntimeError):
+                return acked, False
+            if not rep.get("ok"):
+                return acked, False
+            acked.extend(it["entry"] for it in items)
+            self.perf.inc("recovery_pushes", len(items))
+        return acked, ok
+
     async def _drain_backfill(self, pg: PG) -> None:
-        """Background backfill of this PG's targets, one at a time,
-        while the PG serves client IO (recover_backfill running under
-        the Active state). Ends when no targets remain or primaryship
-        moves (the next peering pass re-evaluates)."""
+        """Background backfill of this PG's targets — concurrently,
+        bounded by the osd_max_backfills semaphore — while the PG
+        serves client IO (recover_backfill running under the Active
+        state). Ends when no targets remain or primaryship moves (the
+        next peering pass re-evaluates)."""
         while pg.backfill_targets and not self._stopped:
             acting, primary = self.acting_of(pg.pool, pg.ps)
             if primary != self.id or not pg.active:
                 return
             ec = self.codec(pg.pool)
             progressed = False
+            live: list[int] = []
             for osd in sorted(pg.backfill_targets):
                 if osd not in acting or self.osdmap.is_down(osd):
                     pg.backfill_targets.discard(osd)
                     progressed = True
                     continue
-                pos = acting.index(osd)
-                shard = pos if ec is not None else None
-                if await self._backfill_member(pg, acting, osd, shard):
+                live.append(osd)
+
+            async def drain_one(osd: int) -> bool:
+                shard = acting.index(osd) if ec is not None else None
+                return await self._backfill_member(
+                    pg, acting, osd, shard
+                )
+
+            done = await asyncio.gather(*(drain_one(o) for o in live))
+            for osd, finished in zip(live, done):
+                if finished:
                     pg.backfill_targets.discard(osd)
                     progressed = True
                     if (d := self.dlog.dout(5)) is not None:
@@ -2391,36 +2557,30 @@ class OSDService(Dispatcher):
         async with self._backfill_sem:
             pushed: dict[str, int] = {}
 
+            async def resolve(e):
+                if e["kind"] == "delete":
+                    return {"entry": e, "has_data": False}, b""
+                got = await self._object_for_push(pg, e, shard, acting)
+                if got is None:
+                    return None
+                raw, attrs = got
+                return {"entry": e, "has_data": True, "force": True,
+                        "attrs": _attrs_to(attrs)}, raw
+
             async def push_diff() -> int | None:
-                n = 0
-                for name, e in sorted(pg.latest_objects().items()):
-                    if pushed.get(name) == e["version"]:
-                        continue
-                    if e["kind"] == "delete":
-                        payload, raw = {"entry": e, "has_data": False}, b""
-                    else:
-                        got = await self._object_for_push(
-                            pg, e, shard, acting
-                        )
-                        if got is None:
-                            return None
-                        raw, attrs = got
-                        payload = {"entry": e, "has_data": True,
-                                   "force": True,
-                                   "attrs": _attrs_to(attrs)}
-                    try:
-                        await self._peer_call(
-                            osd, "obj_push",
-                            {"pgid": [pg.pool, pg.ps],
-                             "shard": shard, **payload},
-                            timeout=5.0, raw=raw,
-                        )
-                        self.perf.inc("recovery_pushes")
-                    except (asyncio.TimeoutError, RuntimeError):
-                        return None
-                    pushed[name] = e["version"]
-                    n += 1
-                return n
+                work = [
+                    e for name, e in sorted(pg.latest_objects().items())
+                    if pushed.get(name) != e["version"]
+                ]
+                acked, ok = await self._push_batches(
+                    pg, osd, shard, work, resolve,
+                    skip_unresolved=False,
+                )
+                for e in acked:
+                    pushed[e["name"]] = e["version"]
+                if not ok:
+                    return None
+                return len(work)
 
             for _pass in range(5):
                 n = await push_diff()
@@ -2531,11 +2691,40 @@ class OSDService(Dispatcher):
             self.store.queue_transaction(txn)
         self._reply_peer(conn, p["tid"], {"ok": True})
 
+    def _admit_recovery(self, conn, p, fn) -> bool:
+        """Recovery-class admission: a sub-op tagged `qos: recovery`
+        takes a detour through the sharded op queue under the mclock
+        recovery profile before its handler runs — client ops keep
+        their weight share against a recovery storm, and the recovery
+        reservation keeps healing off zero under client storms. Returns
+        True when the op was queued (caller returns; the shard worker
+        re-enters `fn` with the admission marker set). Gating here in
+        the handler (not ms_dispatch) covers batch-inner sub-ops too —
+        _h_subop_batch calls handlers directly."""
+        if p.get("qos") != QOS_RECOVERY or p.pop("_admitted", False):
+            return False
+        p["_admitted"] = True
+        p["_rfn"] = fn
+        key = str(p.get("name") or p.get("pgid"))
+        shard = self._op_shards[
+            zlib.crc32(key.encode()) % len(self._op_shards)
+        ]
+        shard.queue.enqueue(
+            63,
+            max(1, len(p.get("_raw") or b"") // 4096),
+            (conn, p),
+            klass=QOS_RECOVERY,
+        )
+        shard.kick.set()
+        return True
+
     async def _h_obj_read(self, conn, p) -> None:
         """handle_sub_read: local read (+ version check when asked).
         `runs` = [[off,len],...] requests sub-extent ranges only — the
         ECSubRead (offset,count) shape (src/osd/ECMsgTypes.h to_read)
         that sub-stripe RMW reads and CLAY fractional repairs ride."""
+        if self._admit_recovery(conn, p, self._h_obj_read):
+            return
         reader = self.store.read
         if p.get("verify"):
             # deep-scrub fetch: read device truth, not the buffer cache
@@ -2603,6 +2792,49 @@ class OSDService(Dispatcher):
             txn.remove(pg.coll, sname)
         self.store.queue_transaction(txn)
         self._reply_peer(conn, p["tid"], {"ok": True})
+
+    async def _h_obj_push_batch(self, conn, p) -> None:
+        if self._admit_recovery(conn, p, self._h_obj_push_batch):
+            return
+        self._enqueue_subop(p, self._do_obj_push_batch, conn)
+
+    async def _do_obj_push_batch(self, conn, p) -> None:
+        """Many recovery pushes, one frame, one commit, one ack (the
+        batched recovery engine's push leg). Items apply strictly IN
+        ORDER — log versions must land monotonically or the
+        `version > last_update` gate would punch holes — under the same
+        per-item version/force gates as _do_obj_push, and the whole
+        batch lands in one store transaction."""
+        pg = self._pg_of(p["pgid"])
+        raw = p.get("_raw") or b""
+        off = 0
+        txn = Transaction()
+        for item in p["items"]:
+            e = item["entry"]
+            n = int(item.get("raw_len") or 0)
+            data = raw[off:off + n]
+            off += n
+            sname = shard_name(e["name"], p.get("shard"))
+            if e["version"] > pg.last_update:
+                pg.append_log(txn, e)
+            if item.get("has_data"):
+                pushed_ver = _attrs_from(item).get("ver") or 0
+                try:
+                    local_ver = self.store.getattrs(
+                        pg.coll, sname
+                    ).get("ver") or 0
+                except StoreError:
+                    local_ver = 0
+                if item.get("force") or local_ver <= pushed_ver:
+                    self._write_fetched(
+                        txn, pg.coll, sname, data, _attrs_from(item)
+                    )
+            elif e["kind"] == "delete":
+                txn.remove(pg.coll, sname)
+        self.store.queue_transaction(txn)
+        self._reply_peer(
+            conn, p["tid"], {"ok": True, "applied": len(p["items"])}
+        )
 
     async def _h_rep_write(self, conn, p) -> None:
         self._enqueue_subop(p, self._do_rep_write, conn)
@@ -3125,6 +3357,16 @@ class OSDService(Dispatcher):
                 await shard.kick.wait()
                 continue
             conn, p = item
+            rfn = p.pop("_rfn", None)
+            if rfn is not None:
+                # admitted recovery sub-op: re-enter its handler as an
+                # ephemeral task (the handler replies to the peer; an
+                # obj_push_batch re-queues itself on the PG FIFO) so a
+                # slow store op can't block the shard's client ops
+                task = asyncio.create_task(rfn(conn, p))
+                self._ephemeral.add(task)
+                task.add_done_callback(self._ephemeral.discard)
+                continue
             name = p.get("name")
             inflight = shard.inflight.get(name)
             if self._op_pipelines(p):
@@ -3231,8 +3473,12 @@ class OSDService(Dispatcher):
                     ):
                         return
                     # cannot prove our copy current: bounce to the
-                    # primary, never serve unproven data
+                    # primary, never serve unproven data — and when our
+                    # marker names the PG's backfill targets (we may be
+                    # one), ship them so the client's round robin stops
+                    # landing reads here while the backfill drains
                     self.perf.inc("read_redirected")
+                    mk = self._pg_of((pool_id, ps)).replica_marker
                     conn.send_message(
                         Message(
                             type="osd_op_reply", tid=p["tid"],
@@ -3240,6 +3486,7 @@ class OSDService(Dispatcher):
                             payload=redirect_reply(
                                 p["tid"], primary, self.osdmap.epoch,
                                 "replica cannot prove its copy current",
+                                backfill=(mk or {}).get("backfill"),
                             ),
                         )
                     )
@@ -4710,8 +4957,10 @@ class OSDService(Dispatcher):
 
         def _redirect(why: str) -> None:
             self.perf.inc("read_redirected")
+            mk = pg.replica_marker
             _send(redirect_reply(
-                p["tid"], primary, self.osdmap.epoch, why
+                p["tid"], primary, self.osdmap.epoch, why,
+                backfill=(mk or {}).get("backfill"),
             ))
 
         pos = p.get("shard")

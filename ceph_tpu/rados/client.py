@@ -89,6 +89,13 @@ class Objecter(Dispatcher):
         #: whole acting set, not just the primary)
         self._target_cache: dict[tuple[int, int], tuple[list[int], int]] = {}
         self._target_cache_epoch = -1
+        #: (pool, ps) -> (expiry, backfill-target osds) learned from
+        #: redirect replies: a PG mid-backfill has acting members that
+        #: ALWAYS bounce balanced reads, so the round robin skips them
+        #: instead of paying a redirect round trip every size-th read.
+        #: Entries die with the epoch (the cache above) or after a TTL —
+        #: backfill completion bumps no epoch, so time heals the set
+        self._avoid_cache: dict[tuple[int, int], tuple[float, set]] = {}
         #: balanced-read round robin over clean acting members
         self._rr = itertools.count(0)
         #: localize: uds hint path -> exists-on-this-host (stat once per
@@ -310,6 +317,7 @@ class Objecter(Dispatcher):
         epoch = self.osdmap.epoch
         if epoch != self._target_cache_epoch:
             self._target_cache.clear()
+            self._avoid_cache.clear()
             self._target_cache_epoch = epoch
         hit = self._target_cache.get((pool_id, ps))
         if hit is None:
@@ -500,6 +508,17 @@ class Objecter(Dispatcher):
                     # EC logical reads stay at the primary (the decode
                     # path); the EC fast path is ec_direct_read
                     cands = self.osdmap.read_candidates(acting)
+                    avoid = self._avoid_cache.get((eff_pool, ps))
+                    if avoid is not None:
+                        now = asyncio.get_event_loop().time()
+                        if now >= avoid[0]:
+                            del self._avoid_cache[(eff_pool, ps)]
+                        else:
+                            # skip known backfill targets — they can
+                            # only bounce us back to the primary
+                            cands = [
+                                o for o in cands if o not in avoid[1]
+                            ] or cands
                     if read_policy == "localize":
                         local = [
                             o for o in cands if self._osd_is_local(o)
@@ -575,6 +594,16 @@ class Objecter(Dispatcher):
                 if span is not None:
                     span.log(f"redirect: osd.{target} -> primary")
                 forced_primary = True
+                bf = reply.get("backfill")
+                if bf:
+                    # remember the PG's backfill targets so FUTURE
+                    # balanced reads round-robin past them (satisfied
+                    # members still serve; one bounce, not one per
+                    # size-th read until the backfill drains)
+                    self._avoid_cache[(eff_pool, ps)] = (
+                        asyncio.get_event_loop().time() + 10.0,
+                        set(bf),
+                    )
                 if reply.get("epoch", 0) > self.osdmap.epoch:
                     await self._refresh_map()
                 continue

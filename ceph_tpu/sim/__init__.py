@@ -13,7 +13,8 @@ so tier-1 can assert on a mini scenario while the bench drives the
 1000-OSD / million-PG scale.
 """
 
+from ceph_tpu.sim.chaos import chaos_script, run_chaos
 from ceph_tpu.sim.cluster import build_cluster
 from ceph_tpu.sim.scenario import run_scenario
 
-__all__ = ["build_cluster", "run_scenario"]
+__all__ = ["build_cluster", "chaos_script", "run_chaos", "run_scenario"]

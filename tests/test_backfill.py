@@ -6,6 +6,7 @@ PrimaryLogPG recover_backfill."""
 import asyncio
 
 from ceph_tpu.common.config import Config
+from ceph_tpu.msg.messenger import next_dispatch_event
 from ceph_tpu.rados.client import Rados
 from tests.test_cluster_live import REP_POOL, Cluster, wait_until
 
@@ -107,10 +108,16 @@ def test_backfill_revives_peer_past_trimmed_log():
                 errors.extend(rep["errors"])
             return errors
 
-        deadline = asyncio.get_event_loop().time() + 60
+        # scrub clean-up rides recovery pushes, so park on the dispatch
+        # hook between polls instead of sleeping a fixed interval
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + 60
         errors = await scrub_errors()
-        while errors and asyncio.get_event_loop().time() < deadline:
-            await asyncio.sleep(1)
+        while errors and loop.time() < deadline:
+            try:
+                await asyncio.wait_for(next_dispatch_event(), 0.25)
+            except asyncio.TimeoutError:
+                pass
             errors = await scrub_errors()
         assert errors == [], errors
         await rados.shutdown()

@@ -384,3 +384,98 @@ def _async_pred(sync_pred):
         return sync_pred()
 
     return p
+
+def test_backfill_hint_spares_redirect_round_trips():
+    """Satellite fix: balanced reads against a PG with backfill in
+    progress used to pay one redirect round-trip per read that landed
+    on the backfill target.  The redirect reply now carries the
+    marker's backfill set, the objecter caches it, and subsequent
+    balanced reads go straight to clean acting members — the
+    read_redirected counter stays FLAT while reads keep flowing."""
+
+    async def main():
+        # aggressive log trim: the writes below push h0's PG log past
+        # the amnesiac member's position 0, so revival MUST backfill
+        # (log recovery would drain instantly and close the window)
+        cfg = live_config()
+        cfg.set("osd_min_pg_log_entries", 20)
+        cluster = Cluster(cfg=cfg)
+        await cluster.start()
+        rados = Rados("client.hint", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        rep = rados.io_ctx(REP_POOL)
+
+        data = {}
+        for i in range(8):
+            data[f"h{i}"] = bytes([i + 1]) * (800 + 131 * i)
+            await rep.write_full(f"h{i}", data[f"h{i}"])
+
+        ps, acting, primary = acting_of(cluster, REP_POOL, "h0")
+        victim = next(o for o in acting if o != primary)
+        await cluster.kill_osd(victim)
+        leader = next(m for m in cluster.mons if m.is_leader)
+        await wait_until(lambda: leader.osdmap.is_down(victim), timeout=30)
+        for round_ in range(30):
+            for i in range(8):
+                data[f"h{i}"] = bytes([(i + round_) % 251 + 1]) * 700
+                await rep.write_full(f"h{i}", data[f"h{i}"])
+
+        # amnesiac revival with recovery PARKED on the reborn member:
+        # pushes are swallowed and pulls fail, so the PG deterministically
+        # stays a backfill-in-progress PG for the whole measurement
+        reborn = await cluster.start_osd(victim)
+
+        async def swallow(conn, p):
+            return None  # no ack: the source retries forever
+
+        reborn._h_obj_push_batch = swallow
+        reborn._h_obj_push = swallow
+
+        async def no_pull(*a, **kw):
+            return None
+
+        reborn._pull_object = no_pull
+        await wait_until(
+            lambda: leader.osdmap.osd_up[victim]
+            and not leader.osdmap.is_down(victim),
+            timeout=30,
+        )
+
+        # wait until the reborn member holds a marker that PROVES it is
+        # a backfill target (the redirect hint's source of truth)
+        def marked():
+            pg = reborn.pgs.get((REP_POOL, ps))
+            mk = pg.replica_marker if pg else None
+            return bool(mk and victim in (mk.get("backfill") or ()))
+
+        await _wait_async(_async_pred(marked), timeout=30)
+
+        # prime: balanced reads of h0 run until one lands on the
+        # backfill target and comes back with the redirect + hint
+        rep.read_policy = "balance"
+        for _ in range(80):
+            assert await rep.read("h0") == data["h0"]
+            if (REP_POOL, ps) in rados.objecter._avoid_cache:
+                break
+        assert (REP_POOL, ps) in rados.objecter._avoid_cache, (
+            "the redirect reply never delivered a backfill hint"
+        )
+
+        # measure (h0's PG only — the hint is cached per PG): with the
+        # avoid set cached, NO further read pays a redirect round-trip —
+        # the counter stays flat while balanced reads keep serving from
+        # clean members
+        before_rdr = fleet_perf(cluster, "read_redirected")
+        before_bal = fleet_perf(cluster, "read_balanced")
+        for _round in range(40):
+            assert await rep.read("h0") == data["h0"]
+        assert fleet_perf(cluster, "read_redirected") == before_rdr, (
+            "reads kept bouncing off the backfill target despite the hint"
+        )
+        assert fleet_perf(cluster, "read_balanced") > before_bal
+
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())

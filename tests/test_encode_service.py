@@ -228,3 +228,44 @@ def test_planar_batches_dispatch_through_device_mesh():
                 assert got[phys] == c[phys]
 
     run(main())
+
+
+def test_recovery_decode_batch_is_one_launch():
+    """The batched recovery engine's decode contract: N objects that
+    each lost the SAME shard position (the post-failure common case —
+    one OSD died, every object in the PG is short the same position)
+    coalesce into exactly ONE decode launch."""
+    from ceph_tpu.ec.registry import factory
+    from ceph_tpu.osd.encode_service import EncodeService
+
+    async def main():
+        codec = factory("tpu", {"k": "2", "m": "2"})
+        svc = EncodeService(window=0.05)  # wide window: determinism
+        rng = np.random.default_rng(11)
+        n = 8
+        payloads = [
+            rng.integers(0, 256, size=2048, dtype=np.uint8).tobytes()
+            for _ in range(n)
+        ]
+        full = [
+            codec.encode(range(codec.get_chunk_count()), p)
+            for p in payloads
+        ]
+        before = svc.launches
+        # every object presents the same (present, target) signature:
+        # shard 1 lost, rebuilt from the surviving k lowest positions
+        # (exactly what _rebuild_shard fetches)
+        outs = await asyncio.gather(*(
+            svc.decode(
+                codec, {1},
+                {i: c for i, c in full[j].items() if i in (0, 2)},
+            )
+            for j in range(n)
+        ))
+        assert svc.launches - before == 1, (
+            f"{svc.launches - before} launches for {n} recovery decodes"
+        )
+        for j in range(n):
+            assert outs[j][1] == full[j][1]
+
+    run(main())

@@ -12,6 +12,7 @@ import asyncio
 
 import pytest
 
+from ceph_tpu.msg.messenger import next_dispatch_event
 from ceph_tpu.rados.client import Rados, RadosError
 from tests.test_cluster_live import (
     REP_POOL,
@@ -31,6 +32,9 @@ def tiny_config():
     # (x3 replicas) crosses the ratios fast
     cfg.set("osd_statfs_total_bytes", 40_000)
     cfg.set("osd_mon_report_interval", 0.3)
+    # recompute statfs on every call: the fill loop and the post-purge
+    # write see fresh usage without sleeping out a cache TTL
+    cfg.set("osd_statfs_cache_sec", 0)
     return cfg
 
 
@@ -57,7 +61,6 @@ def test_fill_to_full_gates_writes_and_deletes_recover():
             try:
                 await io.write_full(f"fill-{i}", b"F" * 4096)
                 written.append(f"fill-{i}")
-                await asyncio.sleep(0.05)  # let the statfs cache turn
             except RadosError as e:
                 assert "ENOSPC" in str(e), e
                 blocked = f"fill-{i}"
@@ -83,12 +86,17 @@ def test_fill_to_full_gates_writes_and_deletes_recover():
             )
 
         async def wait_health(pred, timeout=20.0):
+            # health transitions ride osd->mon stat reports, so park on
+            # the dispatch hook between polls rather than wall-clock
             loop = asyncio.get_event_loop()
             end = loop.time() + timeout
             while not await pred():
                 if loop.time() > end:
                     raise TimeoutError
-                await asyncio.sleep(0.2)
+                try:
+                    await asyncio.wait_for(next_dispatch_event(), 0.25)
+                except asyncio.TimeoutError:
+                    pass
 
         await wait_health(full_reported)
         h = await health(admin)
@@ -99,8 +107,7 @@ def test_fill_to_full_gates_writes_and_deletes_recover():
         for name in written:
             await io.remove(name)
 
-        # with space freed (and the statfs cache turned), writes resume
-        await asyncio.sleep(0.7)
+        # with space freed (statfs recomputes per call), writes resume
         await io.write_full("after-purge", b"ok" * 100)
         assert await io.read("after-purge") == b"ok" * 100
 
@@ -140,7 +147,7 @@ def test_statfs_reported_and_sane():
         # little; the 10 KB payload dwarfs it)
         used_before = st["used"]
         await io.remove("obj")
-        await asyncio.sleep(0.6)  # statfs cache
+        cluster.cfg.set("osd_statfs_cache_sec", 0)  # bypass the TTL
         assert osd.statfs()["used"] < used_before - 5_000
 
         await admin.shutdown()

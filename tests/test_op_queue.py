@@ -178,3 +178,42 @@ def test_mclock_data_prefetch_profile_values():
     assert p.weight == 0.5
     # weight floor keeps the tag algebra finite
     assert data_prefetch_profile(0.0).weight >= 0.01
+
+
+def test_mclock_recovery_profile_values():
+    from ceph_tpu.common.op_queue import recovery_profile
+
+    p = recovery_profile(0.25, 10.0)
+    assert p.weight == 0.25 and p.reservation == 10.0
+    assert p.limit == 0.0
+    # floors keep the tag algebra finite / the reservation sane
+    assert recovery_profile(0.0, -1.0).weight >= 0.01
+    assert recovery_profile(0.0, -1.0).reservation == 0.0
+
+
+def test_mclock_recovery_storm_bounded_but_never_starved():
+    """A recovery storm against a busy client: the fractional weight
+    caps recovery's share (clients keep the bulk of the throughput),
+    while the reservation floor keeps healing off zero — the two-sided
+    contract the batched recovery engine rides on."""
+    from ceph_tpu.common.op_queue import QOS_RECOVERY, recovery_profile
+
+    q = MClockQueue()
+    q.set_profile("client", ClientInfo(weight=1.0))
+    q.set_profile(QOS_RECOVERY, recovery_profile(0.25, 2.0))
+    for i in range(400):
+        q.enqueue("client", ("c", i))
+        q.enqueue(QOS_RECOVERY, ("r", i))
+    got = Counter()
+    for tick in range(20):
+        q.now = float(tick)
+        for _ in range(10):
+            r = q.dequeue()
+            if r is None:
+                break
+            got[r[0]] += 1
+    # clients dominate: recovery cannot starve them...
+    assert got["client"] > got[QOS_RECOVERY], got
+    assert got["client"] >= 100, got
+    # ...but the reservation floor (2/tick) keeps recovery moving
+    assert got[QOS_RECOVERY] >= 30, got

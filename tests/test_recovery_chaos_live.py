@@ -1,0 +1,227 @@
+"""Recovery-under-chaos crash matrix (qa Thrasher kill_osd mid-backfill
++ msgr partition fragments): kill -9 of the backfill SOURCE while it is
+pushing, and an asymmetric partition (primary sees replica, replica
+cannot see primary) during log-based recovery.  Both must converge to
+clean with zero acked-data loss and a clean deep scrub — the batched
+recovery engine's no-torn-state contract."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados.client import ObjectNotFound, Rados
+from tests.test_cluster_live import (
+    EC_POOL,
+    N_OSDS,
+    REP_POOL,
+    Cluster,
+    live_config,
+    wait_until,
+)
+
+pytestmark = pytest.mark.slow
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 300))
+
+
+def backfill_source(cluster):
+    """The OSD currently pushing a backfill, or None."""
+    for osd_id, osd in sorted(cluster.osds.items()):
+        for pg in osd.pgs.values():
+            if pg.backfill_targets:
+                return osd_id
+    return None
+
+
+async def assert_clean_deep_scrub(cluster, rados, pools, timeout=90):
+    """Deep scrub of every pool on every primary settles to zero
+    errors (polled: stray copies from churn drain over peering)."""
+
+    async def scrub_errors():
+        errs = []
+        for o in list(cluster.osds.values()):
+            for pool in pools:
+                rep = await rados.objecter.osd_admin(
+                    o.id, "scrub", {"pool": pool, "deep": True}
+                )
+                errs.extend(rep["errors"])
+        return errs
+
+    deadline = asyncio.get_event_loop().time() + timeout
+    errors = await scrub_errors()
+    while errors and asyncio.get_event_loop().time() < deadline:
+        await asyncio.sleep(1)
+        errors = await scrub_errors()
+    assert errors == [], errors
+
+
+def recovery_config():
+    cfg = live_config()
+    cfg.set("osd_min_pg_log_entries", 20)  # log trim puts backfill in play
+    return cfg
+
+
+def test_kill9_backfill_source_mid_push():
+    """Amnesiac revival makes the victim a backfill target; the moment a
+    source is pushing to it, that source dies (process kill, store
+    survives).  The cluster re-elects sources, finishes the backfill,
+    and every acked object reads back — zero loss, clean scrub."""
+
+    async def main():
+        cluster = Cluster(cfg=recovery_config())
+        await cluster.start()
+        rados = Rados("client.k9", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        rep = rados.io_ctx(REP_POOL)
+        ec = rados.io_ctx(EC_POOL)
+
+        # enough entries per PG that the trimmed logs cannot reach an
+        # empty store's position 0 -> revival MUST backfill, not pull
+        acked = {}
+        for i in range(200):
+            data = bytes([i % 251]) * (100 + i % 37)
+            await rep.write_full(f"k{i:03}", data)
+            acked[f"k{i:03}"] = data
+        ec_acked = {}
+        for i in range(20):
+            data = bytes([i % 251]) * 900
+            await ec.write_full(f"e{i}", data)
+            ec_acked[f"e{i}"] = data
+
+        # amnesiac revival: fresh store, same id -> backfill target
+        victim = 2
+        await cluster.kill_osd(victim)
+        leader = next(m for m in cluster.mons if m.is_leader)
+        await wait_until(lambda: leader.osdmap.is_down(victim))
+        for i in range(200, 230):
+            data = bytes([i % 251]) * 140
+            await rep.write_full(f"k{i:03}", data)
+            acked[f"k{i:03}"] = data
+
+        # slow every frame toward the victim so the push window is wide
+        # enough to catch the source mid-backfill deterministically
+        cluster.cfg.set("ms_inject_chaos_seed", 7)
+        cluster.cfg.set(
+            "ms_inject_chaos_schedule",
+            f"delay:osd.*>osd.{victim}:1:0.4",
+        )
+        await cluster.start_osd(victim)
+
+        # the instant someone is pushing to it, kill -9 that source
+        await wait_until(
+            lambda: backfill_source(cluster) is not None, timeout=60
+        )
+        source = backfill_source(cluster)
+        assert source != victim
+        db = cluster.osds[source].store.db
+        await cluster.kill_osd(source)
+        cluster.cfg.set("ms_inject_chaos_schedule", "")
+        await wait_until(lambda: leader.osdmap.is_down(source))
+
+        # writes keep flowing while the source is down
+        for i in range(230, 240):
+            data = bytes([i % 251]) * 160
+            await rep.write_full(f"k{i:03}", data)
+            acked[f"k{i:03}"] = data
+
+        await cluster.start_osd(source, db=db)
+        await wait_until(
+            lambda: all(
+                not any(o.osdmap.is_down(i) for i in range(N_OSDS))
+                for o in cluster.osds.values()
+            ),
+            timeout=60,
+        )
+        await wait_until(
+            lambda: backfill_source(cluster) is None, timeout=90
+        )
+
+        # zero acked-data loss
+        for name, data in sorted(acked.items()):
+            assert await rep.read(name) == data
+        for name, data in sorted(ec_acked.items()):
+            assert await ec.read(name) == data
+        await assert_clean_deep_scrub(
+            cluster, rados, (REP_POOL, EC_POOL)
+        )
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_asymmetric_partition_during_recovery():
+    """One-way partition while log-based recovery runs: the revived
+    replica cannot reach its primary (its sends die, the primary's
+    still deliver).  Heartbeats flag the asymmetry, the mon remaps or
+    the partition heals, and recovery completes with zero loss."""
+
+    async def main():
+        cluster = Cluster(cfg=recovery_config())
+        await cluster.start()
+        rados = Rados("client.part", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        rep = rados.io_ctx(REP_POOL)
+
+        acked = {}
+        for i in range(30):
+            data = bytes([i % 251]) * (200 + 17 * i)
+            await rep.write_full(f"a{i}", data)
+            acked[f"a{i}"] = data
+
+        # down a replica, write through the hole -> recovery debt
+        victim = 1
+        db = cluster.osds[victim].store.db
+        await cluster.kill_osd(victim)
+        leader = next(m for m in cluster.mons if m.is_leader)
+        await wait_until(lambda: leader.osdmap.is_down(victim))
+        for i in range(30, 45):
+            data = bytes([i % 251]) * 500
+            await rep.write_full(f"a{i}", data)
+            acked[f"a{i}"] = data
+
+        # revive it UNDER an asymmetric partition: the victim cannot
+        # reach osd.0 (a likely recovery source), osd.0 reaches it fine
+        cluster.cfg.set("ms_inject_chaos_seed", 42)
+        cluster.cfg.set(
+            "ms_inject_chaos_schedule",
+            f"partition:osd.{victim}>osd.0",
+        )
+        await cluster.start_osd(victim, db=db)
+        await wait_until(
+            lambda: not leader.osdmap.is_down(victim), timeout=60
+        )
+        # client IO keeps working through the asymmetry
+        await rep.write_full("during-partition", b"P" * 600)
+        acked["during-partition"] = b"P" * 600
+
+        # hold the partition across a few peering passes, then heal
+        await asyncio.sleep(3.0)
+        cluster.cfg.set("ms_inject_chaos_schedule", "")
+
+        await wait_until(
+            lambda: all(
+                not any(o.osdmap.is_down(i) for i in range(N_OSDS))
+                for o in cluster.osds.values()
+            ),
+            timeout=60,
+        )
+        await wait_until(
+            lambda: backfill_source(cluster) is None, timeout=90
+        )
+
+        for name, data in sorted(acked.items()):
+            try:
+                got = await rep.read(name)
+            except ObjectNotFound:
+                got = None
+            assert got == data, (name, "acked write lost")
+        await assert_clean_deep_scrub(cluster, rados, (REP_POOL,))
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())

@@ -134,6 +134,15 @@ def render_top(doc: dict, sort: str = "ops") -> str:
                 f"{p['pool']:<6} {_fmt_rate(p['ops'])} "
                 f"{p['ops_total']:>10}"
             )
+    rec = doc.get("recovery") or {}
+    if rec.get("degraded_objects"):
+        lines.append("")
+        lines.append(
+            f"RECOVERY: {rec['degraded_objects']} object copies "
+            f"degraded, healing at {rec.get('rate', 0):g} obj/s"
+        )
+        for d in rec.get("detail", []):
+            lines.append(f"  {d}")
     if doc.get("slo"):
         lines.append("")
         lines.append("SLO (worst margins first):")

@@ -83,6 +83,14 @@ def parse_args():
                          "the single-process bench (MemDB), kstore-file "
                          "adds a per-txn fsync'd WAL")
     ap.add_argument("--run-dir", default=None)
+    ap.add_argument("--recovery", action="store_true",
+                    help="recovery engine A/B: healed objects/s batched "
+                         "vs one-at-a-time, client p99 during the storm")
+    ap.add_argument("--recovery-objects", type=int, default=400)
+    ap.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                    help="run the seeded chaos scenario against a live "
+                         "cluster (tools/chaos_tool.py) and report its "
+                         "oracle verdict")
     # internal: this invocation is one client worker of a multiprocess run
     ap.add_argument("--client-worker", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--worker-id", type=int, default=0,
@@ -332,6 +340,150 @@ async def main(args) -> dict:
     return result
 
 
+async def _recovery_leg(batch_max: int, n_objects: int) -> dict:
+    """One recovery measurement: amnesiac-kill an OSD, revive it, time
+    the heal with `osd_recovery_batch_max` pinned to `batch_max`, with a
+    client read loop running throughout (p99 under the storm).  A small
+    per-frame wire delay toward the reborn member makes the per-object
+    round-trip cost explicit: the serial engine pays it once per object,
+    the batched engine once per frame."""
+    from ceph_tpu.rados.client import Rados
+    from tools.chaos_tool import (
+        REP_POOL,
+        LiveCluster,
+        backfill_source,
+        chaos_config,
+        wait_until,
+    )
+
+    cfg = chaos_config()
+    cfg.set("osd_recovery_batch_max", batch_max)
+    cluster = LiveCluster(cfg)
+    await cluster.start()
+    rados = Rados("client.rbench", cluster.monmap, config=cfg)
+    await rados.connect()
+    await cluster.create_pools(rados)
+    io = rados.io_ctx(REP_POOL)
+    for i in range(n_objects):
+        await io.write_full(f"r{i:04}", bytes([i % 251]) * 2048)
+
+    victim = 0
+    await cluster.kill_osd(victim)  # db dropped: amnesiac revival
+    await wait_until(
+        lambda: all(
+            o.osdmap.is_down(victim) for o in cluster.osds.values()
+        ),
+        timeout=30,
+    )
+    for i in range(n_objects, n_objects + 16):
+        await io.write_full(f"r{i:04}", bytes([i % 251]) * 2048)
+    cfg.set("ms_inject_chaos_seed", 1)
+    cfg.set(
+        "ms_inject_chaos_schedule",
+        f"delay:osd.*>osd.{victim}:1:0.05",
+    )
+    reborn = await cluster.start_osd(victim)
+    loop = asyncio.get_event_loop()
+
+    lat: list[float] = []
+    stop = asyncio.Event()
+
+    async def client_loop():
+        i = 0
+        while not stop.is_set():
+            s = loop.time()
+            await io.read(f"r{i % n_objects:04}")
+            lat.append(loop.time() - s)
+            i += 1
+
+    reader = asyncio.ensure_future(client_loop())
+
+    # heal target: every object whose PG the victim serves under the
+    # settled map must land back on it (amnesiac -> full repopulation)
+    await wait_until(
+        lambda: all(
+            not o.osdmap.is_down(victim)
+            for o in cluster.osds.values()
+        ),
+        timeout=60,
+    )
+    survivor = cluster.osds[(victim + 1) % (max(cluster.osds) + 1)]
+    expected = sum(
+        1 for i in range(n_objects + 16)
+        if victim in survivor.acting_of(
+            REP_POOL,
+            survivor.object_pg(REP_POOL, f"r{i:04}"),
+        )[0]
+    )
+
+    def healed_count() -> int:
+        n = 0
+        for coll in reborn.store.list_collections():
+            n += len([
+                o for o in reborn.store.list_objects(coll)
+                if not o.startswith(".")
+            ])
+        return n
+
+    def healed() -> bool:
+        return healed_count() >= expected and (
+            backfill_source(cluster) is None
+        )
+
+    # clock the push phase itself: start at the first landed object so
+    # peering/up-mark latency (identical in both legs) cancels out
+    await wait_until(lambda: healed_count() > 0, timeout=60)
+    base = healed_count()
+    t0 = loop.time()
+    await wait_until(healed, timeout=300)
+    heal_seconds = max(1e-9, loop.time() - t0)
+    healed_objects = healed_count() - base
+    stop.set()
+    await reader
+    cfg.set("ms_inject_chaos_schedule", "")
+    p99 = sorted(lat)[int(len(lat) * 0.99)] if lat else 0.0
+    await rados.shutdown()
+    await cluster.stop()
+    return {
+        "batch_max": batch_max,
+        "healed_objects": healed_objects,
+        "heal_seconds": round(heal_seconds, 3),
+        "healed_obj_per_s": round(healed_objects / heal_seconds, 2),
+        "client_ops": len(lat),
+        "client_p99_s": round(p99, 4),
+    }
+
+
+async def main_recovery(args) -> dict:
+    """A/B: one-object-at-a-time (batch_max=1) vs the batched engine."""
+    from ceph_tpu.common.config import Config
+
+    serial = await _recovery_leg(1, args.recovery_objects)
+    batch = int(Config().get("osd_recovery_batch_max"))
+    batched = await _recovery_leg(batch, args.recovery_objects)
+    return {
+        "mode": "recovery",
+        "objects": args.recovery_objects,
+        "serial": serial,
+        "batched": batched,
+        "speedup": round(
+            batched["healed_obj_per_s"]
+            / max(1e-9, serial["healed_obj_per_s"]), 2,
+        ),
+    }
+
+
+async def main_chaos(args) -> dict:
+    from tools.chaos_tool import run_chaos_live
+
+    report = await run_chaos_live(
+        args.chaos, steps=8, step_seconds=1.5,
+        progress=lambda *_: None,
+    )
+    report["mode"] = "chaos"
+    return report
+
+
 async def client_worker(args) -> dict:
     """One client process of a multiprocess run: write then read its own
     object range against the already-created pool, report wall windows."""
@@ -518,6 +670,10 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", plat)
     if args.client_worker:
         result = asyncio.run(asyncio.wait_for(client_worker(args), 600))
+    elif args.chaos is not None:
+        result = asyncio.run(asyncio.wait_for(main_chaos(args), 900))
+    elif args.recovery:
+        result = asyncio.run(asyncio.wait_for(main_recovery(args), 900))
     elif args.multiprocess:
         result = asyncio.run(asyncio.wait_for(main_multiprocess(args), 900))
     else:
