@@ -249,6 +249,48 @@ def _trace_overhead_line() -> None:
         pass
 
 
+def _trace_tail_line() -> None:
+    """Optional JSON line: daemon_bench throughput with the tracer
+    DISABLED vs enabled at sample_rate=0 — the always-on flight
+    recorder's hot-path cost. At rate 0 every op still records spans
+    into the bounded flight ring (tail keep/drop at completion) but
+    exports nothing and, with no slow/error ops in a clean bench,
+    promotes nothing; the enabled/disabled delta is therefore exactly
+    the flight-ring overhead the tail-sampling design budgets at <2%.
+    Guarded (--trace-tail / CEPH_TPU_BENCH_TRACE_TAIL=1), non-fatal."""
+    try:
+        import subprocess
+
+        def run_bench(tracer_on: bool) -> float:
+            env = dict(os.environ)
+            env["CEPH_TPU_TRACER_ENABLED"] = (
+                "true" if tracer_on else "false"
+            )
+            env["CEPH_TPU_TRACER_SAMPLE_RATE"] = "0.0"
+            out = subprocess.run(
+                [sys.executable, "tools/daemon_bench.py", "--cpu",
+                 "--osds", "6", "--size", "65536", "--objects", "48",
+                 "--concurrency", "12"],
+                capture_output=True, timeout=600, env=env, check=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            return float(json.loads(out.stdout)["write_gbps"])
+
+        disabled = run_bench(False)
+        flight = run_bench(True)
+        overhead = 100 * (disabled - flight) / disabled
+        print(json.dumps({
+            "metric": "flight_ring_overhead",
+            "value": round(overhead, 2),
+            "unit": "%",
+            "disabled_gbps": round(disabled, 3),
+            "flight_gbps": round(flight, 3),
+            "within_budget": bool(overhead < 2.0),
+        }))
+    except Exception:  # noqa: BLE001 - strictly best-effort
+        pass
+
+
 def _wire_line() -> None:
     """Optional JSON line: daemon-path throughput with the wire fast
     path on (binary MESSAGE_SEG envelopes + corked BATCH frames +
@@ -781,6 +823,10 @@ def main() -> None:
         "CEPH_TPU_BENCH_TRACE"
     ):
         _trace_overhead_line()
+    if "--trace-tail" in sys.argv[1:] or os.environ.get(
+        "CEPH_TPU_BENCH_TRACE_TAIL"
+    ):
+        _trace_tail_line()
     if "--fault-overhead" in sys.argv[1:] or os.environ.get(
         "CEPH_TPU_BENCH_FAULT"
     ):

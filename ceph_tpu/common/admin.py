@@ -17,6 +17,7 @@ Two small pieces of the reference's observability plumbing:
 
 from __future__ import annotations
 
+import heapq
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -83,6 +84,12 @@ class OpTracker:
         self.on_slow = on_slow
         self._in_flight: dict[int, TrackedOp] = {}
         self._history: deque[TrackedOp] = deque(maxlen=history_size)
+        #: top-K finished ops by duration (min-heap of (duration, id, op)).
+        #: A separate view from the recency ring: a burst of fast ops
+        #: evicts a slow one from _history within seconds, but the slow
+        #: op is exactly the one worth keeping for diagnosis
+        #: (dump_historic_ops_by_duration in the reference).
+        self._slowest: list[tuple[float, int, TrackedOp]] = []
         self._next_id = 0
 
     def create(self, description: str, span=None) -> tuple[int, TrackedOp]:
@@ -98,6 +105,11 @@ class OpTracker:
         if op is not None:
             op.done = time.time()
             self._history.append(op)
+            entry = (op.duration, op.id, op)
+            if len(self._slowest) < self.history_size:
+                heapq.heappush(self._slowest, entry)
+            elif entry[0] > self._slowest[0][0]:
+                heapq.heapreplace(self._slowest, entry)
 
     @property
     def num_in_flight(self) -> int:
@@ -138,6 +150,12 @@ class OpTracker:
         return {
             "num_ops": len(self._history),
             "ops": [op.dump() for op in self._history],
+            "slowest": [
+                op.dump()
+                for _, _, op in sorted(
+                    self._slowest, key=lambda e: e[0], reverse=True
+                )
+            ],
         }
 
 

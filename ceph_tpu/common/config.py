@@ -307,6 +307,19 @@ SCHEMA: dict[str, Option] = {
         _opt("mgr_recovery_slow_warn", TYPE_FLOAT, LEVEL_ADVANCED, 1.0,
              "objects/s below which the mgr raises RECOVERY_SLOW while "
              "any OSD reports degraded objects; 0 disables the check"),
+        _opt("mgr_trace_store_max", TYPE_UINT, LEVEL_ADVANCED, 256,
+             "promoted traces the mgr trace collector retains "
+             "(oldest-first eviction; `ceph trace ls|show` serves from "
+             "this bounded store)", min=1),
+        _opt("mgr_trace_ttl", TYPE_FLOAT, LEVEL_ADVANCED, 600.0,
+             "seconds a promoted trace stays in the mgr collector "
+             "before aging out; 0 keeps traces until evicted by "
+             "mgr_trace_store_max", min=0.0),
+        _opt("mgr_prometheus_exemplars", TYPE_BOOL, LEVEL_ADVANCED,
+             False,
+             "attach OpenMetrics exemplars ({trace_id=...}) to latency "
+             "histogram buckets and serve /metrics as "
+             "application/openmetrics-text"),
         _opt("mds_beacon_interval", TYPE_FLOAT, LEVEL_ADVANCED, 0.5,
              "seconds between MDS beacons to the mon"),
         _opt("mds_max_active", TYPE_UINT, LEVEL_BASIC, 1,
@@ -369,6 +382,39 @@ SCHEMA: dict[str, Option] = {
              "append finished spans as Jaeger-compatible JSONL here "
              "(tools/trace_tool.py renders trace trees from it); empty "
              "disables export"),
+        # tail sampling (the flight recorder: Canopy-style keep/drop at
+        # op COMPLETION instead of at op start; see common/tracer.py)
+        _opt("tracer_flight_ring_size", TYPE_UINT, LEVEL_ADVANCED, 2048,
+             "completed spans retained in the always-on flight ring "
+             "(recorded for EVERY op regardless of sample rate; the "
+             "tail keep/drop decision and the crash black-box read "
+             "from here)", min=1,
+             see_also=("tracer_tail_slow_ms",)),
+        _opt("tracer_tail_slow_ms", TYPE_FLOAT, LEVEL_ADVANCED, 1000.0,
+             "promote a trace at completion when its root span took "
+             "longer than this many milliseconds; 0 disables the "
+             "slow-duration predicate", min=0.0),
+        _opt("tracer_tail_top_n", TYPE_UINT, LEVEL_ADVANCED, 0,
+             "also promote the N slowest root spans per "
+             "tracer_tail_window_s window even when none crossed "
+             "tracer_tail_slow_ms; 0 disables slowest-N promotion"),
+        _opt("tracer_tail_window_s", TYPE_FLOAT, LEVEL_ADVANCED, 10.0,
+             "window length for slowest-N and SLO-capture promotion "
+             "budgets", min=0.1),
+        _opt("tracer_tail_errors", TYPE_BOOL, LEVEL_ADVANCED, True,
+             "promote every trace whose root span carries an error, "
+             "retry, or redirect tag (EIO/resend/abort: the ops an "
+             "operator always wants the trace for)"),
+        _opt("tracer_tail_capture_per_window", TYPE_UINT,
+             LEVEL_ADVANCED, 2,
+             "per-predicate promotion budget per window for mgr-pushed "
+             "SLO capture predicates (bounds trace volume while a rule "
+             "is in violation)", min=1),
+        _opt("tracer_crash_dump_dir", TYPE_STR, LEVEL_ADVANCED, "",
+             "directory for the crash black-box: on StoreFatalError/"
+             "fence the daemon dumps its flight ring, in-flight ops and "
+             "recent log lines to <dir>/<daemon>.blackbox.json and "
+             "clogs the pointer; empty disables the dump"),
         _opt("slow_op_seconds", TYPE_FLOAT, LEVEL_ADVANCED, 30.0,
              "in-flight op age that triggers an immediate `slow "
              "request` warning line (osd_op_complaint_time role)",
@@ -400,6 +446,13 @@ SCHEMA: dict[str, Option] = {
              "in parallel (no primary gather, no decode launch); any "
              "shard error, stale shard, or degraded interval falls back "
              "to the primary decode path",
+             see_also=("rados_read_policy",)),
+        _opt("rados_backfill_hint_ttl", TYPE_FLOAT, LEVEL_ADVANCED, 10.0,
+             "seconds the objecter trusts a redirect reply's backfill "
+             "hint and steers balanced reads past the named backfill "
+             "targets; after expiry the next read probes the target "
+             "again (one redirect round-trip) to learn whether the "
+             "backfill drained", min=0.0,
              see_also=("rados_read_policy",)),
         # checkpoint store (ceph_tpu.ckpt: Orbax/TensorStore-style
         # manifest + chunk layout over RADOS)

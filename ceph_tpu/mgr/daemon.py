@@ -28,6 +28,7 @@ import asyncio
 from ceph_tpu.common.config import Config
 from ceph_tpu.common.log import LogRegistry
 from ceph_tpu.mgr.metrics import MetricsModule
+from ceph_tpu.mgr.traces import TraceCollector
 from ceph_tpu.msg.frames import Message, payload_of
 from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.rados.client import Objecter
@@ -49,7 +50,25 @@ class _ReportDispatcher(Dispatcher):
                     d(f"{self.mgr.name} standby: dropping report "
                       f"from {conn.peer_name}")
                 return
-            self.mgr.metrics.ingest(payload_of(msg))
+            report = payload_of(msg)
+            self.mgr.metrics.ingest(report)
+            self.mgr.traces.ingest(
+                report.get("daemon") or conn.peer_name,
+                report.get("traces") or [],
+            )
+            # close the capture loop: a daemon reporting a stale
+            # predicate version gets the current set pushed back on the
+            # same connection (MgrClient's config-push shape) — no
+            # separate subscription channel
+            ver = report.get("capture_ver")
+            if ver is not None and int(ver) != self.mgr.traces.predicate_version:
+                conn.send_message(Message(
+                    type="mgr_capture",
+                    payload={
+                        "ver": self.mgr.traces.predicate_version,
+                        "predicates": self.mgr.traces.predicates,
+                    },
+                ))
             return
         if msg.type == "mgr_command":
             p = payload_of(msg)
@@ -59,8 +78,13 @@ class _ReportDispatcher(Dispatcher):
                 cmd = p.get("cmd")
                 if cmd == "top":
                     result = self.mgr.metrics.top_document()
+                    result["traces"] = self.mgr.traces.recent()
                 elif cmd == "slo":
                     result = self.mgr.metrics.slo_document()
+                elif cmd == "trace ls":
+                    result = self.mgr.traces.ls_document()
+                elif cmd == "trace show":
+                    result = self.mgr.traces.show(p.get("trace_id") or "")
                 else:
                     raise RuntimeError(f"unknown mgr command {cmd!r}")
                 reply = {"ok": True, "result": result}
@@ -91,6 +115,9 @@ class MgrService:
         #: the push-report store + SLO engine; exists while standby too
         #: (so early reports are dropped deliberately, not AttributeError)
         self.metrics = MetricsModule(self.config, logger=self.dlog)
+        #: the flight-recorder backend: promoted traces + capture
+        #: predicates (same standby-safe lifetime as the metrics store)
+        self.traces = TraceCollector(self.config, logger=self.dlog)
         #: our own endpoint: daemons push mgr_report frames here; the
         #: address is advertised through the beacon -> MgrMap
         self.msgr = Messenger(name, config=self.config, keyring=keyring)
@@ -153,6 +180,7 @@ class MgrService:
         # active stint (or stray pre-promotion report) left behind must
         # not mix with the fresh full reports daemons send a new active
         self.metrics.reset()
+        self.traces.reset()
         balancer = BalancerModule(
             self.objecter.mon,
             tracer=getattr(self.objecter, "tracer", None),
@@ -168,7 +196,7 @@ class MgrService:
             "metrics": self.metrics,
             "prometheus": PrometheusExporter(
                 self.objecter, local_perf=self.perf_collection,
-                metrics=self.metrics,
+                metrics=self.metrics, config=self.config,
             ),
             "dashboard": DashboardModule(self.objecter),
         }
@@ -184,6 +212,11 @@ class MgrService:
             if not self.active:
                 continue
             self.metrics.prune()
+            self.traces.prune()
+            # refresh the capture-predicate set from the current SLO
+            # verdicts; daemons pick the new version up when their next
+            # report's capture_ver compares stale
+            self.traces.capture_predicates(self.metrics.evaluate_slos())
             checks = self.metrics.health_checks()
             try:
                 await self.objecter.mon.command(

@@ -116,7 +116,17 @@ class DashboardServer:
                 return 200, "application/json", json.dumps(doc).encode()
             if target.startswith("/metrics"):
                 text = await self.mgr.prometheus_scrape()
-                return 200, "text/plain; version=0.0.4", text.encode()
+                # exemplar syntax only exists in OpenMetrics; the
+                # Content-Type switches with the knob so 0.0.4-only
+                # scrapers are never handed lines they can't parse
+                exporter = self.mgr.modules.get("prometheus")
+                if exporter is not None and getattr(
+                    exporter, "exemplars_enabled", False
+                ):
+                    ctype = "application/openmetrics-text; version=1.0.0"
+                else:
+                    ctype = "text/plain; version=0.0.4"
+                return 200, ctype, text.encode()
         except Exception as e:  # surface collection errors as 500s
             return 500, "text/plain", str(e).encode()
         return 404, "text/plain", b"not found"

@@ -136,6 +136,10 @@ class _DaemonSeries:
     rings: dict[tuple[str, str], deque] = field(default_factory=dict)
     #: last status section verbatim (queue depth, in-flight, pool ops)
     status: dict[str, Any] = field(default_factory=dict)
+    #: latest promoted-trace exemplar per latency histogram key
+    #: ({trace_id, value, ts}) — rides the Prometheus histograms as
+    #: OpenMetrics exemplars when mgr_prometheus_exemplars is on
+    exemplars: dict[str, dict[str, Any]] = field(default_factory=dict)
 
 
 class MetricsModule:
@@ -196,6 +200,9 @@ class MetricsModule:
                 prev = blk.get(key)
                 blk[key] = val
                 self._ring_append(d, block, key, val, prev, now)
+        exemplars = report.get("exemplars")
+        if exemplars:
+            d.exemplars.update(exemplars)
         status = report.get("status")
         if status:
             d.status = status
@@ -558,6 +565,14 @@ class MetricsModule:
         for name, d in self.fresh_daemons(now):
             for block in sorted(d.latest):
                 yield name, block, d.latest[block]
+
+    def exemplar_for(self, daemon: str, key: str) -> dict[str, Any] | None:
+        """The latest promoted-trace exemplar a daemon reported for one
+        latency histogram key, or None (prometheus exemplar lookup)."""
+        d = self.daemons.get(daemon)
+        if d is None:
+            return None
+        return d.exemplars.get(key)
 
     def series_rates(
         self, window: float | None = None, now: float | None = None

@@ -13,6 +13,14 @@ sample pairs, HISTOGRAM (log2 bucket counts) as CUMULATIVE
 `<name>_bucket{le="..."}` series plus `_count` — so rate() and
 histogram_quantile() work on them, instead of flat gauges that lose the
 distribution.
+
+With ``mgr_prometheus_exemplars`` on, latency histograms additionally
+carry OpenMetrics exemplars: the bucket covering a tail-promoted
+trace's duration gets a ``# {trace_id="..."} <value> <ts>`` suffix, so
+a dashboard p99 spike links straight to ``ceph trace show <id>``. The
+dashboard advertises ``application/openmetrics-text`` for /metrics
+when the knob is on (exemplar syntax is OpenMetrics, not the 0.0.4
+text format).
 """
 
 from __future__ import annotations
@@ -24,14 +32,19 @@ def _sanitize(name: str) -> str:
     return "".join(c if c.isalnum() else "_" for c in name)
 
 
-def render_perf_value(emit, key: str, value, labels: dict) -> None:
+def render_perf_value(emit, key: str, value, labels: dict,
+                      exemplar: dict | None = None) -> None:
     """Render one perf-dump counter as Prometheus samples via
-    `emit(metric_name, value, labels, type, type_name=None)`.
+    `emit(metric_name, value, labels, type, type_name=None,
+    exemplar=None)`.
 
     Plain ints/floats -> one counter sample. TIME_AVG dicts
     ({avgcount, sum}) -> `_sum` + `_count`. HISTOGRAM dicts (power-of-2
     lower bound -> count) -> cumulative `_bucket{le=...}` + `+Inf` +
-    `_count`, the native Prometheus histogram convention."""
+    `_count`, the native Prometheus histogram convention. An exemplar
+    ({trace_id, value, ts}) attaches to the first histogram bucket
+    whose upper edge covers its value (the OpenMetrics rule: an
+    exemplar must fall inside its bucket)."""
     if isinstance(value, dict):
         if "avgcount" in value and "sum" in value:
             emit(f"{key}_sum", value["sum"], labels, "counter")
@@ -42,15 +55,29 @@ def render_perf_value(emit, key: str, value, labels: dict) -> None:
         except (TypeError, ValueError):
             return  # not a perf histogram shape; skip
         total = 0
+        placed = exemplar is None
         for lower, n in bounds:
             total += n
             # bucket holds values in [2^b, 2^(b+1)); le is inclusive,
             # so the upper edge for integer samples is 2^(b+1) - 1
-            emit(f"{key}_bucket", total,
-                 {**labels, "le": str(2 * lower - 1)},
-                 "histogram", type_name=key)
-        emit(f"{key}_bucket", total, {**labels, "le": "+Inf"},
-             "histogram", type_name=key)
+            le = 2 * lower - 1
+            blab = {**labels, "le": str(le)}
+            # the kwarg only appears when there IS an exemplar, so
+            # exemplar-unaware emit callbacks keep working
+            if not placed and exemplar["value"] <= le:
+                placed = True
+                emit(f"{key}_bucket", total, blab, "histogram",
+                     type_name=key, exemplar=exemplar)
+            else:
+                emit(f"{key}_bucket", total, blab, "histogram",
+                     type_name=key)
+        inf_lab = {**labels, "le": "+Inf"}
+        if placed:
+            emit(f"{key}_bucket", total, inf_lab, "histogram",
+                 type_name=key)
+        else:
+            emit(f"{key}_bucket", total, inf_lab, "histogram",
+                 type_name=key, exemplar=exemplar)
         emit(f"{key}_count", total, labels, "histogram",
              type_name=key)
         return
@@ -61,7 +88,8 @@ def render_perf_value(emit, key: str, value, labels: dict) -> None:
 class PrometheusExporter:
     PREFIX = "ceph_tpu"
 
-    def __init__(self, objecter, local_perf=None, metrics=None):
+    def __init__(self, objecter, local_perf=None, metrics=None,
+                 config=None):
         self.objecter = objecter
         #: optional PerfCountersCollection of mgr-LOCAL blocks (balancer
         #: moves/launches/spread): scraped in-process, no admin hop
@@ -71,6 +99,19 @@ class PrometheusExporter:
         #: hop on the scrape path (the reference mgr's DaemonStateIndex
         #: role); without it we fall back to pulling perf dumps
         self.metrics = metrics
+        self.config = config if config is not None else getattr(
+            metrics, "config", None
+        )
+
+    @property
+    def exemplars_enabled(self) -> bool:
+        """OpenMetrics exemplar emission (and the matching /metrics
+        Content-Type switch) — off by default: plain-Prometheus
+        consumers reject exemplar syntax in the 0.0.4 text format."""
+        return bool(
+            self.config is not None
+            and self.config.get("mgr_prometheus_exemplars")
+        )
 
     async def collect(self) -> str:
         osdmap = self.objecter.osdmap
@@ -79,8 +120,11 @@ class PrometheusExporter:
         #: over `lines` was O(n²) across a large perf dump)
         typed: set[str] = set()
 
+        want_exemplars = self.exemplars_enabled
+
         def gauge(name: str, value, labels: dict | None = None,
-                  mtype: str = "gauge", type_name: str | None = None) -> None:
+                  mtype: str = "gauge", type_name: str | None = None,
+                  exemplar: dict | None = None) -> None:
             full = f"{self.PREFIX}_{_sanitize(name)}"
             # TYPE is declared once per metric FAMILY: histogram series
             # (_bucket/_count) share their base name's declaration
@@ -97,7 +141,14 @@ class PrometheusExporter:
                     f'{k}="{v}"' for k, v in sorted(labels.items())
                 )
                 lab = "{" + inner + "}"
-            lines.append(f"{full}{lab} {value}")
+            tail = ""
+            if want_exemplars and exemplar is not None:
+                # OpenMetrics exemplar: ` # {labels} value timestamp`
+                tail = (
+                    f' # {{trace_id="{exemplar["trace_id"]}"}}'
+                    f' {exemplar["value"]} {exemplar.get("ts", "")}'
+                ).rstrip()
+            lines.append(f"{full}{lab} {value}{tail}")
 
         # health checks (ceph_health_status convention: 0 OK, 1 WARN,
         # 2 ERR; one labeled gauge per active check with its count)
@@ -136,7 +187,8 @@ class PrometheusExporter:
             for logger, counters in sorted(self.local_perf.dump().items()):
                 for key, value in sorted(counters.items()):
                     render_perf_value(
-                        lambda n, v, lab, t, type_name=None: gauge(
+                        lambda n, v, lab, t, type_name=None,
+                        exemplar=None: gauge(
                             f"mgr_{n}", v, lab, t,
                             type_name=(None if type_name is None
                                        else f"mgr_{type_name}"),
@@ -146,15 +198,23 @@ class PrometheusExporter:
 
         # per-daemon perf counters (TIME_AVG/HISTOGRAM expanded into
         # their native Prometheus representations)
-        def emit_daemon(logger: str, counters: dict) -> None:
+        def emit_daemon(logger: str, counters: dict,
+                        daemon: str | None = None) -> None:
             for key, value in sorted(counters.items()):
+                ex = None
+                if (
+                    want_exemplars and daemon is not None
+                    and self.metrics is not None
+                ):
+                    ex = self.metrics.exemplar_for(daemon, key)
                 render_perf_value(
-                    lambda n, v, lab, t, type_name=None: gauge(
+                    lambda n, v, lab, t, type_name=None, exemplar=None: gauge(
                         f"daemon_{n}", v, lab, t,
                         type_name=(None if type_name is None
                                    else f"daemon_{type_name}"),
+                        exemplar=exemplar,
                     ),
-                    key, value, {"daemon": logger},
+                    key, value, {"daemon": logger}, exemplar=ex,
                 )
 
         served_from_store = False
@@ -162,8 +222,8 @@ class PrometheusExporter:
             blocks = list(self.metrics.latest_blocks())
             if blocks:
                 served_from_store = True
-                for _daemon, block, counters in blocks:
-                    emit_daemon(block, counters)
+                for daemon, block, counters in blocks:
+                    emit_daemon(block, counters, daemon=daemon)
                 # windowed rates the pull model could never render:
                 # first-class per-counter ops/sec series from the ring
                 for block, key, rate in self.metrics.series_rates():

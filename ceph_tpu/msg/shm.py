@@ -351,18 +351,25 @@ class ShmStream(InjectingStream):
         self, frames: list, session_key: bytes | None, coalesced: int = 1
     ) -> None:
         await self._maybe_inject()
+        # the chaos schedule judges shm runs too: a co-located pair is
+        # still a (src, dst) fault stream (delays stall the producer,
+        # drops sever the session, dups re-write the same records —
+        # same seqs, absorbed by the receiver's dedup)
+        chaos = await self._chaos_action()
         limit = self._tx.max_record
         total = 0
-        for f in frames:
-            parts = f.encode_parts(session_key)
-            n = sum(len(p) for p in parts)
-            total += n
-            if n <= limit:
-                await self._write_avail(
-                    lambda: self._tx.try_write_parts(parts, n)
-                )
-            else:
-                await self._write_frame_bytes(b"".join(parts))
+        for pass_no in range(2 if chaos == "dup" else 1):
+            for f in frames:
+                parts = f.encode_parts(session_key)
+                n = sum(len(p) for p in parts)
+                if pass_no == 0:
+                    total += n
+                if n <= limit:
+                    await self._write_avail(
+                        lambda: self._tx.try_write_parts(parts, n)
+                    )
+                else:
+                    await self._write_frame_bytes(b"".join(parts))
         m = self._m
         m.bytes_sent += total
         perf = m.perf

@@ -880,6 +880,13 @@ class OSDService(Dispatcher):
             "ERR",
             f"osd.{self.id}: store fenced ({reason}); fail-stop",
         )
+        box = self._write_black_box(reason)
+        if box is not None:
+            # the pointer rides the cluster log so an operator reading
+            # `ceph log last` knows exactly where the causal history is
+            self._cluster_log(
+                "ERR", f"osd.{self.id}: black box: {box}"
+            )
         try:
             self.mon.report_failure(self.id)
         # cephlint: disable=error-taxonomy (one-way death report: peers will report us anyway)
@@ -889,6 +896,37 @@ class OSDService(Dispatcher):
         # messenger dies with the rest of the daemon
         await asyncio.sleep(0.05)
         await self.stop()
+
+    def _write_black_box(self, reason: str) -> str | None:
+        """Crash black-box: on a fatal store error, persist the flight
+        ring (recent span history regardless of sampling), the op
+        tracker state, and the recent in-memory log lines to a file so
+        the causal history of the crash survives the daemon. Best
+        effort by design — the daemon is dying and must not hang on a
+        diagnostic write."""
+        dump_dir = self.config.get("tracer_crash_dump_dir")
+        if not dump_dir:
+            return None
+        try:
+            import os
+
+            os.makedirs(dump_dir, exist_ok=True)
+            path = os.path.join(dump_dir, f"osd.{self.id}.blackbox.json")
+            box = {
+                "daemon": f"osd.{self.id}",
+                "reason": reason,
+                "time": time.time(),
+                "flight_spans": self.tracer.flight_snapshot(),
+                "ops_in_flight": self.op_tracker.dump_ops_in_flight(),
+                "historic_ops": self.op_tracker.dump_historic_ops(),
+                "recent_log": self.logs.dump_recent(),
+            }
+            with open(path, "w") as fh:
+                json.dump(box, fh, indent=1)
+            return path
+        # cephlint: disable=error-taxonomy (diagnostic write on the death path)
+        except Exception:  # noqa: BLE001 - never let diagnostics block death
+            return None
 
     # -- placement helpers ----------------------------------------------------
 
@@ -1587,7 +1625,19 @@ class OSDService(Dispatcher):
                         str(pid): n for pid, n in self._pool_ops.items()
                     },
                 },
+                # tail-sampling surface: promoted traces for the mgr
+                # collector, their exemplars for the Prometheus
+                # histograms, and the capture-predicate version we hold
+                # (a stale version makes the mgr push fresh predicates
+                # back down this same connection)
+                "capture_ver": self.tracer.capture_version,
             }
+            promoted = self.tracer.drain_promoted()
+            if promoted:
+                report["traces"] = promoted
+            exemplars = self.tracer.exemplars()
+            if exemplars:
+                report["exemplars"] = exemplars
             try:
                 conn = self.messenger.connect(
                     target[1], Policy.lossy_client()
@@ -3429,9 +3479,13 @@ class OSDService(Dispatcher):
         # task-local current context so every downstream site — sub-op
         # forks, encode batches, journal commits, store reads — parents
         # to it without plumbing
+        # tail=True: the execution span runs its own keep/drop decision
+        # at completion — a server-slow op promotes its trace even when
+        # the client never relays (e.g. the client died mid-op)
         span = self.tracer.join(
             p.get("_trace"), "osd_op",
             tags={"op": p.get("op"), "object": f"{pool_id}/{name}"},
+            tail=True,
         )
         stoken = None if span is None else self.tracer.use(span)
         self._trace(
@@ -5383,6 +5437,12 @@ class OSDService(Dispatcher):
                 result = self.op_tracker.dump_ops_in_flight()
             elif cmd == "dump_historic_ops":
                 result = self.op_tracker.dump_historic_ops()
+                # cross-link: a historic op's full span timeline is still
+                # retrievable while the flight ring holds the trace
+                for o in result.get("slowest", []):
+                    tid = o.get("trace_id")
+                    if tid:
+                        o["in_flight_ring"] = self.tracer.flight_has(tid)
             elif cmd == "injectargs":
                 # runtime config overrides (`ceph tell osd.N injectargs`):
                 # flips the fault knobs, tracer rates, etc. live — the
@@ -5429,8 +5489,32 @@ class OSDService(Dispatcher):
 
     async def _h_trace_report(self, conn, p) -> None:
         """Adopt a client's finished spans (the Jaeger agent->collector
-        hop): one-way, no reply — tracing must never add an RTT."""
+        hop): one-way, no reply — tracing must never add an RTT.
+
+        A `promote` section is the tail-sampling relay: the client kept
+        its completed trace (slow/errored at any sample rate) — adopt
+        its spans into the FLIGHT ring (not the sampled ring: an
+        unsampled trace must stay invisible to dump_tracing) and
+        promote the same trace locally so our own flight spans ride the
+        next mgr report alongside the client's."""
+        promote = p.get("promote")
+        if promote:
+            self.tracer.adopt_flight(p.get("spans") or [])
+            self.tracer.promote(
+                promote.get("trace_id"),
+                reason=promote.get("reason", "relay"),
+                root=promote.get("root"),
+            )
+            return
         self.tracer.adopt(p.get("spans") or [])
+
+    async def _h_mgr_capture(self, conn, p) -> None:
+        """The mgr pushed fresh SLO capture predicates down the report
+        channel (the metrics->traces loop): while a rule is violated,
+        matching ops promote their traces at completion."""
+        self.tracer.set_capture_predicates(
+            p.get("predicates") or [], p.get("ver") or 0
+        )
 
     async def _scrub_fetch(self, pg, sname: str, osd: int,
                            verify: bool = False):

@@ -437,7 +437,9 @@ class Objecter(Dispatcher):
         finally:
             if span is not None:
                 span.finish()
-                self._report_trace(span.trace_id)
+                if span.sampled:
+                    self._report_trace(span.trace_id)
+                self._relay_promotion(span)
 
     def _report_trace(self, trace_id: str) -> None:
         """Ship this client's finished spans of one trace to the primary
@@ -465,6 +467,28 @@ class Objecter(Dispatcher):
                     data=json.dumps({"spans": spans}).encode(),
                 )
             )
+
+    def _relay_promotion(self, span) -> None:
+        """Tail-sampling relay: when this op's completed trace was
+        promoted locally (slow / errored / capture-matched at any
+        sample rate), ship the keep decision plus our flight spans to
+        the primary we last talked to — the OSD adopts them into ITS
+        flight ring and promotes the same trace onto its mgr report.
+        One one-way message per PROMOTED op only; the unpromoted hot
+        path pays a single dict miss."""
+        promoted = self.tracer.take_promoted(span.trace_id)
+        conn = self._last_conn
+        if promoted is None or conn is None:
+            return
+        spans = promoted.pop("spans", [])
+        conn.send_message(
+            Message(
+                type="trace_report",
+                data=json.dumps(
+                    {"spans": spans, "promote": promoted}
+                ).encode(),
+            )
+        )
 
     #: connection of the most recent op send (trace reporting target)
     _last_conn = None
@@ -571,6 +595,7 @@ class Objecter(Dispatcher):
                 # to the primary path (kill -9 mid-read lands here)
                 if span is not None:
                     span.log(f"resend: osd.{target} silent")
+                    span.set_tag("retried", True)
                 forced_primary = forced_primary or balanced
                 await self._refresh_map()
                 continue
@@ -593,6 +618,7 @@ class Objecter(Dispatcher):
                 # (peering/backfill/stale marker): finish at the primary
                 if span is not None:
                     span.log(f"redirect: osd.{target} -> primary")
+                    span.set_tag("redirected", True)
                 forced_primary = True
                 bf = reply.get("backfill")
                 if bf:
@@ -601,7 +627,9 @@ class Objecter(Dispatcher):
                     # members still serve; one bounce, not one per
                     # size-th read until the backfill drains)
                     self._avoid_cache[(eff_pool, ps)] = (
-                        asyncio.get_event_loop().time() + 10.0,
+                        asyncio.get_event_loop().time()
+                        + float(self.config.get(
+                            "rados_backfill_hint_ttl")),
                         set(bf),
                     )
                 if reply.get("epoch", 0) > self.osdmap.epoch:

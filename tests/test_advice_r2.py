@@ -90,7 +90,15 @@ def test_orphaned_promise_does_not_wedge_proposals():
                         return
                     except RuntimeError:
                         pass  # leadership churned: retry, like reporters do
-                await asyncio.sleep(0.05)
+                # park on the dispatch hook instead of a timed sleep:
+                # re-election rides dispatched messages, so any wakeup
+                # is a reason to re-check for a leader
+                from ceph_tpu.msg.messenger import next_dispatch_event
+
+                try:
+                    await asyncio.wait_for(next_dispatch_event(), 0.25)
+                except asyncio.TimeoutError:
+                    pass
 
         await asyncio.wait_for(try_propose(), 30)
         await wait_until(
@@ -136,7 +144,7 @@ def test_reflected_server_proof_rejected():
         client.dispatcher = cd
         conn = client.connect(server.my_addr, Policy.lossy_client())
         conn.send_message(Message(type="ping", data=b"zz"))
-        await asyncio.sleep(0.5)
+        await wait_until(lambda: conn._closed, timeout=20)
         assert not conn.is_connected, "reflected proof was accepted"
         await client.shutdown()
         await server.shutdown()
@@ -209,7 +217,7 @@ def test_server_must_prove_secret():
         client.dispatcher = cd
         conn = client.connect(server.my_addr, Policy.lossy_client())
         conn.send_message(Message(type="ping", data=b"zz"))
-        await asyncio.sleep(0.5)
+        await wait_until(lambda: conn._closed, timeout=20)
         assert not sd.messages, "client sent payload to unproven server"
         assert not conn.is_connected
         await client.shutdown()

@@ -399,6 +399,11 @@ def test_backfill_hint_spares_redirect_round_trips():
         # (log recovery would drain instantly and close the window)
         cfg = live_config()
         cfg.set("osd_min_pg_log_entries", 20)
+        # the measurement below must not race the hint's expiry: on a
+        # loaded box 80 priming + 40 measured reads can outlast the
+        # default 10 s TTL, and the expiry re-probe is one legitimate
+        # redirect that would fail the flat-counter assertion
+        cfg.set("rados_backfill_hint_ttl", 600.0)
         cluster = Cluster(cfg=cfg)
         await cluster.start()
         rados = Rados("client.hint", cluster.monmap, config=cluster.cfg)

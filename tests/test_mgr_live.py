@@ -107,12 +107,16 @@ def test_mgr_failover_keeps_prometheus_serving():
         await a.start()
         await wait_until(lambda: a.active, timeout=30)
         await b.start()
-        await asyncio.sleep(0.5)
-        assert not b.active
 
-        mm = (await admin.mon_command("mgr map"))["mgrmap"]
+        # b's beacon has registered it once the map lists it as a
+        # standby — from there the mon won't promote it past mgr.x
+        async def standby_map():
+            mm = (await admin.mon_command("mgr map"))["mgrmap"]
+            return mm if mm.get("standbys") == ["mgr.y"] else None
+
+        mm = await wait_async(standby_map, timeout=30)
+        assert not b.active
         assert mm["active"] == "mgr.x"
-        assert mm["standbys"] == ["mgr.y"]
 
         # the active serves metrics; the module tier is daemon-hosted
         text = await a.prometheus_scrape()

@@ -1,0 +1,679 @@
+"""Flight recorder / tail sampling (the Canopy shape over the tracer).
+
+Unit tier: the keep/drop-at-completion predicates (slow threshold,
+error tags, mgr capture predicates with per-window budgets, slowest-N
+window), the promotion outbox + relay dedup, the mgr TraceCollector
+(merge across daemons, bounds, TTL, predicates from violated SLOs),
+OpenMetrics exemplar rendering, and trace_tool's cross-trace
+critical-path contribution report.
+
+Live tier: the acceptance proof — with `tracer_sample_rate=0` a
+chaos-delayed (seeded, deterministic) slow op is captured with
+probability 1, lands in the mgr's trace store, `ceph trace show <id>`
+returns the merged tree, and its id rides the op-latency histogram as
+an OpenMetrics exemplar, while head sampling at the SAME export volume
+misses the slow op; a violated SLO pushes capture predicates down the
+report channel; an injected fsync failure dumps the crash black-box.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.tracer import Tracer
+from ceph_tpu.mgr.traces import TraceCollector
+
+
+def tail_config(**overrides) -> Config:
+    cfg = Config()
+    cfg.set("tracer_enabled", True)
+    cfg.set("tracer_sample_rate", 0.0)  # head sampling OFF
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return cfg
+
+
+def finish_with_duration(tr: Tracer, name: str, ms: float, tags=None):
+    """Start + finish a tail-eligible root whose duration is exactly
+    `ms` (backdated start: no wall-clock sleeps in the unit tier)."""
+    import time
+
+    sp = tr.start(name, tags=tags)
+    assert sp is not None
+    sp.start = time.time() - ms / 1e3
+    sp.finish()
+    return sp
+
+
+# -- tail predicates --------------------------------------------------------
+
+
+def test_slow_op_promotes_with_exemplar():
+    tr = Tracer("osd.0", config=tail_config(tracer_tail_slow_ms=10.0))
+    finish_with_duration(tr, "osd_op", 3.0)   # under threshold
+    sp = finish_with_duration(tr, "osd_op", 50.0)
+    assert tr.dump_tracing()["num_spans"] == 0  # still nothing exported
+    out = tr.drain_promoted()
+    assert len(out) == 1
+    assert out[0]["trace_id"] == sp.trace_id
+    assert out[0]["reason"] == "slow"
+    # the gathered payload carries the flight span itself
+    assert any(s["span_id"] == sp.span_id for s in out[0]["spans"])
+    # and the latency histogram got a drill-down exemplar (µs)
+    ex = tr.exemplars()["lat_us_osd_op"]
+    assert ex["trace_id"] == sp.trace_id
+    assert ex["value"] == pytest.approx(50_000, rel=0.2)
+    assert tr.perf.dump()["tail_promoted"] == 1
+    assert tr.drain_promoted() == []  # outbox drained
+
+
+def test_error_tags_promote_regardless_of_duration():
+    tr = Tracer("c", config=tail_config(tracer_tail_slow_ms=1e9))
+    for tag in ("error", "retried", "redirected", "aborted"):
+        sp = tr.start("op_submit")
+        sp.set_tag(tag, True)
+        sp.finish()
+        (meta,) = tr.drain_promoted()
+        assert meta["reason"] == "error", tag
+        assert meta["trace_id"] == sp.trace_id
+    # the knob turns the error predicate off
+    tr2 = Tracer("c2", config=tail_config(
+        tracer_tail_slow_ms=1e9, tracer_tail_errors=False
+    ))
+    sp = tr2.start("op_submit")
+    sp.set_tag("error", "EIO")
+    sp.finish()
+    assert tr2.drain_promoted() == []
+
+
+def test_capture_predicates_budget_per_window():
+    """An mgr-pushed predicate keeps at most
+    tracer_tail_capture_per_window matching traces per window, and
+    min_ms pre-filters the spend."""
+    tr = Tracer("osd.1", config=tail_config(
+        tracer_tail_slow_ms=1e9, tracer_tail_capture_per_window=2,
+        tracer_tail_window_s=3600.0,
+    ))
+    tr.set_capture_predicates(
+        [{"name": "lat rule", "min_ms": 5.0}], version=3
+    )
+    assert tr.capture_version == 3
+    finish_with_duration(tr, "osd_op", 1.0)  # below min_ms: no spend
+    for _ in range(4):
+        finish_with_duration(tr, "osd_op", 8.0)
+    out = tr.drain_promoted()
+    assert len(out) == 2  # budget, not 4
+    assert all(m["reason"] == "slo:lat rule" for m in out)
+
+
+def test_slowest_n_promotes_on_window_roll():
+    tr = Tracer("osd.2", config=tail_config(
+        tracer_tail_slow_ms=1e9, tracer_tail_top_n=2,
+        tracer_tail_window_s=3600.0,
+    ))
+    sps = [
+        finish_with_duration(tr, "osd_op", ms)
+        for ms in (4.0, 9.0, 1.0, 7.0)
+    ]
+    assert tr.drain_promoted() == []  # window still open: no decision
+    # backdate the window start: the next drain rolls it and flushes
+    # the slowest-2 candidates
+    tr._win_start = 0.0
+    out = tr.drain_promoted()
+    assert {m["trace_id"] for m in out} == {
+        sps[1].trace_id, sps[3].trace_id
+    }
+    assert all(m["reason"] == "slowest_n" for m in out)
+
+
+def test_relay_promote_dedups_and_adopts_foreign_spans():
+    """The OSD side of the client relay: adopt_flight lands foreign
+    spans in the flight ring only, promote() by id ships them and
+    dedups repeats."""
+    tr = Tracer("osd.3", config=tail_config(tracer_tail_slow_ms=1e9))
+    foreign = {
+        "trace_id": "t1", "span_id": "c1", "parent_id": None,
+        "name": "op_submit", "service": "client.x",
+        "start": 1.0, "duration": 0.2, "tags": {}, "events": [],
+    }
+    tr.adopt_flight([foreign])
+    assert tr.dump_tracing()["num_spans"] == 0  # sampled ring untouched
+    assert tr.flight_has("t1")
+    assert tr.promote("t1", reason="relay") is True
+    assert tr.promote("t1", reason="relay") is False  # dedup
+    (meta,) = tr.drain_promoted()
+    assert meta["reason"] == "relay"
+    assert [s["span_id"] for s in meta["spans"]] == ["c1"]
+    # already-shipped ids never re-promote (LRU seen set)
+    assert tr.promote("t1") is False
+
+
+# -- mgr trace collector ----------------------------------------------------
+
+
+def collector(**overrides) -> TraceCollector:
+    cfg = Config()
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return TraceCollector(cfg)
+
+
+def promoted(tid, spans, reason="slow"):
+    return {"trace_id": tid, "reason": reason, "spans": spans}
+
+
+def span(tid, sid, parent=None, start=0.0, dur=0.01, name="osd_op"):
+    return {
+        "trace_id": tid, "span_id": sid, "parent_id": parent,
+        "name": name, "service": "osd.0", "start": start,
+        "duration": dur, "tags": {}, "events": [],
+    }
+
+
+def test_collector_merges_fragments_across_daemons():
+    tc = collector()
+    root = span("t1", "a", start=1.0, dur=0.5, name="op_submit")
+    child = span("t1", "b", parent="a", start=1.1, dur=0.3)
+    tc.ingest("osd.0", [promoted("t1", [root, child])], now=100.0)
+    # the client relay arrives a tick later via another daemon, with an
+    # overlapping span set: merged by span_id, not duplicated
+    tc.ingest("osd.1", [promoted("t1", [root])], now=101.0)
+    doc = tc.show("t1")
+    assert doc["num_spans"] == 2
+    assert doc["daemons"] == ["osd.0", "osd.1"]
+    assert doc["root"] == "op_submit"
+    assert doc["duration_ms"] == pytest.approx(500.0)
+    assert [s["span_id"] for s in doc["spans"]] == ["a", "b"]
+    ls = tc.ls_document()
+    assert ls["num_traces"] == 1
+    assert ls["traces"][0]["trace_id"] == "t1"
+    with pytest.raises(KeyError):
+        tc.show("nope")
+
+
+def test_collector_bounds_and_ttl():
+    tc = collector(mgr_trace_store_max=3, mgr_trace_ttl=60.0)
+    for i in range(5):
+        tc.ingest("osd.0", [promoted(f"t{i}", [span(f"t{i}", "s")])],
+                  now=float(i))
+    assert len(tc) == 3  # oldest evicted
+    assert tc.ls_document()["traces"][0]["trace_id"] == "t4"
+    tc.prune(now=62.5)  # t2 (last_seen 2.0) aged out, t3/t4 survive
+    assert len(tc) == 2
+    tc.prune(now=1000.0)
+    assert len(tc) == 0
+
+
+def test_capture_predicates_from_violated_slos():
+    tc = collector()
+    ok = {"rule": "op_w.rate > 1", "ok": True, "op": ">", "threshold": 1}
+    # native-µs histogram rule: threshold converts µs -> ms
+    hist = {"rule": "lat_us_osd_op.p99 < 5000", "ok": False,
+            "op": "<", "threshold": 5000.0}
+    # unit-suffixed rule: parser scaled the threshold to seconds
+    lat = {"rule": "op_latency.avg < 5ms @ 30", "ok": False,
+           "op": "<", "threshold": 0.005}
+    # ratio rule: not a latency, capture unfiltered
+    ratio = {"rule": "read_redirected/read_balanced < 0.05",
+             "ok": False, "op": "<", "threshold": 0.05}
+    ver, preds = tc.capture_predicates([ok, hist, lat, ratio])
+    assert ver == 1
+    by_name = {p["name"]: p["min_ms"] for p in preds}
+    assert by_name == {
+        "lat_us_osd_op.p99 < 5000": pytest.approx(5.0),
+        "op_latency.avg < 5ms @ 30": pytest.approx(5.0),
+        "read_redirected/read_balanced < 0.05": 0.0,
+    }
+    # unchanged verdicts do NOT bump the version (no re-push storm)
+    ver2, _ = tc.capture_predicates([ok, hist, lat, ratio])
+    assert ver2 == 1
+    # all healthy -> empty set, new version
+    ver3, preds3 = tc.capture_predicates([ok])
+    assert ver3 == 2 and preds3 == []
+
+
+# -- exemplar rendering -----------------------------------------------------
+
+
+def test_exemplar_attaches_to_covering_bucket():
+    from ceph_tpu.mgr.prometheus import render_perf_value
+
+    out = []
+
+    def emit(name, v, labels, mtype, type_name=None, exemplar=None):
+        out.append((name, labels.get("le"), exemplar))
+
+    ex = {"trace_id": "abc", "value": 6, "ts": 12.0}
+    render_perf_value(
+        emit, "lat_us_osd_op", {"1": 2, "4": 3, "1024": 1},
+        {"daemon": "osd.0"}, exemplar=ex,
+    )
+    # buckets le=1,7,2047,+Inf: value 6 belongs to le=7 — and ONLY there
+    tagged = [(le, e) for _n, le, e in out if e is not None]
+    assert tagged == [("7", ex)]
+    # a value beyond every finite bucket rides +Inf
+    out.clear()
+    render_perf_value(
+        emit, "lat_us_osd_op", {"1": 2},
+        {"daemon": "osd.0"},
+        exemplar={"trace_id": "big", "value": 999, "ts": 1.0},
+    )
+    assert [(le) for _n, le, e in out if e is not None] == ["+Inf"]
+
+
+def test_exporter_renders_openmetrics_exemplar_line():
+    """End-to-end text shape: with the knob on, the store-served scrape
+    suffixes the covering bucket with `# {trace_id="..."} v ts`."""
+    from ceph_tpu.mgr.metrics import MetricsModule
+    from ceph_tpu.mgr.prometheus import PrometheusExporter
+
+    cfg = Config()
+    cfg.set("mgr_prometheus_exemplars", True)
+    metrics = MetricsModule(cfg)
+    metrics.ingest({
+        "daemon": "osd.0", "seq": 1,
+        "counters": {"tracer": {"lat_us_osd_op": {"4": 3}}},
+        "exemplars": {
+            "lat_us_osd_op": {"trace_id": "feed", "value": 6, "ts": 5.0}
+        },
+    })
+
+    class _Map:
+        epoch, max_osd, pools = 1, 0, {}
+
+        @staticmethod
+        def is_down(_o):
+            return False
+
+    class _Mon:
+        async def command(self, *a, **k):
+            raise RuntimeError("no mon in this unit test")
+
+    class _Objecter:
+        osdmap, mon = _Map(), _Mon()
+
+    exp = PrometheusExporter(_Objecter(), metrics=metrics, config=cfg)
+    assert exp.exemplars_enabled
+    text = asyncio.run(exp.collect())
+    line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith("ceph_tpu_daemon_lat_us_osd_op_bucket")
+        and 'le="7"' in ln
+    )
+    assert '# {trace_id="feed"} 6 5.0' in line
+    # knob off: same store, no exemplar syntax anywhere
+    cfg.set("mgr_prometheus_exemplars", False)
+    text2 = asyncio.run(exp.collect())
+    assert "trace_id=" not in text2
+
+
+# -- trace_tool critical report --------------------------------------------
+
+
+def test_critical_report_aggregates_stage_contributions():
+    from tools.trace_tool import critical_report, path_contributions
+
+    def trace(tid, root_ms, child_ms):
+        return [
+            span(tid, "r", start=0.0, dur=root_ms / 1e3,
+                 name="op_submit"),
+            span(tid, "c", parent="r", start=0.001,
+                 dur=child_ms / 1e3, name="journal_commit"),
+        ]
+
+    t1, t2 = trace("t1", 10.0, 8.0), trace("t2", 20.0, 5.0)
+    # self-time: root contributes duration minus its on-path child
+    contrib = dict(path_contributions(t1))
+    assert contrib["osd.0: op_submit"] == pytest.approx(0.002)
+    assert contrib["osd.0: journal_commit"] == pytest.approx(0.008)
+    text = critical_report({"t1": t1, "t2": t2})
+    assert "critical-path contribution over 2 trace(s)" in text
+    assert "osd.0: op_submit" in text
+    assert "osd.0: journal_commit" in text
+    assert "P99" in text and "SHARE" in text
+
+
+# -- slowest-by-duration historic view --------------------------------------
+
+
+def test_op_tracker_keeps_slowest_by_duration():
+    """A burst of fast ops evicts a slow one from the recency ring;
+    the slowest view still holds it."""
+    import time
+
+    from ceph_tpu.common.admin import OpTracker
+
+    tracker = OpTracker(history_size=4)
+    op_id, op = tracker.create("the slow one")
+    op.start = time.time() - 9.0  # backdate: duration ~9s
+    tracker.finish(op_id)
+    for i in range(10):  # fast churn evicts it from _history
+        oid, _ = tracker.create(f"fast-{i}")
+        tracker.finish(oid)
+    dump = tracker.dump_historic_ops()
+    assert all(
+        o["description"] != "the slow one" for o in dump["ops"]
+    )
+    assert dump["slowest"][0]["description"] == "the slow one"
+    assert dump["slowest"][0]["age"] > 5.0
+    # sorted slowest-first, bounded by history_size
+    ages = [o["age"] for o in dump["slowest"]]
+    assert ages == sorted(ages, reverse=True)
+    assert len(dump["slowest"]) <= 4
+
+
+# -- live tier --------------------------------------------------------------
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 300))
+
+
+def tail_cluster_cfg(**overrides):
+    from tests.test_cluster_live import live_config
+
+    cfg = live_config()
+    cfg.set("tracer_enabled", True)
+    cfg.set("tracer_sample_rate", 0.0)   # head sampling fully off
+    cfg.set("tracer_tail_slow_ms", 60.0)
+    cfg.set("mgr_report_interval", 0.25)
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return cfg
+
+
+@pytest.mark.slow
+def test_live_tail_capture_beats_head_sampling(tmp_path):
+    """The acceptance path end to end: sample rate 0, a seeded chaos
+    delay makes exactly one window of ops slow — the tail sampler
+    captures the slow trace with probability 1 (it is a deterministic
+    keep decision at completion), the mgr serves it via `ceph trace
+    show`, and its id rides the op-latency histogram as an OpenMetrics
+    exemplar. Head sampling at the SAME export volume is then shown to
+    miss the slow op (seeded simulation over the actual op count)."""
+    from ceph_tpu.mgr import MgrService
+    from ceph_tpu.rados.client import Rados
+    from tests.test_cluster_live import REP_POOL, Cluster, wait_until
+    from tools.ceph_top import TopClient
+
+    async def main():
+        cfg = tail_cluster_cfg(mgr_prometheus_exemplars=True)
+        cluster = Cluster(cfg=cfg)
+        await cluster.start()
+        rados = Rados("client.tail", cluster.monmap, config=cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        mgr = MgrService("mgr.fr", cluster.monmap, config=cfg)
+        await mgr.start()
+        await wait_until(lambda: mgr.active, timeout=30)
+        io = rados.io_ctx(REP_POOL)
+
+        # fast ops: recorded in flight rings, promoted nowhere
+        N_FAST = 10
+        for i in range(N_FAST):
+            await io.write_full(f"fast{i}", b"f" * 2048)
+        assert rados.objecter.tracer.dump_tracing()["num_spans"] == 0
+
+        # one seeded chaos-delayed op: replica sub-ops + acks stall, the
+        # primary's osd_op (and the client's op_submit root) go slow
+        cfg.set("ms_inject_chaos_seed", 11)
+        cfg.set("ms_inject_chaos_schedule",
+                "delay:osd.*>osd.*:1.0:0.5")
+        await io.write_full("slow-obj", b"s" * 2048)
+        cfg.set("ms_inject_chaos_schedule", "")
+
+        flight = [
+            s for s in list(rados.objecter.tracer._flight)
+            if getattr(s, "name", None) == "op_submit"
+            and s.tags.get("object") == "slow-obj"
+        ]
+        assert flight, "client flight ring lost the slow root"
+        slow = flight[-1]
+        assert slow.sampled is False  # head sampling never kept it
+        assert slow.duration * 1e3 >= 60.0, "chaos delay did not bite"
+
+        # deterministic capture: the trace reached the mgr collector
+        await wait_until(
+            lambda: any(
+                t["trace_id"] == slow.trace_id
+                for t in mgr.traces.ls_document()["traces"]
+            ),
+            timeout=30,
+        )
+
+        # `ceph trace ls` / `ceph trace show <id>` over the real wire
+        top = TopClient(cluster.monmap, name="client.trc")
+        try:
+            ls = await top.fetch("trace ls")
+            row = next(
+                t for t in ls["traces"]
+                if t["trace_id"] == slow.trace_id
+            )
+            assert row["reason"] in ("slow", "relay")
+            doc = await top.fetch(
+                "trace show", trace_id=slow.trace_id
+            )
+        finally:
+            await top.close()
+        assert doc["num_spans"] >= 1
+        names = {s["name"] for s in doc["spans"]}
+        assert "osd_op" in names or "op_submit" in names
+        assert doc["duration_ms"] >= 60.0
+
+        # the id rides the latency histogram as an OpenMetrics exemplar
+        def scraped():
+            for d in mgr.metrics.daemons.values():
+                ex = d.exemplars.get("lat_us_osd_op")
+                if ex and ex["trace_id"] == slow.trace_id:
+                    return True
+            return False
+
+        await wait_until(scraped, timeout=30)
+        text = await mgr.prometheus_scrape()
+        assert f'# {{trace_id="{slow.trace_id}"}}' in text
+
+        # the `ceph top` drill-down pane lists it
+        topdoc = mgr.metrics.top_document()
+        topdoc["traces"] = mgr.traces.recent()
+        from tools.ceph_top import render_top
+
+        rendered = render_top(topdoc)
+        assert slow.trace_id in rendered
+
+        # head sampling at the SAME export volume misses the slow op:
+        # 1 promoted trace / 11 ops -> rate 1/11; the seeded draw
+        # sequence (deterministic) fails to select the slow op
+        import random
+
+        rng = random.Random(11)
+        rate = 1.0 / (N_FAST + 1)
+        draws = [rng.random() < rate for _ in range(N_FAST + 1)]
+        assert not draws[-1], "chosen seed must demonstrate the miss"
+        # ...while the tail sampler's keep decision is unconditional
+        assert any(
+            t["trace_id"] == slow.trace_id
+            for t in mgr.traces.ls_document()["traces"]
+        )
+
+        await mgr.stop()
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_live_slo_violation_pushes_capture_predicates():
+    """The mgr->daemon capture loop: a violated latency SLO turns into
+    capture predicates pushed down the report channel; daemons then
+    promote matching traces with an `slo:` reason."""
+    from ceph_tpu.mgr import MgrService
+    from ceph_tpu.rados.client import Rados
+    from tests.test_cluster_live import REP_POOL, Cluster, wait_until
+
+    async def main():
+        # every osd_op breaches a 1µs p99 rule: instantly violated
+        cfg = tail_cluster_cfg(
+            tracer_tail_slow_ms=1e9,  # only the SLO path may promote
+            mgr_slo_rules="lat_us_osd_op.p99 < 1",
+        )
+        cluster = Cluster(cfg=cfg)
+        await cluster.start()
+        rados = Rados("client.slo", cluster.monmap, config=cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        mgr = MgrService("mgr.slo", cluster.monmap, config=cfg)
+        await mgr.start()
+        await wait_until(lambda: mgr.active, timeout=30)
+        io = rados.io_ctx(REP_POOL)
+
+        # traffic primes the histograms; two report ticks later the
+        # rule evaluates, violates, and predicates reach the daemons
+        async def violated_and_pushed():
+            for i in range(4):
+                await io.write_full(f"p{i}", b"x" * 1024)
+            return any(
+                o.tracer._captures for o in cluster.osds.values()
+            )
+
+        from tests.test_mgr_live import wait_async
+
+        await wait_async(violated_and_pushed, timeout=60)
+        armed = next(
+            o for o in cluster.osds.values() if o.tracer._captures
+        )
+        assert armed.tracer.capture_version >= 1
+        assert armed.tracer._captures[0]["name"].startswith(
+            "lat_us_osd_op"
+        )
+
+        # subsequent ops are promoted under the rule's name and reach
+        # the collector tagged slo:<rule>
+        async def slo_capture_landed():
+            await io.write_full("cap", b"y" * 1024)
+            return any(
+                t["reason"].startswith("slo:")
+                for t in mgr.traces.ls_document()["traces"]
+            )
+
+        await wait_async(slo_capture_landed, timeout=60)
+
+        await mgr.stop()
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_live_crash_black_box_round_trip(tmp_path):
+    """Fail-stop forensics: an injected fsync failure fences the store;
+    on its way down the daemon writes the black box (flight-ring spans,
+    op tracker state, recent log lines) and clogs the pointer."""
+    from ceph_tpu.rados.client import Rados
+    from tests.test_cluster_live import (
+        N_OSDS,
+        REP_POOL,
+        Cluster,
+        live_config,
+        wait_until,
+    )
+
+    def osd_cfg():
+        cfg = live_config()
+        cfg.set("tracer_enabled", True)
+        cfg.set("tracer_sample_rate", 0.0)
+        cfg.set("osd_objectstore", "blockstore")
+        cfg.set("tracer_crash_dump_dir", str(tmp_path))
+        return cfg
+
+    async def main():
+        cluster = Cluster(
+            cfg=osd_cfg(),
+            osd_configs={i: osd_cfg() for i in range(N_OSDS)},
+        )
+        await cluster.start()
+        rados = Rados("client.bb", cluster.monmap, config=cluster.cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        io = rados.io_ctx(REP_POOL)
+        for i in range(6):
+            await io.write_full(f"bb{i}", b"b" * 4096)
+
+        victim = rados.objecter._calc_target(REP_POOL, "bb0")
+        vosd = cluster.osds[victim]
+        await rados.objecter.osd_admin(
+            victim, "injectargs",
+            {"args": {"blockstore_inject_fsync_fail": 1}},
+        )
+        await rados.objecter.op_submit(
+            REP_POOL, "bb0", "write", b"v2" * 2048, timeout=120.0
+        )
+        await wait_until(lambda: vosd._stopped, timeout=30)
+
+        path = os.path.join(
+            str(tmp_path), f"osd.{victim}.blackbox.json"
+        )
+        assert os.path.exists(path), os.listdir(str(tmp_path))
+        with open(path) as fh:
+            box = json.load(fh)
+        assert box["daemon"] == f"osd.{victim}"
+        assert "fsync" in box["reason"] or "inject" in box["reason"]
+        # causal history survived the crash: every pre-crash op's span
+        # sits in the flight dump despite sample rate 0
+        names = {s["name"] for s in box["flight_spans"]}
+        assert "osd_op" in names, names
+        assert box["historic_ops"]["num_ops"] > 0
+        assert "slowest" in box["historic_ops"]
+        assert any(
+            e.get("message") for e in box["recent_log"]
+        )
+        # ...and the cluster log points at the file
+        logd = await rados.mon_command("log last", {"n": 50})
+        assert any(
+            "black box" in e["message"] and path in e["message"]
+            for e in logd["lines"]
+        ), [e["message"] for e in logd["lines"]][-10:]
+
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())
+
+
+def test_historic_ops_cross_link_flight_ring_live():
+    """dump_historic_ops' slowest view cross-links trace ids to the
+    flight ring while it still holds them (fast tier: one small
+    cluster, no chaos)."""
+    from ceph_tpu.rados.client import Rados
+    from tests.test_cluster_live import REP_POOL, Cluster, wait_until
+
+    async def main():
+        cfg = tail_cluster_cfg()
+        cluster = Cluster(cfg=cfg)
+        await cluster.start()
+        rados = Rados("client.hx", cluster.monmap, config=cfg)
+        await rados.connect()
+        await cluster.create_pools(rados)
+        io = rados.io_ctx(REP_POOL)
+        await io.write_full("hx", b"h" * 2048)
+
+        primary = rados.objecter._calc_target(REP_POOL, "hx")
+        hist = await rados.objecter.osd_admin(
+            primary, "dump_historic_ops"
+        )
+        assert hist["slowest"], "slowest view empty after an op"
+        linked = [o for o in hist["slowest"] if "trace_id" in o]
+        assert linked, "historic op lost its trace id"
+        # the flight ring (sample rate 0!) still holds the trace
+        assert any(o.get("in_flight_ring") for o in linked), linked
+
+        await rados.shutdown()
+        await cluster.stop()
+
+    run(main())

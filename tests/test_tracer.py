@@ -61,24 +61,33 @@ def test_enable_disable_is_config_observed():
     assert tr.start("x") is None
 
 
-def test_sample_rate_zero_samples_nothing():
+def test_sample_rate_zero_exports_nothing():
+    """Tail-sampling contract: at rate 0 every root still gets a span
+    (the flight recorder records EVERY op) but it is unsampled — no
+    entry in the exported ring, nothing in dump_tracing, only the
+    bounded flight ring holds it."""
     tr = Tracer("c", config=traced_config(tracer_sample_rate=0.0))
-    assert all(tr.start("op") is None for _ in range(50))
+    for _ in range(50):
+        sp = tr.start("op")
+        assert sp is not None and sp.sampled is False
+        sp.finish()
+    assert tr.dump_tracing()["num_spans"] == 0  # nothing exported
+    assert len(tr._flight) == 50  # ...but everything flight-recorded
 
 
 def test_per_op_type_rate_overrides_base():
     """tracer_sample_rate_<optype>: recovery reads trace at 100% while
-    steady-state IO (base rate 0) stays unsampled; types without an
-    override inherit the base."""
+    steady-state IO (base rate 0) stays unsampled (flight-only); types
+    without an override inherit the base."""
     tr = Tracer("osd.0", config=traced_config(
         tracer_sample_rate=0.0, tracer_sample_rate_recovery=1.0,
     ))
     for _ in range(20):
         sp = tr.start("recovery_read", op_type="recovery")
-        assert sp is not None
+        assert sp is not None and sp.sampled
         sp.finish()
-        assert tr.start("op_submit", op_type="read") is None  # inherits 0
-        assert tr.start("op_submit") is None  # untyped inherits too
+        assert not tr.start("op_submit", op_type="read").sampled
+        assert not tr.start("op_submit").sampled  # untyped inherits too
 
 
 def test_per_op_type_rate_flips_at_runtime():
@@ -86,17 +95,17 @@ def test_per_op_type_rate_flips_at_runtime():
     very next root; -1 returns the type to inheriting the base rate."""
     cfg = traced_config(tracer_sample_rate=1.0)
     tr = Tracer("osd.0", config=cfg)
-    assert tr.start("op", op_type="write") is not None  # inherits 1.0
+    assert tr.start("op", op_type="write").sampled  # inherits 1.0
     cfg.set("tracer_sample_rate_write", 0.0)
     assert all(
-        tr.start("op", op_type="write") is None for _ in range(20)
+        not tr.start("op", op_type="write").sampled for _ in range(20)
     )
     sp = tr.start("op", op_type="read")  # other types unaffected
-    assert sp is not None
+    assert sp.sampled
     sp.finish()
     cfg.set("tracer_sample_rate_write", -1.0)  # back to inheriting
     sp = tr.start("op", op_type="write")
-    assert sp is not None
+    assert sp.sampled
     sp.finish()
 
 
@@ -260,8 +269,24 @@ def test_trace_context_survives_messenger_roundtrip():
         assert seen["trace"] == root.context().encode()
         ctx = SpanContext.decode(seen["trace"])
         assert ctx.trace_id == root.trace_id and ctx.sampled
-        # both messenger legs produced spans of THIS trace
-        await asyncio.sleep(0.05)  # let the send span finish
+        # both messenger legs produced spans of THIS trace; the send
+        # span closes just after dispatch, so park on the dispatch hook
+        # until it lands instead of a timed sleep
+        from ceph_tpu.msg.messenger import next_dispatch_event
+
+        def send_span_done():
+            return any(
+                s["name"] == "msg_send"
+                for s in client.tracer.spans_of(root.trace_id)
+            )
+
+        deadline = asyncio.get_event_loop().time() + 10
+        while not send_span_done():
+            assert asyncio.get_event_loop().time() < deadline
+            try:
+                await asyncio.wait_for(next_dispatch_event(), 0.05)
+            except asyncio.TimeoutError:
+                pass
         snd = client.tracer.spans_of(root.trace_id)
         assert any(s["name"] == "msg_send" for s in snd)
         rcv = server.tracer.dump_tracing()

@@ -4,6 +4,7 @@ object_info + client linger re-watch) and on-wire frame compression
 
 import asyncio
 
+from ceph_tpu.msg.messenger import next_dispatch_event
 from ceph_tpu.rados.client import Rados
 from tests.test_cluster_live import REP_POOL, Cluster, live_config, wait_until
 
@@ -50,7 +51,12 @@ def test_watch_survives_primary_failover():
         deadline = asyncio.get_event_loop().time() + 30
         while not await notified_again():
             assert asyncio.get_event_loop().time() < deadline
-            await asyncio.sleep(0.5)
+            # the re-watch lands via dispatched messages: park on the
+            # dispatch hook between probes instead of a timed sleep
+            try:
+                await asyncio.wait_for(next_dispatch_event(), 0.25)
+            except asyncio.TimeoutError:
+                pass
         assert "again" in got
         await rados.shutdown()
         await cluster.stop()
@@ -154,17 +160,28 @@ def test_df_reports_at_rest_compression():
             for c in comp
         )
 
-        # ...aggregated by the mon once statfs reports land
+        # ...aggregated by the mon once statfs reports land; size-3 pool,
+        # so all three replicas must have reported before the totals are
+        # meaningful (a lone early report also carries a compress_ratio)
         async def df_compressed():
             df = await rados.mon_command("df")
-            return df if "compress_ratio" in df else None
+            if "compress_ratio" not in df:
+                return None
+            if df["data_compressed_original"] < 3 * 65536:
+                return None
+            return df
 
         loop = asyncio.get_event_loop()
         end = loop.time() + 60
         df = await df_compressed()
         while df is None:
             assert loop.time() < end, await rados.mon_command("df")
-            await asyncio.sleep(0.3)
+            # statfs reports ride dispatched messages — park on the
+            # dispatch hook between probes instead of a timed sleep
+            try:
+                await asyncio.wait_for(next_dispatch_event(), 0.25)
+            except asyncio.TimeoutError:
+                pass
             df = await df_compressed()
         assert 0 < df["compress_ratio"] < 1
         assert df["data_compressed"] < df["data_compressed_original"]

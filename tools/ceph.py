@@ -19,6 +19,8 @@ Commands mirror the reference surface:
     osd down|out|in <osd>
     osd pg-upmap-items <pool.ps> <from:to> [...]
     pg dump [--pool N]               pg -> up/acting/primary
+    trace ls | show <id>             tail-promoted traces from the mgr's
+                                     flight-recorder store
     balancer run [--pools a,b]       one upmap-balancer pass
     daemon osd.<id> <cmd> [k=v...]   admin socket commands (perf dump,
                                      status, scrub pool=N deep=1, repair
@@ -162,6 +164,31 @@ async def _dispatch(rados, args) -> dict:
         return await BalancerModule(rados.objecter.mon).run_once(
             pools=pools
         )
+
+    if cmd == "trace":
+        # flight-recorder queries answered by the active mgr's trace
+        # collector (tail-promoted traces; see ceph_tpu/mgr/traces.py)
+        from ceph_tpu.mon import MonMap
+        from tools.ceph_top import TopClient
+
+        addrs = []
+        for hostport in args.mon_host.split(","):
+            host, _, port = hostport.rpartition(":")
+            addrs.append((host or "127.0.0.1", int(port)))
+        top = TopClient(MonMap(addrs=addrs), name=f"{args.name}.trace")
+        try:
+            sub = args.rest[0] if args.rest else "ls"
+            if sub == "ls":
+                return await top.fetch("trace ls")
+            if sub == "show":
+                if len(args.rest) < 2:
+                    raise SystemExit("usage: trace show <trace_id>")
+                return await top.fetch(
+                    "trace show", trace_id=args.rest[1]
+                )
+            raise SystemExit(f"unknown trace subcommand {sub!r}")
+        finally:
+            await top.close()
 
     if cmd == "daemon":
         target = args.rest[0]

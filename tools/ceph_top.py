@@ -65,7 +65,8 @@ class TopClient:
             name, monmap, config=self.config, messenger=self.messenger
         )
 
-    async def fetch(self, cmd: str = "top", timeout: float = 10.0) -> dict:
+    async def fetch(self, cmd: str = "top", timeout: float = 10.0,
+                    **params) -> dict:
         from ceph_tpu.msg import Message, Policy
 
         rep = await self.mon.command("mgr map", timeout=timeout)
@@ -82,7 +83,8 @@ class TopClient:
         fut = asyncio.get_event_loop().create_future()
         self._waiters[tid] = fut
         conn.send_message(
-            Message(type="mgr_command", tid=tid, payload={"cmd": cmd})
+            Message(type="mgr_command", tid=tid,
+                    payload={"cmd": cmd, **params})
         )
         try:
             reply = await asyncio.wait_for(fut, timeout)
@@ -152,6 +154,19 @@ def render_top(doc: dict, sort: str = "ops") -> str:
                 f"  [{state:>8}] {r['rule']}  margin "
                 f"{r['margin']:+.3f}  worst {r['daemon']} "
                 f"= {r['value']:.6g}"
+            )
+    if doc.get("traces"):
+        lines.append("")
+        lines.append(
+            "TRACES (tail-promoted, newest first — "
+            "`ceph trace show <id>` for the span tree):"
+        )
+        for t in doc["traces"]:
+            lines.append(
+                f"  {t['trace_id']}  {t.get('reason', '?'):<10} "
+                f"{t.get('duration_ms', 0):>9.1f}ms  "
+                f"{t.get('num_spans', 0):>3} spans  "
+                f"{','.join(t.get('daemons', []))}"
             )
     return "\n".join(lines)
 

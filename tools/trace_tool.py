@@ -11,8 +11,18 @@ trace-view role, in a terminal).
 
 Usage:
     python tools/trace_tool.py trace.jsonl [--trace <id>] [--limit N]
+    python tools/trace_tool.py traces.json --critical-report
 
-Also accepts `dump_tracing` admin output piped on stdin with `-`.
+Also accepts `dump_tracing` admin output or a `ceph trace show <id>`
+document (the mgr flight-recorder store's merged span tree) piped on
+stdin with `-`.
+
+`--critical-report` aggregates ACROSS traces instead of rendering each:
+for every stage (service: span name) on any trace's critical path it
+reports how much wall time that stage contributed (span self-time on
+the path, i.e. duration minus the on-path child it was waiting on) at
+p50/p99 — over a batch of tail-promoted traces this answers "when ops
+are slow, WHERE are they slow" in one table.
 """
 
 from __future__ import annotations
@@ -37,6 +47,11 @@ def load_spans(path: str) -> list[dict]:
         for trace in doc.get("traces", []):
             spans.extend(trace.get("spans", []))
         return spans
+    if stripped.startswith("{") and '"spans"' in stripped[:2000]:
+        # `ceph trace show <id>` document: the mgr collector's merged
+        # span tree — spans are already internal-shape dump dicts
+        doc = json.loads(raw)
+        return list(doc.get("spans", []))
     for line in raw.splitlines():
         line = line.strip()
         if not line:
@@ -108,6 +123,58 @@ def critical_path(spans: list[dict]) -> list[dict]:
         path.append(node)
 
 
+def path_contributions(spans: list[dict]) -> list[tuple[str, float]]:
+    """(stage, seconds) self-time of every critical-path node: a node's
+    contribution is its duration minus its on-path child's — the time
+    the op spent IN that stage rather than waiting below it. The leaf
+    keeps its full duration. Sums to roughly the root's wall time."""
+    path = critical_path(spans)
+    out: list[tuple[str, float]] = []
+    for i, s in enumerate(path):
+        stage = f"{s['service']}: {s['name']}"
+        child_dur = path[i + 1]["duration"] if i + 1 < len(path) else 0.0
+        out.append((stage, max(0.0, s["duration"] - child_dur)))
+    return out
+
+
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank quantile over an ascending list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def critical_report(traces: dict[str, list[dict]]) -> str:
+    """Aggregate per-stage critical-path contributions across traces:
+    p50/p99/max self-time plus each stage's share of the summed wall
+    time — the "where do slow ops spend their time" table."""
+    stages: dict[str, list[float]] = {}
+    for spans in traces.values():
+        for stage, secs in path_contributions(spans):
+            stages.setdefault(stage, []).append(secs)
+    grand = sum(sum(v) for v in stages.values())
+    lines = [
+        f"critical-path contribution over {len(traces)} trace(s) "
+        f"({grand * 1e3:.3f}ms total on-path time)",
+        f"{'STAGE':<40} {'N':>4} {'P50':>10} {'P99':>10} "
+        f"{'MAX':>10} {'SHARE':>6}",
+    ]
+    rows = sorted(
+        stages.items(), key=lambda kv: sum(kv[1]), reverse=True
+    )
+    for stage, vals in rows:
+        vals.sort()
+        share = 100.0 * sum(vals) / grand if grand > 0 else 0.0
+        lines.append(
+            f"{stage:<40} {len(vals):>4} "
+            f"{_quantile(vals, 0.50) * 1e3:>8.3f}ms "
+            f"{_quantile(vals, 0.99) * 1e3:>8.3f}ms "
+            f"{max(vals) * 1e3:>8.3f}ms {share:>5.1f}%"
+        )
+    return "\n".join(lines)
+
+
 def _fmt_ms(seconds: float) -> str:
     return f"{seconds * 1e3:8.3f}ms"
 
@@ -175,8 +242,17 @@ def main(argv=None) -> int:
                     help="render only this trace id")
     ap.add_argument("--limit", type=int, default=10,
                     help="max traces rendered (newest first)")
+    ap.add_argument("--critical-report", action="store_true",
+                    help="aggregate per-stage critical-path p50/p99 "
+                         "contributions across all traces")
     args = ap.parse_args(argv)
     traces = group_traces(load_spans(args.path))
+    if args.critical_report:
+        if not traces:
+            print("no traces to aggregate", file=sys.stderr)
+            return 1
+        print(critical_report(traces))
+        return 0
     if args.trace is not None:
         traces = {k: v for k, v in traces.items() if k == args.trace}
         if not traces:
